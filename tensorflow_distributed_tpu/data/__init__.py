@@ -1,0 +1,15 @@
+"""Data layer: dataset loading + sharded host->device batching.
+
+Replaces the reference's ``tensorflow.examples.tutorials.mnist.input_data``
+loader and per-step feed_dict path (SURVEY.md N13/N14).
+"""
+
+from tensorflow_distributed_tpu.data.mnist import (  # noqa: F401
+    Dataset,
+    ShardedBatcher,
+    load_dataset,
+    load_mnist,
+    parse_idx,
+    synthetic_mnist,
+)
+from tensorflow_distributed_tpu.data.prefetch import prefetch_to_mesh  # noqa: F401

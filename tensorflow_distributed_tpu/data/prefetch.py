@@ -1,0 +1,36 @@
+"""Double-buffered host->device prefetch.
+
+The reference paid a synchronous feed_dict host->runtime copy inside
+every ``sess.run`` (mnist_python_m.py:291-294, SURVEY.md N14). Here the
+next batch's device transfer overlaps the current step's compute:
+``jax.device_put`` is async, so simply staying one batch ahead of the
+consumer hides the PCIe/DMA latency behind the MXU work.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Iterator, Optional
+
+from jax.sharding import Mesh
+
+from tensorflow_distributed_tpu.parallel.sharding import shard_batch
+
+
+def prefetch_to_mesh(it: Iterator[Any], mesh: Mesh, size: int = 2,
+                     seq_axis: Optional[int] = None) -> Iterator[Any]:
+    """Yield batches already device_put against ``mesh``, ``size`` ahead."""
+    buf = collections.deque()
+
+    def enqueue(n: int) -> None:
+        for _ in range(n):
+            try:
+                batch = next(it)
+            except StopIteration:
+                return
+            buf.append(shard_batch(mesh, batch, seq_axis=seq_axis))
+
+    enqueue(size)
+    while buf:
+        yield buf.popleft()
+        enqueue(1)

@@ -1,0 +1,22 @@
+"""Model zoo.
+
+``mnist_cnn`` is the reference-parity model (the CNN duplicated across
+mnist_python_m.py:93-128, mnist_single.py:55-88 and the notebook — here
+it exists exactly once). ResNet and the transformer families extend the
+same train-step machinery to the BASELINE.json scale-out configs.
+"""
+
+from tensorflow_distributed_tpu.models.cnn import MnistCNN  # noqa: F401
+
+
+def build_model(name: str, **kw):
+    from tensorflow_distributed_tpu.models import cnn, resnet, transformer
+    registry = {
+        "mnist_cnn": cnn.MnistCNN,
+        "resnet20": resnet.resnet20,
+        "resnet50": resnet.resnet50,
+        "bert_mlm": transformer.bert_base_mlm,
+    }
+    if name not in registry:
+        raise ValueError(f"unknown model {name!r}; have {sorted(registry)}")
+    return registry[name](**kw)

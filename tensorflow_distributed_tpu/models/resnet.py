@@ -1,0 +1,13 @@
+"""ResNet family for the BASELINE.json scale-out configs
+(ResNet-20/CIFAR-10, ResNet-50/ImageNet). Implemented in a later
+milestone of this round; importable now so the registry stays total."""
+
+from __future__ import annotations
+
+
+def resnet20(**kw):
+    raise NotImplementedError("resnet20 lands in a later milestone")
+
+
+def resnet50(**kw):
+    raise NotImplementedError("resnet50 lands in a later milestone")

@@ -1,0 +1,6 @@
+"""Numerics: losses, metrics, and Pallas TPU kernels for the hot ops."""
+
+from tensorflow_distributed_tpu.ops.losses import (  # noqa: F401
+    accuracy,
+    softmax_cross_entropy,
+)

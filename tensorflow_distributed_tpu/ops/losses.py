@@ -1,0 +1,36 @@
+"""Loss and metric math.
+
+Parity targets in the reference:
+- loss: ``tf.reduce_mean(tf.nn.softmax_cross_entropy_with_logits(pred, y))``
+  with one-hot labels (mnist_python_m.py:205; mnist_single.py:94).
+- metric: argmax-equality accuracy (mnist_python_m.py:206-207;
+  mnist_single.py:97-98).
+
+Computed in float32 regardless of the model's compute dtype — softmax
+log-sum-exp in bf16 loses enough mantissa to visibly bend training curves.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean softmax cross-entropy; ``labels`` are int class ids.
+
+    The reference fed one-hot labels; integer labels with a take-along
+    gather are the same math with one less materialized [B,10] tensor.
+    """
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[:, None].astype(jnp.int32),
+                               axis=-1)[:, 0]
+    return jnp.mean(logz - gold)
+
+
+def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Fraction of argmax predictions equal to labels
+    (mnist_python_m.py:206-207)."""
+    pred = jnp.argmax(logits, axis=-1)
+    return jnp.mean((pred == labels).astype(jnp.float32))

@@ -1,0 +1,23 @@
+"""Parallelism substrate: mesh bootstrap, sharding rules, collectives.
+
+Replaces the reference's entire distribution/coordination layer
+(ClusterSpec + tf.train.Server + replica_device_setter +
+SyncReplicasOptimizer + Supervisor, mnist_python_m.py:146-282) with
+mesh construction + sharding annotations; XLA's SPMD partitioner inserts
+the collectives.
+"""
+
+from tensorflow_distributed_tpu.parallel.mesh import (  # noqa: F401
+    AXIS_DATA,
+    AXIS_MODEL,
+    AXIS_SEQ,
+    bootstrap,
+    is_chief,
+    make_mesh,
+)
+from tensorflow_distributed_tpu.parallel.sharding import (  # noqa: F401
+    batch_sharding,
+    param_sharding,
+    replicated,
+    shard_batch,
+)

@@ -1,0 +1,114 @@
+"""Device-mesh construction and multi-host bootstrap.
+
+TPU-native replacement for the reference's cluster plumbing:
+
+- ``tf.train.ClusterSpec`` parsed from ``ps_hosts``/``worker_hosts`` flags
+  (mnist_python_m.py:146-154) -> ``bootstrap()`` driving
+  ``jax.distributed.initialize`` from env vars; the device set then comes
+  from ``jax.devices()``. There is no ps role: every device is a worker
+  and parameters live on-chip.
+- ``tf.train.Server`` / ``server.join()`` (mnist_python_m.py:156-161) ->
+  nothing user-visible; the TPU runtime and ICI fabric replace gRPC.
+- ``is_chief`` (task_index == 0, mnist_python_m.py:163) -> ``is_chief()``
+  == ``jax.process_index() == 0``, used only to elect one process for
+  logging/checkpoint writes, never for an init dance.
+
+Mesh axes:
+    data   — data parallelism (the reference's 2 worker replicas)
+    model  — tensor parallelism (not in the reference; first-class here)
+    seq    — sequence/context parallelism (ring attention)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from tensorflow_distributed_tpu.config import MeshConfig
+
+AXIS_DATA = "data"
+AXIS_MODEL = "model"
+AXIS_SEQ = "seq"
+MESH_AXES = (AXIS_DATA, AXIS_SEQ, AXIS_MODEL)
+
+_bootstrapped = False
+
+
+def bootstrap(coordinator: Optional[str] = None,
+              num_processes: Optional[int] = None,
+              process_id: Optional[int] = None) -> None:
+    """Initialize multi-host JAX if a coordinator is configured.
+
+    Replaces the reference's per-role server boot
+    (mnist_python_m.py:156-161) and the chief's
+    ``prepare_or_wait_for_session`` barrier (:272-275): after this
+    returns, every process sees the same global device list and
+    compiles the same SPMD program — there is nothing to "wait" for.
+
+    No-op on a single host (the common test/bench path). Arguments
+    default to the ``TPU_COORDINATOR_ADDRESS`` / ``TPU_NUM_PROCESSES`` /
+    ``TPU_PROCESS_ID`` environment variables, so launching N identical
+    processes with different env is the whole cluster story — the
+    reference needed three differently-edited script copies.
+    """
+    global _bootstrapped
+    if _bootstrapped:
+        return
+    coordinator = coordinator or os.environ.get("TPU_COORDINATOR_ADDRESS")
+    if coordinator is None:
+        _bootstrapped = True
+        return
+    num_processes = num_processes or int(os.environ.get("TPU_NUM_PROCESSES", "1"))
+    process_id = process_id if process_id is not None else int(
+        os.environ.get("TPU_PROCESS_ID", "0"))
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _bootstrapped = True
+
+
+def is_chief() -> bool:
+    """True on the process elected for logging/checkpoint writes.
+
+    The reference's chief (task_index==0, mnist_python_m.py:163) also ran
+    variable init, sync-token init and a queue-runner thread
+    (:224-233,:279-282); none of that exists under SPMD — this is purely
+    "who prints".
+    """
+    return jax.process_index() == 0
+
+
+def make_mesh(cfg: Optional[MeshConfig] = None,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a ``(data, seq, model)`` mesh over the given devices.
+
+    ``cfg.data == -1`` means "all devices not consumed by seq/model".
+    A 1-device mesh is valid and is exactly the reference's
+    single-device path (mnist_single.py): same train step, mesh of one.
+    """
+    cfg = cfg or MeshConfig()
+    cfg.validate()
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    denom = cfg.model * cfg.seq
+    if n % denom != 0:
+        raise ValueError(
+            f"{n} devices not divisible by model*seq = {cfg.model}*{cfg.seq}")
+    data = cfg.data if cfg.data != -1 else n // denom
+    if data * denom != n:
+        raise ValueError(
+            f"mesh {data}x{cfg.seq}x{cfg.model} != {n} devices")
+    arr = np.array(devices).reshape(data, cfg.seq, cfg.model)
+    return Mesh(arr, MESH_AXES)
+
+
+def single_device_mesh(device: Optional[jax.Device] = None) -> Mesh:
+    """1-device mesh — the mnist_single.py path, same code, mesh of one."""
+    device = device or jax.devices()[0]
+    return make_mesh(MeshConfig(data=1), [device])

@@ -1,0 +1,92 @@
+"""Sharding rules: how params, optimizer state, and batches map to a mesh.
+
+TPU-native replacement for ``tf.train.replica_device_setter``
+(mnist_python_m.py:177), which round-robined Variables onto the ps and
+compute onto workers. Here there is no variable/op placement split:
+parameters carry (optional) partition metadata, batches are sharded over
+the data axis, and XLA's SPMD partitioner emits collectives (psum over
+ICI) wherever math crosses shards — the per-step ps pull/push
+(SURVEY.md N4) simply has no analog.
+
+Conventions:
+- Model params without partition metadata are fully replicated (the
+  reference's model, ~3.3M params, is small enough that ZeRO-style
+  sharding would be pure overhead).
+- Params built with ``flax.linen.with_partitioning`` carry logical axis
+  names that are already mesh axis names ("model", "seq") — used by the
+  tensor-parallel transformer.
+- Batches shard their leading axis over "data" (and, for long-sequence
+  inputs, their sequence axis over "seq").
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import numpy as np
+from flax import linen as nn
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tensorflow_distributed_tpu.parallel.mesh import AXIS_DATA, AXIS_SEQ
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Fully-replicated sharding (params live on every chip, unlike the
+    reference where they lived only on the ps CPU and streamed over TCP
+    each step, mnist_python_m.py:177, SURVEY.md N4)."""
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, ndim: int = 1,
+                   seq_axis: Optional[int] = None) -> NamedSharding:
+    """Shard dim 0 over the data axis; optionally a sequence dim over seq.
+
+    This is the framework's data-parallel contract: each data-slice of
+    the mesh sees a disjoint shard of the global batch — unlike the
+    reference, whose workers sampled MNIST independently with no
+    sharding at all (SURVEY.md N13; a documented behavioral upgrade).
+    """
+    spec = [None] * ndim
+    spec[0] = AXIS_DATA
+    if seq_axis is not None:
+        spec[seq_axis] = AXIS_SEQ
+    return NamedSharding(mesh, P(*spec))
+
+
+def param_sharding(mesh: Mesh, tree: Any) -> Any:
+    """NamedSharding tree for a (possibly metadata-boxed) param pytree.
+
+    Leaves wrapped by ``nn.with_partitioning`` map their axis names onto
+    the mesh; bare leaves are replicated.
+    """
+    def one(leaf):
+        if isinstance(leaf, nn.Partitioned):
+            return NamedSharding(mesh, P(*leaf.names))
+        return replicated(mesh)
+
+    return jax.tree_util.tree_map(
+        one, tree, is_leaf=lambda x: isinstance(x, nn.Partitioned))
+
+
+def shard_batch(mesh: Mesh, batch: Any, seq_axis: Optional[int] = None) -> Any:
+    """device_put a host batch as a globally-sharded array.
+
+    Replaces the reference's per-step feed_dict host->runtime copy
+    (mnist_python_m.py:291-294, SURVEY.md N14). On one host this splits
+    the (full) global batch over local devices. Under multi-host each
+    process passes only its local shard (the process-disjoint rows from
+    ``data.ShardedBatcher``) and the pieces are assembled into one
+    global array via ``make_array_from_process_local_data`` — the global
+    batch keeps its full size B, each host contributing B/P rows.
+    """
+    multihost = jax.process_count() > 1
+
+    def one(x):
+        x = np.asarray(x)
+        sharding = batch_sharding(mesh, x.ndim, seq_axis)
+        if multihost:
+            return jax.make_array_from_process_local_data(sharding, x)
+        return jax.device_put(x, sharding)
+
+    return jax.tree_util.tree_map(one, batch)

@@ -1,0 +1,10 @@
+"""Training: state, jitted SPMD steps, loop, checkpointing."""
+
+from tensorflow_distributed_tpu.train.state import (  # noqa: F401
+    TrainState,
+    create_train_state,
+)
+from tensorflow_distributed_tpu.train.step import (  # noqa: F401
+    make_eval_step,
+    make_train_step,
+)

@@ -1,0 +1,43 @@
+"""Optimizer construction.
+
+The reference used ``tf.train.AdamOptimizer`` with its slots (m, v)
+living on the ps like every other variable (mnist_python_m.py:208,
+SURVEY.md N12). Here the optimizer is an optax transformation whose
+state is sharded exactly like the params (on-chip, replicated or
+partitioned) — there is no ps for it to live on.
+"""
+
+from __future__ import annotations
+
+import optax
+
+from tensorflow_distributed_tpu.config import TrainConfig
+
+
+def make_schedule(cfg: TrainConfig) -> optax.Schedule:
+    if cfg.lr_schedule == "constant":
+        return optax.constant_schedule(cfg.learning_rate)
+    if cfg.lr_schedule == "cosine":
+        return optax.cosine_decay_schedule(cfg.learning_rate, cfg.train_steps)
+    if cfg.lr_schedule == "warmup_cosine":
+        return optax.warmup_cosine_decay_schedule(
+            init_value=0.0, peak_value=cfg.learning_rate,
+            warmup_steps=max(cfg.warmup_steps, 1),
+            decay_steps=max(cfg.train_steps, cfg.warmup_steps + 1))
+    raise ValueError(f"unknown lr_schedule {cfg.lr_schedule!r}")
+
+
+def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
+    sched = make_schedule(cfg)
+    if cfg.optimizer == "adam":
+        if cfg.weight_decay:
+            core = optax.adamw(sched, weight_decay=cfg.weight_decay)
+        else:
+            core = optax.adam(sched)
+    elif cfg.optimizer == "sgd":
+        core = optax.sgd(sched, momentum=0.9)
+    else:
+        raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
+    if cfg.grad_clip_norm:
+        return optax.chain(optax.clip_by_global_norm(cfg.grad_clip_norm), core)
+    return core

@@ -1,0 +1,72 @@
+"""Train state: the entire mutable world of a run, as one pytree.
+
+Replaces the reference's scattered mutable state — ps-resident Variables
+(mnist_python_m.py:185-196), Adam slots, the ``global_step`` Variable
+(:178), and accumulator/queue state inside SyncReplicasOptimizer — with
+one immutable pytree threaded through a jitted step. ``step`` increments
+once per aggregated update exactly like the reference's global_step
+(SURVEY.md N15); there is no separate local_step because SPMD has no
+stale gradients to count.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import optax
+from flax import struct
+from jax.sharding import Mesh
+
+from tensorflow_distributed_tpu.parallel.sharding import param_sharding, replicated
+from tensorflow_distributed_tpu.utils import prng
+
+
+@struct.dataclass
+class TrainState:
+    step: jax.Array
+    params: Any
+    opt_state: Any
+
+    # Static (non-pytree) fields:
+    apply_fn: Callable = struct.field(pytree_node=False)
+    tx: optax.GradientTransformation = struct.field(pytree_node=False)
+
+
+def create_train_state(model: nn.Module, tx: optax.GradientTransformation,
+                       sample_input: jax.Array, mesh: Mesh, seed: int = 0
+                       ) -> TrainState:
+    """Initialize params/opt-state and place them on the mesh.
+
+    Every process calls this with the same seed and gets bit-identical
+    params — replacing the reference's chief-initializes-then-others-wait
+    protocol (``prepare_or_wait_for_session``, mnist_python_m.py:264-275).
+    Partition-annotated params land sharded; everything else replicated.
+    """
+    # Abstract init to read partition metadata without allocating.
+    abstract = jax.eval_shape(
+        lambda k: model.init(k, sample_input, train=False),
+        jax.random.key(0))
+    # param_sharding maps each metadata box (or bare leaf) to a
+    # NamedSharding, yielding a tree with the *unboxed* structure.
+    shardings = param_sharding(mesh, abstract["params"])
+
+    def init_params(key):
+        v = model.init(key, sample_input, train=False)
+        return nn.meta.unbox(v["params"])
+
+    with mesh:
+        params = jax.jit(init_params, out_shardings=shardings)(
+            prng.init_key(seed))
+        # Adam's m/v mirror the params elementwise, so jit propagates the
+        # param shardings into the optimizer state.
+        opt_state = jax.jit(tx.init)(params)
+        step = jax.device_put(jax.numpy.zeros((), jax.numpy.int32),
+                              replicated(mesh))
+    return TrainState(step=step, params=params, opt_state=opt_state,
+                      apply_fn=model.apply, tx=tx)
+
+
+def param_count(params: Any) -> int:
+    return sum(x.size for x in jax.tree_util.tree_leaves(params))

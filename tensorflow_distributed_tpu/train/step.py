@@ -1,0 +1,102 @@
+"""The jitted SPMD train/eval steps — the core of the framework.
+
+This single function replaces the reference's entire per-step distributed
+machinery (SURVEY.md N3-N5, N12, N14, N15):
+
+    reference (per sync step, over gRPC/TCP)          here (on-chip)
+    ------------------------------------------        ----------------
+    workers pull full weights from ps                 params already resident
+    each worker: forward/backward                     same, per mesh slice
+    workers push grads to ps accumulators             XLA psum over ICI
+    ps waits for replicas_to_aggregate=2, means       mean is the psum, sync
+    ps ApplyAdam, bumps global_step                   optax update + step+1
+    token queue releases workers                      nothing to release
+
+Synchronous-by-construction: there are no accumulators, stale-gradient
+drops, token queues, or chief queue-runner threads
+(mnist_python_m.py:210-233, :279-282) because SPMD has no asynchrony to
+police. Loss is the mean over the *global* batch, which is exactly
+SyncReplicasOptimizer's mean-of-replica-gradients semantics (mean of
+per-shard means over equal shards == global mean).
+
+The same compiled step runs on a 1-device mesh (the mnist_single.py
+path) and an N-device mesh — BASELINE.json's north star.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from tensorflow_distributed_tpu.ops.losses import accuracy, softmax_cross_entropy
+from tensorflow_distributed_tpu.parallel.sharding import batch_sharding, replicated
+from tensorflow_distributed_tpu.train.state import TrainState
+from tensorflow_distributed_tpu.utils import prng
+
+Batch = Tuple[jax.Array, jax.Array]  # (images, labels)
+Metrics = Dict[str, jax.Array]
+
+
+def loss_fn(apply_fn: Callable, params: Any, batch: Batch,
+            dropout_key: jax.Array, train: bool) -> Tuple[jax.Array, Metrics]:
+    images, labels = batch
+    logits = apply_fn({"params": params}, images, train=train,
+                      rngs={"dropout": dropout_key} if train else {})
+    loss = softmax_cross_entropy(logits, labels)
+    return loss, {"loss": loss, "accuracy": accuracy(logits, labels)}
+
+
+def make_train_step(mesh: Mesh, seed: int = 0,
+                    donate: bool = True) -> Callable[[TrainState, Batch],
+                                                     Tuple[TrainState, Metrics]]:
+    """Build the jitted train step for a mesh.
+
+    Gradient synchronization is implicit: params are replicated (or
+    partition-annotated) and the batch is sharded over the data axis, so
+    XLA's SPMD partitioner inserts the psum allreduce in the backward
+    pass — the explicit, inspectable shard_map/psum formulation lives in
+    ``parallel.collectives`` and is proven equivalent in tests.
+    """
+
+    def step(state: TrainState, batch: Batch) -> Tuple[TrainState, Metrics]:
+        # Per-step dropout key derived on-device from the step counter —
+        # no host round-trip, fully deterministic (utils.prng).
+        dkey = prng.step_key(seed, state.step)
+        grad_fn = jax.value_and_grad(
+            partial(loss_fn, state.apply_fn), has_aux=True)
+        (_, metrics), grads = grad_fn(state.params, batch, dkey, True)
+        updates, new_opt = state.tx.update(grads, state.opt_state, state.params)
+        new_params = jax.tree_util.tree_map(
+            lambda p, u: (p + u.astype(p.dtype)), state.params, updates)
+        new_state = state.replace(step=state.step + 1, params=new_params,
+                                  opt_state=new_opt)
+        return new_state, metrics
+
+    with mesh:
+        return jax.jit(
+            step,
+            in_shardings=(None, (batch_sharding(mesh, 4), batch_sharding(mesh, 1))),
+            donate_argnums=(0,) if donate else (),
+        )
+
+
+def make_eval_step(mesh: Mesh) -> Callable[[TrainState, Batch], Metrics]:
+    """Jitted eval: loss + accuracy over a (sharded) eval batch — the
+    reference's validation pass (mnist_python_m.py:309-320) as one SPMD
+    call instead of 5 feed_dict sess.runs."""
+
+    def step(state: TrainState, batch: Batch) -> Metrics:
+        _, metrics = loss_fn(state.apply_fn, state.params, batch,
+                             jax.random.key(0), False)
+        return metrics
+
+    with mesh:
+        return jax.jit(
+            step,
+            in_shardings=(None, (batch_sharding(mesh, 4), batch_sharding(mesh, 1))),
+            out_shardings=replicated(mesh),
+        )

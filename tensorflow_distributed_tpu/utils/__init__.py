@@ -1,0 +1,1 @@
+"""Shared utilities: PRNG discipline, structured logging, timing."""

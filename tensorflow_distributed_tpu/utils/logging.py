@@ -1,0 +1,74 @@
+"""Structured per-step logging + the reference `performance` table format.
+
+The reference's observability was bare ``print()`` (timestamps + steps at
+mnist_python_m.py:297-299, loss every 10 steps at mnist_single.py:113-116,
+including one malformed print at mnist_python_m.py:316) and a
+hand-maintained 6-line ``performance`` file. This module logs structured
+rows and can regenerate that exact table automatically.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, TextIO
+
+
+@dataclass
+class StepRecord:
+    step: int
+    wall_time: float
+    metrics: Dict[str, float]
+
+
+@dataclass
+class MetricLogger:
+    """Collects per-step metrics; one process (the chief) prints them."""
+
+    enabled: bool = True
+    stream: TextIO = sys.stdout
+    records: List[StepRecord] = field(default_factory=list)
+    _t0: float = field(default_factory=time.time)
+
+    def log(self, step: int, **metrics: float) -> None:
+        rec = StepRecord(step=step, wall_time=time.time() - self._t0,
+                         metrics={k: float(v) for k, v in metrics.items()})
+        self.records.append(rec)
+        if self.enabled:
+            parts = " ".join(f"{k}={v:.6g}" for k, v in rec.metrics.items())
+            print(f"[step {step:>6}] t={rec.wall_time:8.2f}s {parts}",
+                  file=self.stream, flush=True)
+
+    def log_json(self, payload: Dict[str, Any]) -> None:
+        if self.enabled:
+            print(json.dumps(payload), file=self.stream, flush=True)
+
+    def performance_table(self, learning_rate: float) -> str:
+        """Render eval records in the reference's `performance` file format:
+        ``Steps, Time, Accuracy, Learning rate`` (performance:1-6)."""
+        lines = ["Steps,        Time,      Accuracy,  Learning rate"]
+        for rec in self.records:
+            if "accuracy" not in rec.metrics:
+                continue
+            lines.append(
+                f"{rec.step},        {rec.wall_time:.0f} seconds,  "
+                f"{100.0 * rec.metrics['accuracy']:.2f},      {learning_rate}")
+        return "\n".join(lines)
+
+
+@dataclass
+class Timer:
+    """Wall-clock span timer, mirroring the reference's train/infer timing
+    prints (mnist_single.py:102,119-120,133-134)."""
+
+    _start: Optional[float] = None
+    elapsed: float = 0.0
+
+    def __enter__(self) -> "Timer":
+        self._start = time.time()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.elapsed = time.time() - self._start

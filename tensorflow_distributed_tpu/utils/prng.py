@@ -1,0 +1,29 @@
+"""PRNG key discipline.
+
+The reference relied on process-local ``tf.random_normal`` ops with no
+seed control (mnist_python_m.py:185-196) — every run and every worker got
+different init, and only the ps's copy mattered. Here a single root seed
+derives every stream deterministically, so N-device and 1-device runs are
+bit-comparable (the basis of the sync-parity tests, SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def root_key(seed: int) -> jax.Array:
+    return jax.random.key(seed)
+
+
+def init_key(seed: int) -> jax.Array:
+    """Key for parameter init — shared across all processes so every host
+    materializes identical params (replaces the chief-initializes-ps
+    variables dance, mnist_python_m.py:272-275)."""
+    return jax.random.fold_in(root_key(seed), 0)
+
+
+def step_key(seed: int, step) -> jax.Array:
+    """Per-step key (dropout etc.), derived inside the jitted step from
+    the step counter so it needs no host round-trip."""
+    return jax.random.fold_in(jax.random.fold_in(root_key(seed), 1), step)

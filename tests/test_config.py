@@ -1,0 +1,50 @@
+"""Config surface tests (replaces the reference's 14-flag system,
+SURVEY.md Appendix A)."""
+
+import pytest
+
+from tensorflow_distributed_tpu.config import MeshConfig, TrainConfig, parse_args
+
+
+def test_defaults_valid():
+    cfg = TrainConfig()
+    cfg.validate()
+    assert cfg.model == "mnist_cnn"
+    # Global batch 256 == reference's 2 workers x 128 per-worker batch
+    # (mnist_python_m.py:62-70).
+    assert cfg.batch_size == 256
+
+
+def test_parse_args_roundtrip():
+    cfg = parse_args([
+        "--batch-size", "512", "--learning-rate", "0.01",
+        "--train-steps", "42", "--init-scheme", "reference",
+        "--mesh.data", "4", "--mesh.model", "2",
+    ])
+    assert cfg.batch_size == 512
+    assert cfg.learning_rate == 0.01
+    assert cfg.train_steps == 42
+    assert cfg.init_scheme == "reference"
+    assert cfg.mesh.data == 4 and cfg.mesh.model == 2
+
+
+def test_validation_rejects_bad_values():
+    with pytest.raises(ValueError):
+        TrainConfig(batch_size=0).validate()
+    with pytest.raises(ValueError):
+        TrainConfig(dropout_rate=1.5).validate()
+    with pytest.raises(ValueError):
+        TrainConfig(init_scheme="bogus").validate()
+    with pytest.raises(ValueError):
+        TrainConfig(resume=True).validate()  # resume without checkpoint_dir
+    with pytest.raises(ValueError):
+        MeshConfig(model=0).validate()
+
+
+def test_reference_dead_flags_are_gone():
+    # hidden_units was a dead relic in the reference (SURVEY.md Appendix
+    # B.2); role flags are replaced by env bootstrap.
+    names = {f.name for f in __import__("dataclasses").fields(TrainConfig)}
+    for dead in ("hidden_units", "job_name", "task_index", "ps_hosts",
+                 "worker_hosts", "existing_servers", "num_gpus"):
+        assert dead not in names

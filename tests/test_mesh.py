@@ -1,0 +1,64 @@
+"""Mesh construction + sharding rule tests."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tensorflow_distributed_tpu.config import MeshConfig
+from tensorflow_distributed_tpu.parallel import mesh as meshlib
+from tensorflow_distributed_tpu.parallel.sharding import (
+    batch_sharding, replicated, shard_batch)
+
+
+def test_make_mesh_all_data(devices8):
+    m = meshlib.make_mesh(MeshConfig(data=-1), devices8)
+    assert m.shape == {"data": 8, "seq": 1, "model": 1}
+
+
+def test_make_mesh_2d(devices8):
+    m = meshlib.make_mesh(MeshConfig(data=4, model=2), devices8)
+    assert m.shape == {"data": 4, "seq": 1, "model": 2}
+
+
+def test_make_mesh_seq(devices8):
+    m = meshlib.make_mesh(MeshConfig(data=2, seq=4), devices8)
+    assert m.shape == {"data": 2, "seq": 4, "model": 1}
+
+
+def test_make_mesh_rejects_indivisible(devices8):
+    with pytest.raises(ValueError):
+        meshlib.make_mesh(MeshConfig(data=3, model=3), devices8)
+
+
+def test_single_device_mesh_is_same_code_path(devices8):
+    m = meshlib.single_device_mesh(devices8[0])
+    assert m.shape == {"data": 1, "seq": 1, "model": 1}
+
+
+def test_batch_sharding_splits_leading_axis(mesh8):
+    x = np.arange(8 * 4, dtype=np.float32).reshape(8, 4)
+    arr = jax.device_put(x, batch_sharding(mesh8, 2))
+    assert arr.sharding.spec == P("data", None)
+    # Each device holds exactly one row.
+    assert arr.addressable_shards[0].data.shape == (1, 4)
+    np.testing.assert_array_equal(np.asarray(arr), x)
+
+
+def test_shard_batch_pytree(mesh8):
+    imgs = np.zeros((16, 28, 28, 1), np.float32)
+    labels = np.zeros((16,), np.int32)
+    simgs, slabels = shard_batch(mesh8, (imgs, labels))
+    assert simgs.shape == (16, 28, 28, 1)
+    assert simgs.addressable_shards[0].data.shape == (2, 28, 28, 1)
+    assert slabels.addressable_shards[0].data.shape == (2,)
+
+
+def test_replicated_places_full_copy_everywhere(mesh8):
+    x = np.arange(6, dtype=np.float32)
+    arr = jax.device_put(x, replicated(mesh8))
+    assert all(s.data.shape == (6,) for s in arr.addressable_shards)
+
+
+def test_is_chief_single_host():
+    assert meshlib.is_chief()
