@@ -1,0 +1,44 @@
+"""Single CLI entrypoint — ``python -m tensorflow_distributed_tpu.cli``.
+
+Replaces all five reference entrypoints (mnist_python_m.py / _w1 / _w2 /
+mnist_single.py / the notebook) and their ``tf.app.run`` dispatch
+(mnist_python_m.py:323-324). Role selection by editing per-file flag
+defaults is gone: every process runs this same module; mesh shape and
+env-driven bootstrap decide the topology.
+
+Examples:
+    # single device (the mnist_single.py path):
+    python -m tensorflow_distributed_tpu.cli --train-steps 200
+
+    # 8-way data parallel on one host:
+    python -m tensorflow_distributed_tpu.cli --mesh.data 8
+
+    # reference-faithful hyperparameters (for apples-to-apples runs):
+    python -m tensorflow_distributed_tpu.cli --init-scheme reference \
+        --learning-rate 0.01 --log-every 1
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Optional, Sequence
+
+from tensorflow_distributed_tpu.config import parse_args
+from tensorflow_distributed_tpu.parallel.mesh import is_chief
+from tensorflow_distributed_tpu.train.loop import train
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    cfg = parse_args(argv)
+    result = train(cfg)
+    if is_chief():
+        # Emit the reference's hand-maintained `performance` table
+        # automatically (performance:1-6).
+        table = result.logger.performance_table(cfg.learning_rate)
+        if table.count("\n"):
+            print(table)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

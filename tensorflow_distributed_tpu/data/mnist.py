@@ -225,8 +225,17 @@ class ShardedBatcher:
             idx = perm[lo:lo + self.local_batch]
             yield self.ds.images[idx], self.ds.labels[idx]
 
-    def forever(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
-        e = 0
+    def forever(self, start_step: int = 0
+                ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        """Infinite batch stream. ``start_step`` fast-forwards to the
+        position an uninterrupted run would be at after that many global
+        steps — so a checkpoint-resumed run continues the exact sample
+        stream instead of replaying from epoch 0."""
+        e, skip = divmod(start_step, self.steps_per_epoch)
         while True:
-            yield from self.epoch(e)
+            for i, batch in enumerate(self.epoch(e)):
+                if i < skip:
+                    continue
+                yield batch
+            skip = 0
             e += 1

@@ -56,12 +56,36 @@ def create_train_state(model: nn.Module, tx: optax.GradientTransformation,
         v = model.init(key, sample_input, train=False)
         return nn.meta.unbox(v["params"])
 
+    # Optimizer-state shardings: slots that mirror a param tensor (Adam
+    # m/v, momentum) get that param's sharding; scalars (step counts)
+    # are replicated. Left to jit's choosing they end up committed to
+    # device 0, which breaks mesh-wide reuse after checkpoint restore.
+    # Matching is by key path: optax slot trees embed copies of the
+    # param tree, so an opt leaf at (...,'0','mu','conv1','kernel')
+    # matches the param path ('conv1','kernel') as a suffix. (Shape-
+    # keyed matching would collide for same-shape params partitioned
+    # differently, e.g. TP in- vs out-projections.)
+    abstract_params = nn.meta.unbox(abstract["params"])
+    param_path_to_sharding = {
+        tuple(str(getattr(k, "key", getattr(k, "idx", k))) for k in path): sd
+        for path, sd in jax.tree_util.tree_flatten_with_path(shardings)[0]}
+
+    def opt_leaf_sharding(path, leaf):
+        keys = tuple(str(getattr(k, "key", getattr(k, "idx", k)))
+                     for k in path)
+        for i in range(len(keys)):
+            if keys[i:] in param_path_to_sharding:
+                return param_path_to_sharding[keys[i:]]
+        return replicated(mesh)
+
+    abstract_opt = jax.eval_shape(tx.init, abstract_params)
+    opt_shardings = jax.tree_util.tree_map_with_path(
+        opt_leaf_sharding, abstract_opt)
+
     with mesh:
         params = jax.jit(init_params, out_shardings=shardings)(
             prng.init_key(seed))
-        # Adam's m/v mirror the params elementwise, so jit propagates the
-        # param shardings into the optimizer state.
-        opt_state = jax.jit(tx.init)(params)
+        opt_state = jax.jit(tx.init, out_shardings=opt_shardings)(params)
         step = jax.device_put(jax.numpy.zeros((), jax.numpy.int32),
                               replicated(mesh))
     return TrainState(step=step, params=params, opt_state=opt_state,

@@ -46,15 +46,17 @@ class MetricLogger:
             print(json.dumps(payload), file=self.stream, flush=True)
 
     def performance_table(self, learning_rate: float) -> str:
-        """Render eval records in the reference's `performance` file format:
-        ``Steps, Time, Accuracy, Learning rate`` (performance:1-6)."""
+        """Render EVAL records (val_accuracy rows only — per-step training
+        accuracies don't belong in it) in the reference's `performance`
+        file format: ``Steps, Time, Accuracy, Learning rate``
+        (performance:1-6)."""
         lines = ["Steps,        Time,      Accuracy,  Learning rate"]
         for rec in self.records:
-            if "accuracy" not in rec.metrics:
+            if "val_accuracy" not in rec.metrics:
                 continue
             lines.append(
                 f"{rec.step},        {rec.wall_time:.0f} seconds,  "
-                f"{100.0 * rec.metrics['accuracy']:.2f},      {learning_rate}")
+                f"{100.0 * rec.metrics['val_accuracy']:.2f},      {learning_rate}")
         return "\n".join(lines)
 
 

@@ -1,0 +1,104 @@
+"""Checkpoint/resume tests (SURVEY.md N7 replacement — including the
+cross-run resume the reference structurally could not do)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tensorflow_distributed_tpu.models.cnn import MnistCNN
+from tensorflow_distributed_tpu.parallel.sharding import shard_batch
+from tensorflow_distributed_tpu.train import checkpoint as ckpt
+from tensorflow_distributed_tpu.train.state import create_train_state
+from tensorflow_distributed_tpu.train.step import make_train_step
+
+
+def _state(mesh):
+    model = MnistCNN(dropout_rate=0.0, compute_dtype=jnp.float32)
+    return create_train_state(model, optax.adam(1e-3),
+                              jnp.zeros((2, 28, 28, 1)), mesh, seed=0)
+
+
+def _batch(n=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.normal(size=(n, 28, 28, 1)).astype(np.float32),
+            rng.integers(0, 10, size=(n,)).astype(np.int32))
+
+
+def test_roundtrip_bitexact(tmp_path, mesh8):
+    state = _state(mesh8)
+    step = make_train_step(mesh8, donate=False)
+    state, _ = step(state, shard_batch(mesh8, _batch()))
+    path = ckpt.save(str(tmp_path), state)
+    assert os.path.isdir(path)
+
+    template = _state(mesh8)  # fresh init, different values
+    restored = ckpt.restore(str(tmp_path), template)
+    assert int(jax.device_get(restored.step)) == 1
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)),
+        jax.device_get(state.params), jax.device_get(restored.params))
+    # Optimizer slots (Adam m/v — the reference's ps-resident slots,
+    # SURVEY.md N12) restore too.
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)),
+        jax.device_get(state.opt_state), jax.device_get(restored.opt_state))
+
+
+def test_resume_continues_identically(tmp_path, mesh8):
+    """train 4 steps == train 2, checkpoint, restore, train 2 more."""
+    step = make_train_step(mesh8, donate=False)
+    batches = [shard_batch(mesh8, _batch(seed=i)) for i in range(4)]
+
+    s_full = _state(mesh8)
+    for b in batches:
+        s_full, _ = step(s_full, b)
+
+    s_a = _state(mesh8)
+    for b in batches[:2]:
+        s_a, _ = step(s_a, b)
+    ckpt.save(str(tmp_path), s_a)
+    s_b = ckpt.restore(str(tmp_path), _state(mesh8))
+    for b in batches[2:]:
+        s_b, _ = step(s_b, b)
+
+    assert int(jax.device_get(s_b.step)) == 4
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)),
+        jax.device_get(s_full.params), jax.device_get(s_b.params))
+
+
+def test_restore_across_mesh_shapes(tmp_path, mesh8, mesh1):
+    """Save on 8 devices, restore on 1 — the mesh-agnostic restore the
+    Supervisor never had."""
+    s8 = _state(mesh8)
+    step8 = make_train_step(mesh8, donate=False)
+    s8, _ = step8(s8, shard_batch(mesh8, _batch()))
+    ckpt.save(str(tmp_path), s8)
+
+    s1 = ckpt.restore(str(tmp_path), _state(mesh1))
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)),
+        jax.device_get(s8.params), jax.device_get(s1.params))
+
+
+def test_keep_prunes_old(tmp_path, mesh8):
+    state = _state(mesh8)
+    step = make_train_step(mesh8, donate=False)
+    b = shard_batch(mesh8, _batch())
+    for _ in range(5):
+        state, _ = step(state, b)
+        ckpt.save(str(tmp_path), state, keep=2)
+    assert ckpt.available_steps(str(tmp_path)) == [4, 5]
+
+
+def test_restore_missing_raises(tmp_path, mesh8):
+    with pytest.raises(FileNotFoundError):
+        ckpt.restore(str(tmp_path / "nope"), _state(mesh8))

@@ -1,0 +1,91 @@
+"""End-to-end loop + CLI tests: the accuracy-bar integration test the
+reference performed by hand (SURVEY.md §4 "accuracy-as-test")."""
+
+import jax
+import pytest
+
+from tensorflow_distributed_tpu.config import MeshConfig, TrainConfig
+from tensorflow_distributed_tpu.train.loop import train
+
+
+def _cfg(**kw):
+    base = dict(dataset="synthetic", batch_size=128, train_steps=40,
+                eval_every=0, log_every=0, eval_batch_size=128,
+                compute_dtype="float32", mesh=MeshConfig(data=8))
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def test_train_reaches_accuracy_bar():
+    """The integration bar: the loop must reach high accuracy on the
+    synthetic digits within a small budget (the analog of the
+    reference's 95.75%-at-120-steps ceiling, performance:6 — which our
+    'improved' init scheme beats by design)."""
+    result = train(_cfg(train_steps=60))
+    assert result.final_metrics["accuracy"] >= 0.97
+    assert int(jax.device_get(result.state.step)) == 60
+    assert result.images_per_sec > 0
+
+
+def test_train_resume_roundtrip(tmp_path):
+    cfg = _cfg(train_steps=10, checkpoint_dir=str(tmp_path),
+               checkpoint_every=5)
+    r1 = train(cfg)
+    cfg2 = _cfg(train_steps=14, checkpoint_dir=str(tmp_path),
+                checkpoint_every=5, resume=True)
+    r2 = train(cfg2)
+    assert int(jax.device_get(r2.state.step)) == 14
+
+
+def test_performance_table_emitted():
+    result = train(_cfg(train_steps=20, eval_every=10))
+    table = result.logger.performance_table(1e-3)
+    lines = table.splitlines()
+    assert lines[0].startswith("Steps,")
+    assert len(lines) >= 3  # header + 2 eval rows
+
+
+def test_cli_main_runs():
+    from tensorflow_distributed_tpu.cli import main
+    rc = main(["--dataset", "synthetic", "--train-steps", "5",
+               "--batch-size", "64", "--eval-every", "0",
+               "--log-every", "0", "--eval-batch-size", "64",
+               "--compute-dtype", "float32"])
+    assert rc == 0
+
+
+def _load_graft_entry():
+    import importlib.util
+    import os
+    path = os.path.join(os.path.dirname(__file__), "..", "__graft_entry__.py")
+    spec = importlib.util.spec_from_file_location("__graft_entry__", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_graft_entry_single():
+    mod = _load_graft_entry()
+    fn, args = mod.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape == (8, 10)
+
+
+def test_graft_entry_multichip():
+    _load_graft_entry().dryrun_multichip(8)
+
+
+def test_resume_continues_sample_stream():
+    """A resumed run must consume the same batches an uninterrupted run
+    would have (data-stream fast-forward on resume)."""
+    from tensorflow_distributed_tpu.data.mnist import Dataset, ShardedBatcher
+    import numpy as np
+    ds = Dataset(np.zeros((64, 1, 1, 1), np.float32),
+                 np.arange(64, dtype=np.int32))
+    b = ShardedBatcher(ds, 16, seed=1)
+    stream = b.forever()
+    full = [next(stream)[1] for _ in range(10)]
+    resumed = b.forever(start_step=6)
+    tail = [next(resumed)[1] for _ in range(4)]
+    for a, c in zip(full[6:], tail):
+        np.testing.assert_array_equal(a, c)
