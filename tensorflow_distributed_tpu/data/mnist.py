@@ -27,9 +27,11 @@ import dataclasses
 import gzip
 import os
 import struct
-from typing import Iterator, Optional, Tuple
+from typing import Tuple
 
 import numpy as np
+
+from tensorflow_distributed_tpu.data.batcher import Batcher
 
 # idx magic numbers: 0x801 = unsigned-byte 1-D (labels),
 # 0x803 = unsigned-byte 3-D (images).
@@ -186,56 +188,17 @@ def load_dataset(dataset: str, data_dir: str, seed: int = 0
     raise ValueError(f"unknown dataset {dataset!r}")
 
 
-class ShardedBatcher:
-    """Epoch-shuffled, process-disjoint global batches.
-
-    Each global batch of size B is a contiguous slice of a seeded
-    per-epoch permutation shared by all processes (same seed ->
-    identical permutation everywhere, no coordination traffic). Process
-    p materializes rows [p*B/P, (p+1)*B/P) — its local shard — which
-    ``parallel.shard_batch`` then places on local devices. A 1-process
-    run therefore consumes the identical sample stream, enabling exact
-    N-vs-1 equivalence tests (SURVEY.md §7 "sync-semantics parity").
-    """
+class ShardedBatcher(Batcher):
+    """(images, labels) batches over a Dataset — the generic
+    data.batcher.Batcher with a vision gather. The trailing partial
+    batch of each epoch is always dropped: SPMD steps need static
+    shapes (XLA recompiles per shape)."""
 
     def __init__(self, ds: Dataset, global_batch: int, seed: int = 0,
                  num_processes: int = 1, process_index: int = 0):
-        # The trailing partial batch of each epoch is always dropped:
-        # SPMD steps need static shapes (XLA recompiles per shape).
-        if global_batch % max(num_processes, 1) != 0:
-            raise ValueError(
-                f"global batch {global_batch} not divisible by "
-                f"{num_processes} processes")
-        if len(ds) < global_batch:
-            raise ValueError("dataset smaller than one global batch")
         self.ds = ds
-        self.global_batch = global_batch
-        self.seed = seed
-        self.num_processes = num_processes
-        self.process_index = process_index
-        self.local_batch = global_batch // max(num_processes, 1)
-        self.steps_per_epoch = len(ds) // global_batch
-
-    def epoch(self, epoch_idx: int) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
-        rng = np.random.default_rng((self.seed, epoch_idx))
-        perm = rng.permutation(len(self.ds))
-        for s in range(self.steps_per_epoch):
-            gstart = s * self.global_batch
-            lo = gstart + self.process_index * self.local_batch
-            idx = perm[lo:lo + self.local_batch]
-            yield self.ds.images[idx], self.ds.labels[idx]
-
-    def forever(self, start_step: int = 0
-                ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
-        """Infinite batch stream. ``start_step`` fast-forwards to the
-        position an uninterrupted run would be at after that many global
-        steps — so a checkpoint-resumed run continues the exact sample
-        stream instead of replaying from epoch 0."""
-        e, skip = divmod(start_step, self.steps_per_epoch)
-        while True:
-            for i, batch in enumerate(self.epoch(e)):
-                if i < skip:
-                    continue
-                yield batch
-            skip = 0
-            e += 1
+        super().__init__(
+            n_items=len(ds), global_batch=global_batch,
+            gather=lambda idx: (ds.images[idx], ds.labels[idx]),
+            seed=seed, num_processes=num_processes,
+            process_index=process_index)

@@ -6,17 +6,41 @@ it exists exactly once). ResNet and the transformer families extend the
 same train-step machinery to the BASELINE.json scale-out configs.
 """
 
+from typing import Optional
+
+import jax.numpy as jnp
+
 from tensorflow_distributed_tpu.models.cnn import MnistCNN  # noqa: F401
 
+MODEL_NAMES = ("mnist_cnn", "resnet20", "resnet50", "bert_mlm")
 
-def build_model(name: str, **kw):
+
+def build_model(name: str, mesh=None, dropout_rate: Optional[float] = None,
+                init_scheme: str = "improved",
+                compute_dtype=jnp.bfloat16, **overrides):
+    """Explicit per-family dispatch (no kwargs guessing): each family
+    takes what it understands.
+
+    ``init_scheme`` is the CNN's reference-vs-improved switch
+    (mnist_python_m.py:185-196); the other families have no reference
+    counterpart to be faithful to and ignore it. ``mesh`` matters only
+    to the transformer (ring attention needs it); ``overrides`` are
+    TransformerConfig fields.
+    """
     from tensorflow_distributed_tpu.models import cnn, resnet, transformer
-    registry = {
-        "mnist_cnn": cnn.MnistCNN,
-        "resnet20": resnet.resnet20,
-        "resnet50": resnet.resnet50,
-        "bert_mlm": transformer.bert_base_mlm,
-    }
-    if name not in registry:
-        raise ValueError(f"unknown model {name!r}; have {sorted(registry)}")
-    return registry[name](**kw)
+
+    if name == "mnist_cnn":
+        kw = dict(init_scheme=init_scheme, compute_dtype=compute_dtype)
+        if dropout_rate is not None:
+            kw["dropout_rate"] = dropout_rate
+        return cnn.MnistCNN(**kw)
+    if name == "resnet20":
+        return resnet.resnet20(compute_dtype=compute_dtype, **overrides)
+    if name == "resnet50":
+        return resnet.resnet50(compute_dtype=compute_dtype, **overrides)
+    if name == "bert_mlm":
+        if dropout_rate is not None:
+            overrides.setdefault("dropout_rate", dropout_rate)
+        overrides.setdefault("compute_dtype", compute_dtype)
+        return transformer.bert_base_mlm(mesh=mesh, **overrides)
+    raise ValueError(f"unknown model {name!r}; have {sorted(MODEL_NAMES)}")

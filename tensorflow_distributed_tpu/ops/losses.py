@@ -34,3 +34,25 @@ def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
     (mnist_python_m.py:206-207)."""
     pred = jnp.argmax(logits, axis=-1)
     return jnp.mean((pred == labels).astype(jnp.float32))
+
+
+def masked_softmax_cross_entropy(logits: jax.Array, targets: jax.Array,
+                                 mask: jax.Array) -> jax.Array:
+    """Mean cross-entropy over masked positions only (the MLM objective;
+    no reference counterpart — the reference has no sequence models).
+
+    logits: [B, L, V]; targets: [B, L] ints; mask: [B, L] {0,1}.
+    """
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(
+        logits, targets[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    per_tok = (logz - gold) * mask
+    return jnp.sum(per_tok) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def masked_accuracy(logits: jax.Array, targets: jax.Array,
+                    mask: jax.Array) -> jax.Array:
+    pred = jnp.argmax(logits, axis=-1)
+    hit = (pred == targets).astype(jnp.float32) * mask
+    return jnp.sum(hit) / jnp.maximum(jnp.sum(mask), 1.0)

@@ -1,0 +1,110 @@
+"""Ring attention: sequence-parallel exact attention over the mesh.
+
+The reference has no sequence models at all (SURVEY.md §5 "long-context:
+absent" — its inputs are fixed 784-px images), but long-context is
+first-class in this framework: attention over sequences sharded across
+the "seq" mesh axis, computed exactly (not approximated) by rotating
+key/value blocks around the ring with ``lax.ppermute`` while queries
+stay resident.
+
+Method (blockwise streaming softmax, flash-attention style):
+each device holds Q,K,V for its L/S-token block. For S ring steps it
+computes partial attention of its Q block against the currently-held
+K,V block, folds the result into a running (max, sum, weighted-value)
+accumulator in f32, and passes the K,V block to the next device on the
+ring. After S steps every Q block has attended to every K,V block —
+total comms = each K,V block traverses the ring once over ICI, overlap-
+friendly, and no device ever materializes the full [L, L] score matrix
+or the full K,V.
+
+Per-shard compute stays MXU-shaped: the inner op is a batched matmul
+[B*H, L/S, D] x [B*H, D, L/S]. bf16 matmuls, f32 softmax statistics.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tensorflow_distributed_tpu.parallel.mesh import AXIS_DATA, AXIS_MODEL, AXIS_SEQ
+
+
+def _block_attend(q, k, v, bias):
+    """One Q-block vs one K,V-block partial attention.
+
+    q: [B, Lq, H, D]; k, v: [B, Lk, H, D]; bias: [B, Lq, Lk] or None.
+    Returns (scores_max [B,H,Lq], exp-sum [B,H,Lq], weighted-V
+    [B,Lq,H,D]) — the streaming-softmax partials, all f32.
+    """
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32)
+    s = s * (1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32)))
+    if bias is not None:
+        s = s + bias[:, None, :, :]
+    m = jnp.max(s, axis=-1)                      # [B,H,Lq]
+    p = jnp.exp(s - m[..., None])                # [B,H,Lq,Lk]
+    l = jnp.sum(p, axis=-1)                      # [B,H,Lq]
+    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                   preferred_element_type=jnp.float32)
+    return m, l, o
+
+
+def _merge(m1, l1, o1, m2, l2, o2):
+    """Fold two streaming-softmax partials into one."""
+    m = jnp.maximum(m1, m2)
+    a1 = jnp.exp(m1 - m)
+    a2 = jnp.exp(m2 - m)
+    l = l1 * a1 + l2 * a2
+    o = (o1 * a1.transpose(0, 2, 1)[..., None]
+         + o2 * a2.transpose(0, 2, 1)[..., None])
+    return m, l, o
+
+
+def full_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   mask: Optional[jax.Array] = None) -> jax.Array:
+    """Plain exact attention (the mesh.seq == 1 path and the test
+    oracle). q,k,v: [B, L, H, D]; mask: [B, L, L] additive or None."""
+    m, l, o = _block_attend(q, k, v, mask)
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   mesh: Mesh, mask: Optional[jax.Array] = None) -> jax.Array:
+    """Exact attention with the sequence axis sharded over mesh "seq".
+
+    q,k,v are GLOBAL [B, L, H, D] arrays (call under jit; the seq axis
+    carries the "seq" sharding). Non-causal (bidirectional — the BERT
+    MLM case). ``mask`` is not yet supported with S > 1 ring steps.
+
+    Degenerate 1-shard ring: identical to full_attention.
+    """
+    seq_size = mesh.shape[AXIS_SEQ]
+    if seq_size == 1:
+        return full_attention(q, k, v, mask)
+    if mask is not None:
+        raise NotImplementedError("masked ring attention lands with the "
+                                  "causal-LM family")
+
+    spec = P(AXIS_DATA, AXIS_SEQ, AXIS_MODEL, None)
+
+    def per_shard(q_blk, k_blk, v_blk):
+        # q_blk etc: [B/dp, L/S, H/tp, D] local blocks.
+        m, l, o = _block_attend(q_blk, k_blk, v_blk, None)
+        k_rot, v_rot = k_blk, v_blk
+        perm = [(i, (i + 1) % seq_size) for i in range(seq_size)]
+        for _ in range(seq_size - 1):
+            k_rot = jax.lax.ppermute(k_rot, AXIS_SEQ, perm)
+            v_rot = jax.lax.ppermute(v_rot, AXIS_SEQ, perm)
+            m2, l2, o2 = _block_attend(q_blk, k_rot, v_rot, None)
+            m, l, o = _merge(m, l, o, m2, l2, o2)
+        out = o / l.transpose(0, 2, 1)[..., None]
+        return out.astype(q_blk.dtype)
+
+    return jax.shard_map(per_shard, mesh=mesh,
+                         in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)

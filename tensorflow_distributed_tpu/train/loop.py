@@ -2,22 +2,22 @@
 
 Covers mnist_python_m.py:285-320 (train loop + validation loop) and
 mnist_single.py:104-134 (single-device loop + timing prints) with the
-same code on any mesh shape. The loop body is thin by design — the only
-per-step host work is feeding the next prefetched batch, exactly the
-collapse SURVEY.md §3.5 prescribes.
+same code on any mesh shape and any task family. The loop body is thin
+by design — the only per-step host work is feeding the next prefetched
+batch, exactly the collapse SURVEY.md §3.5 prescribes.
 """
 
 from __future__ import annotations
 
+import collections
 import dataclasses
-from typing import Any, Dict, Optional, Tuple
+from typing import Dict, Optional
 
 import jax
 import numpy as np
 
 from tensorflow_distributed_tpu.config import TrainConfig
-from tensorflow_distributed_tpu.data import (
-    Dataset, ShardedBatcher, load_dataset, prefetch_to_mesh)
+from tensorflow_distributed_tpu.data import prefetch_to_mesh
 from tensorflow_distributed_tpu.models import build_model
 from tensorflow_distributed_tpu.parallel import make_mesh
 from tensorflow_distributed_tpu.parallel.mesh import bootstrap, is_chief
@@ -27,6 +27,7 @@ from tensorflow_distributed_tpu.train.optim import make_optimizer
 from tensorflow_distributed_tpu.train.state import (
     TrainState, create_train_state, param_count)
 from tensorflow_distributed_tpu.train.step import make_eval_step, make_train_step
+from tensorflow_distributed_tpu.train.tasks import Task, make_task
 from tensorflow_distributed_tpu.utils.logging import MetricLogger, Timer
 
 
@@ -41,23 +42,22 @@ class TrainResult:
     logger: MetricLogger
 
 
-def evaluate(state: TrainState, eval_fn, ds: Dataset, mesh, batch: int
+def evaluate(state: TrainState, eval_fn, task: Task, mesh, batch: int
              ) -> Dict[str, float]:
     """Full-split eval in fixed-size SPMD batches (the reference's 5x1000
     validation loop, mnist_python_m.py:309-320, as jitted calls)."""
     data_size = mesh.shape["data"]
     # Clamp to the split size (rounded to a shardable multiple) so a
     # small validation split with a large eval_batch still evaluates.
-    batch = min(batch, (len(ds) // data_size) * data_size)
+    batch = min(batch, (task.eval_size // data_size) * data_size)
     if batch == 0:
         raise ValueError(
-            f"validation split ({len(ds)} rows) smaller than the mesh "
-            f"data axis ({data_size})")
-    n = (len(ds) // batch) * batch
+            f"validation split ({task.eval_size} rows) smaller than the "
+            f"mesh data axis ({data_size})")
     totals: Dict[str, float] = {}
     count = 0
-    for lo in range(0, n, batch):
-        b = shard_batch(mesh, (ds.images[lo:lo + batch], ds.labels[lo:lo + batch]))
+    for host_batch in task.eval_batches(batch):
+        b = shard_batch(mesh, host_batch, seq_axis=task.seq_axis)
         m = jax.device_get(eval_fn(state, b))
         for k, v in m.items():
             totals[k] = totals.get(k, 0.0) + float(v) * batch
@@ -71,19 +71,15 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
     bootstrap()
     logger = logger or MetricLogger(enabled=is_chief())
     mesh = make_mesh(cfg.mesh)
+    task = make_task(cfg, mesh)
 
-    train_ds, val_ds, _ = load_dataset(cfg.dataset, cfg.data_dir, cfg.seed)
-    batcher = ShardedBatcher(
-        train_ds, cfg.batch_size, cfg.shuffle_seed,
-        num_processes=jax.process_count(), process_index=jax.process_index())
-
-    model = build_model(cfg.model, dropout_rate=cfg.dropout_rate,
-                        init_scheme=cfg.init_scheme,
-                        compute_dtype=jax.numpy.bfloat16
-                        if cfg.compute_dtype == "bfloat16" else jax.numpy.float32)
+    model = build_model(
+        cfg.model, mesh=mesh, dropout_rate=cfg.dropout_rate,
+        init_scheme=cfg.init_scheme,
+        compute_dtype=jax.numpy.bfloat16
+        if cfg.compute_dtype == "bfloat16" else jax.numpy.float32)
     tx = make_optimizer(cfg)
-    sample = np.zeros((2,) + train_ds.images.shape[1:], np.float32)
-    state = create_train_state(model, tx, sample, mesh, cfg.seed)
+    state = create_train_state(model, tx, task.sample_input, mesh, cfg.seed)
 
     start_step = 0
     if cfg.resume and ckpt.latest_step(cfg.checkpoint_dir) is not None:
@@ -91,21 +87,39 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
         start_step = int(jax.device_get(state.step))
         logger.log_json({"event": "resumed", "step": start_step})
 
-    step_fn = make_train_step(mesh, cfg.seed)
-    eval_fn = make_eval_step(mesh)
+    step_fn = make_train_step(mesh, cfg.seed, loss=task.loss,
+                              batch_shardings=task.batch_shardings)
+    eval_fn = make_eval_step(mesh, loss=task.loss,
+                             batch_shardings=task.batch_shardings)
     logger.log_json({
-        "event": "start", "model": cfg.model,
+        "event": "start", "model": cfg.model, "task": task.name,
         "params": param_count(state.params), "mesh": dict(mesh.shape),
         "global_batch": cfg.batch_size, "start_step": start_step,
     })
 
-    it = prefetch_to_mesh(batcher.forever(start_step=start_step), mesh)
-    # Warm-up compile outside the timed span (the reference's timings
-    # included graph setup; ours separate compile from steady state).
-    metrics = {}
+    it = prefetch_to_mesh(task.train_stream(start_step), mesh,
+                          seq_axis=task.seq_axis)
+
+    def cadence(step_now: int, state: TrainState, metrics) -> None:
+        """Periodic log/eval/checkpoint — applied to EVERY step
+        including the warm-up compile step."""
+        if cfg.log_every and step_now % cfg.log_every == 0:
+            logger.log(step_now, **jax.device_get(metrics))
+        if cfg.eval_every and step_now % cfg.eval_every == 0:
+            em = evaluate(state, eval_fn, task, mesh, cfg.eval_batch_size)
+            logger.log(step_now, **{f"val_{k}": v for k, v in em.items()})
+        if (cfg.checkpoint_dir and cfg.checkpoint_every
+                and step_now % cfg.checkpoint_every == 0):
+            ckpt.save(cfg.checkpoint_dir, state, cfg.keep_checkpoints)
+
+    # Warm-up compile outside the timed steady-state span (the
+    # reference's timings conflated graph setup with steps; ours don't).
+    metrics = None
     with Timer() as compile_t:
         if cfg.train_steps > start_step:
             state, metrics = step_fn(state, next(it))
+            jax.block_until_ready(metrics)
+            cadence(start_step + 1, state, metrics)
     steps_done = 1 if cfg.train_steps > start_step else 0
 
     # Bounded async dispatch: keep at most 2 steps in flight. Unbounded
@@ -113,8 +127,7 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
     # compete for the same worker threads (on oversubscribed hosts the
     # XLA:CPU rendezvous aborts after 40s); a 2-deep window preserves the
     # host/device overlap that hides dispatch latency.
-    import collections
-    inflight = collections.deque([metrics] if metrics else [])
+    inflight = collections.deque()
 
     with Timer() as train_t:
         for i in range(start_step + steps_done, cfg.train_steps):
@@ -122,19 +135,11 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
             inflight.append(metrics)
             if len(inflight) > 2:
                 jax.block_until_ready(inflight.popleft())
-            step_now = i + 1
-            if cfg.log_every and step_now % cfg.log_every == 0:
-                logger.log(step_now, **jax.device_get(metrics))
-            if cfg.eval_every and step_now % cfg.eval_every == 0:
-                em = evaluate(state, eval_fn, val_ds, mesh, cfg.eval_batch_size)
-                logger.log(step_now, **{f"val_{k}": v for k, v in em.items()})
-            if (cfg.checkpoint_dir and cfg.checkpoint_every
-                    and step_now % cfg.checkpoint_every == 0):
-                ckpt.save(cfg.checkpoint_dir, state, cfg.keep_checkpoints)
+            cadence(i + 1, state, metrics)
         jax.block_until_ready(state.params)
 
     with Timer() as eval_t:
-        final = evaluate(state, eval_fn, val_ds, mesh, cfg.eval_batch_size)
+        final = evaluate(state, eval_fn, task, mesh, cfg.eval_batch_size)
     if cfg.checkpoint_dir:
         ckpt.save(cfg.checkpoint_dir, state, cfg.keep_checkpoints)
 

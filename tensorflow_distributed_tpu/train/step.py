@@ -37,12 +37,18 @@ from tensorflow_distributed_tpu.parallel.sharding import batch_sharding, replica
 from tensorflow_distributed_tpu.train.state import TrainState
 from tensorflow_distributed_tpu.utils import prng
 
-Batch = Tuple[jax.Array, jax.Array]  # (images, labels)
+Batch = Any  # task-defined pytree; classification default: (images, labels)
 Metrics = Dict[str, jax.Array]
+# A LossFn maps (apply_fn, params, batch, dropout_key, train) ->
+# (scalar loss, metrics dict). Tasks (vision, masked-LM, ...) plug in
+# here; the step/sync machinery below is task-agnostic.
+LossFn = Callable
 
 
 def loss_fn(apply_fn: Callable, params: Any, batch: Batch,
             dropout_key: jax.Array, train: bool) -> Tuple[jax.Array, Metrics]:
+    """Default classification loss — the reference's task
+    (mnist_python_m.py:205-207)."""
     images, labels = batch
     logits = apply_fn({"params": params}, images, train=train,
                       rngs={"dropout": dropout_key} if train else {})
@@ -50,9 +56,15 @@ def loss_fn(apply_fn: Callable, params: Any, batch: Batch,
     return loss, {"loss": loss, "accuracy": accuracy(logits, labels)}
 
 
-def make_train_step(mesh: Mesh, seed: int = 0,
-                    donate: bool = True) -> Callable[[TrainState, Batch],
-                                                     Tuple[TrainState, Metrics]]:
+def default_batch_shardings(mesh: Mesh):
+    return (batch_sharding(mesh, 4), batch_sharding(mesh, 1))
+
+
+def make_train_step(mesh: Mesh, seed: int = 0, donate: bool = True,
+                    loss: LossFn = loss_fn,
+                    batch_shardings: Any = None
+                    ) -> Callable[[TrainState, Batch],
+                                  Tuple[TrainState, Metrics]]:
     """Build the jitted train step for a mesh.
 
     Gradient synchronization is implicit: params are replicated (or
@@ -62,12 +74,15 @@ def make_train_step(mesh: Mesh, seed: int = 0,
     ``parallel.collectives`` and is proven equivalent in tests.
     """
 
+    if batch_shardings is None:
+        batch_shardings = default_batch_shardings(mesh)
+
     def step(state: TrainState, batch: Batch) -> Tuple[TrainState, Metrics]:
         # Per-step dropout key derived on-device from the step counter —
         # no host round-trip, fully deterministic (utils.prng).
         dkey = prng.step_key(seed, state.step)
         grad_fn = jax.value_and_grad(
-            partial(loss_fn, state.apply_fn), has_aux=True)
+            partial(loss, state.apply_fn), has_aux=True)
         (_, metrics), grads = grad_fn(state.params, batch, dkey, True)
         updates, new_opt = state.tx.update(grads, state.opt_state, state.params)
         new_params = jax.tree_util.tree_map(
@@ -79,24 +94,28 @@ def make_train_step(mesh: Mesh, seed: int = 0,
     with mesh:
         return jax.jit(
             step,
-            in_shardings=(None, (batch_sharding(mesh, 4), batch_sharding(mesh, 1))),
+            in_shardings=(None, batch_shardings),
             donate_argnums=(0,) if donate else (),
         )
 
 
-def make_eval_step(mesh: Mesh) -> Callable[[TrainState, Batch], Metrics]:
-    """Jitted eval: loss + accuracy over a (sharded) eval batch — the
+def make_eval_step(mesh: Mesh, loss: LossFn = loss_fn,
+                   batch_shardings: Any = None
+                   ) -> Callable[[TrainState, Batch], Metrics]:
+    """Jitted eval: loss + metrics over a (sharded) eval batch — the
     reference's validation pass (mnist_python_m.py:309-320) as one SPMD
     call instead of 5 feed_dict sess.runs."""
+    if batch_shardings is None:
+        batch_shardings = default_batch_shardings(mesh)
 
     def step(state: TrainState, batch: Batch) -> Metrics:
-        _, metrics = loss_fn(state.apply_fn, state.params, batch,
-                             jax.random.key(0), False)
+        _, metrics = loss(state.apply_fn, state.params, batch,
+                          jax.random.key(0), False)
         return metrics
 
     with mesh:
         return jax.jit(
             step,
-            in_shardings=(None, (batch_sharding(mesh, 4), batch_sharding(mesh, 1))),
+            in_shardings=(None, batch_shardings),
             out_shardings=replicated(mesh),
         )

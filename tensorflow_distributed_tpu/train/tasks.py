@@ -1,0 +1,121 @@
+"""Task definitions: what varies between model families.
+
+The step/sync machinery (train.step) is task-agnostic; a Task bundles
+the loss, the batch shardings/layout, the data streams, and the sample
+input used for init. Vision is the reference's task (SURVEY.md §2a);
+MLM is the BASELINE.json stretch family.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Iterator, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tensorflow_distributed_tpu.config import TrainConfig
+from tensorflow_distributed_tpu.ops.losses import (
+    masked_accuracy, masked_softmax_cross_entropy)
+from tensorflow_distributed_tpu.parallel.mesh import AXIS_DATA, AXIS_SEQ
+from tensorflow_distributed_tpu.train import step as step_lib
+
+
+@dataclasses.dataclass
+class Task:
+    """Everything the loop needs beyond the jitted step machinery."""
+
+    name: str
+    loss: step_lib.LossFn
+    batch_shardings: Any
+    sample_input: np.ndarray          # for model.init
+    seq_axis: Optional[int]           # batch dim carrying "seq", if any
+    train_stream: Callable[[int], Iterator[Any]]  # start_step -> batches
+    eval_batches: Callable[[int], Iterator[Any]]  # batch_size -> batches
+    eval_size: int                    # rows in the eval split
+    steps_per_epoch: int
+
+
+# --- vision (the reference's task) --------------------------------------
+
+def vision_loss(apply_fn, params, batch, dropout_key, train):
+    return step_lib.loss_fn(apply_fn, params, batch, dropout_key, train)
+
+
+def _make_vision_task(cfg: TrainConfig, mesh: Mesh) -> Task:
+    from tensorflow_distributed_tpu.data import ShardedBatcher, load_dataset
+
+    train_ds, val_ds, _ = load_dataset(cfg.dataset, cfg.data_dir, cfg.seed)
+    batcher = ShardedBatcher(
+        train_ds, cfg.batch_size, cfg.shuffle_seed,
+        num_processes=jax.process_count(),
+        process_index=jax.process_index())
+
+    def eval_batches(batch: int) -> Iterator[Any]:
+        n = (len(val_ds) // batch) * batch
+        for lo in range(0, n, batch):
+            yield (val_ds.images[lo:lo + batch], val_ds.labels[lo:lo + batch])
+
+    return Task(
+        name="vision", loss=vision_loss,
+        batch_shardings=step_lib.default_batch_shardings(mesh),
+        sample_input=np.zeros((2,) + train_ds.images.shape[1:], np.float32),
+        seq_axis=None, train_stream=batcher.forever,
+        eval_batches=eval_batches, eval_size=len(val_ds),
+        steps_per_epoch=batcher.steps_per_epoch)
+
+
+# --- masked LM (BASELINE.json stretch family) ---------------------------
+
+def mlm_loss(apply_fn, params, batch, dropout_key, train):
+    """Masked-LM objective over a {tokens, targets, mask} batch."""
+    logits = apply_fn({"params": params}, batch["tokens"], train=train,
+                      rngs={"dropout": dropout_key} if train else {})
+    loss = masked_softmax_cross_entropy(logits, batch["targets"],
+                                        batch["mask"])
+    return loss, {
+        "loss": loss,
+        "accuracy": masked_accuracy(logits, batch["targets"], batch["mask"]),
+    }
+
+
+def mlm_batch_shardings(mesh: Mesh) -> Dict[str, NamedSharding]:
+    """Tokens shard batch over "data" and sequence over "seq" — the
+    long-context layout the ring attention consumes without resharding."""
+    s = NamedSharding(mesh, P(AXIS_DATA, AXIS_SEQ))
+    return {"tokens": s, "targets": s, "mask": s}
+
+
+def _make_mlm_task(cfg: TrainConfig, mesh: Mesh,
+                   seq_len: int = 128, vocab_size: int = 64) -> Task:
+    from tensorflow_distributed_tpu.data.lm import LmBatcher, synthetic_mlm
+
+    n = max(16 * cfg.batch_size, 4096)
+    train_ds = synthetic_mlm(n=n, seq_len=seq_len, vocab_size=vocab_size,
+                             seed=cfg.seed)
+    val_ds = synthetic_mlm(n=max(4 * cfg.eval_batch_size, 512),
+                           seq_len=seq_len, vocab_size=vocab_size,
+                           seed=cfg.seed + 1)
+    batcher = LmBatcher(train_ds, cfg.batch_size, cfg.shuffle_seed,
+                        num_processes=jax.process_count(),
+                        process_index=jax.process_index())
+
+    def eval_batches(batch: int) -> Iterator[Any]:
+        nrows = (len(val_ds) // batch) * batch
+        for lo in range(0, nrows, batch):
+            yield val_ds.batch(np.arange(lo, lo + batch))
+
+    return Task(
+        name="mlm", loss=mlm_loss, batch_shardings=mlm_batch_shardings(mesh),
+        sample_input=np.zeros((2, seq_len), np.int32), seq_axis=1,
+        train_stream=batcher.forever, eval_batches=eval_batches,
+        eval_size=len(val_ds), steps_per_epoch=batcher.steps_per_epoch)
+
+
+def make_task(cfg: TrainConfig, mesh: Mesh) -> Task:
+    """Model family -> task. bert_mlm trains masked-LM; everything else
+    is image classification."""
+    if cfg.model == "bert_mlm":
+        return _make_mlm_task(cfg, mesh)
+    return _make_vision_task(cfg, mesh)

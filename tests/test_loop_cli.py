@@ -75,6 +75,18 @@ def test_graft_entry_multichip():
     _load_graft_entry().dryrun_multichip(8)
 
 
+def test_first_step_hits_log_and_checkpoint_cadence(tmp_path):
+    """The warm-up compile step is still step 1: with log_every=1 and
+    checkpoint_every=1 it must be logged and checkpointed."""
+    cfg = _cfg(train_steps=3, log_every=1, checkpoint_dir=str(tmp_path),
+               checkpoint_every=1)
+    result = train(cfg)
+    logged_steps = [r.step for r in result.logger.records]
+    assert 1 in logged_steps
+    from tensorflow_distributed_tpu.train import checkpoint as ckpt
+    assert 1 in ckpt.available_steps(str(tmp_path))
+
+
 def test_resume_continues_sample_stream():
     """A resumed run must consume the same batches an uninterrupted run
     would have (data-stream fast-forward on resume)."""

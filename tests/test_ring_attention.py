@@ -1,0 +1,72 @@
+"""Ring attention == dense attention, on real sharded meshes.
+
+The correctness bar for the sequence-parallel path: rotating K,V blocks
+around the "seq" ring with streaming-softmax merging must reproduce
+exact dense attention to float tolerance.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorflow_distributed_tpu.config import MeshConfig
+from tensorflow_distributed_tpu.parallel.mesh import make_mesh
+from tensorflow_distributed_tpu.parallel.ring_attention import (
+    full_attention, ring_attention)
+
+
+def _qkv(b=2, l=32, h=4, d=8, seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(rng.normal(size=(b, l, h, d)).astype(np.float32))
+    return mk(), mk(), mk()
+
+
+def test_full_attention_matches_naive_softmax():
+    q, k, v = _qkv(b=1, l=8, h=2, d=4)
+    out = full_attention(q, k, v)
+    # Naive oracle.
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(4.0)
+    w = jax.nn.softmax(s, axis=-1)
+    oracle = jnp.einsum("bhqk,bkhd->bqhd", w, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(oracle),
+                               rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("mesh_cfg", [
+    MeshConfig(data=2, seq=4, model=1),
+    MeshConfig(data=1, seq=8, model=1),
+    MeshConfig(data=2, seq=2, model=2),
+])
+def test_ring_equals_dense(devices8, mesh_cfg):
+    mesh = make_mesh(mesh_cfg, devices8)
+    q, k, v = _qkv(b=2, l=32, h=4, d=8)
+    dense = full_attention(q, k, v)
+    ring = jax.jit(lambda q, k, v: ring_attention(q, k, v, mesh))(q, k, v)
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(dense),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_ring_seq1_degenerates_to_dense(mesh8):
+    q, k, v = _qkv()
+    out = ring_attention(q, k, v, mesh8)  # mesh8 has seq=1
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(full_attention(q, k, v)),
+                               rtol=1e-6)
+
+
+def test_ring_rejects_mask(devices8):
+    mesh = make_mesh(MeshConfig(data=2, seq=4), devices8)
+    q, k, v = _qkv()
+    with pytest.raises(NotImplementedError):
+        ring_attention(q, k, v, mesh, mask=jnp.zeros((2, 32, 32)))
+
+
+def test_ring_long_sequence_streams(devices8):
+    """Longer-than-VMEM-ish shape sanity: L=512 over 8-way seq."""
+    mesh = make_mesh(MeshConfig(data=1, seq=8), devices8)
+    q, k, v = _qkv(b=1, l=512, h=2, d=8, seed=3)
+    ring = jax.jit(lambda q, k, v: ring_attention(q, k, v, mesh))(q, k, v)
+    dense = full_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(dense),
+                               rtol=2e-5, atol=2e-6)

@@ -1,0 +1,157 @@
+"""Transformer/BERT-MLM tests: TP sharding metadata, SP training, and
+the dp+tp+sp composite mesh the reference never had."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tensorflow_distributed_tpu.config import MeshConfig
+from tensorflow_distributed_tpu.data.lm import LmBatcher, synthetic_mlm
+from tensorflow_distributed_tpu.models.transformer import (
+    BertMLM, bert_tiny_mlm, tiny_config)
+from tensorflow_distributed_tpu.parallel.mesh import make_mesh
+from tensorflow_distributed_tpu.parallel.sharding import shard_batch
+from tensorflow_distributed_tpu.train.state import create_train_state
+from tensorflow_distributed_tpu.train.step import make_eval_step, make_train_step
+from tensorflow_distributed_tpu.train.tasks import mlm_batch_shardings, mlm_loss
+
+
+def _tokens(b=4, l=32, vocab=64, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, vocab, size=(b, l)).astype(np.int32)
+
+
+def test_forward_shape_no_mesh():
+    model = bert_tiny_mlm()
+    toks = jnp.asarray(_tokens())
+    variables = model.init(jax.random.key(0), toks, train=False)
+    logits = model.apply(variables, toks, train=False)
+    assert logits.shape == (4, 32, 64)
+    assert logits.dtype == jnp.float32
+
+
+def test_partition_metadata_present():
+    model = bert_tiny_mlm()
+    toks = jnp.asarray(_tokens(b=2))
+    variables = jax.eval_shape(
+        lambda: model.init(jax.random.key(0), toks, train=False))
+    p = variables["params"]
+    import flax.linen as nn
+    qkv = p["layer_0"]["attn"]["qkv"]["kernel"]
+    assert isinstance(qkv, nn.Partitioned)
+    assert qkv.names == (None, None, "model", None)
+    up = p["layer_0"]["mlp"]["up"]["kernel"]
+    assert up.names == (None, "model")
+    down = p["layer_0"]["mlp"]["down"]["kernel"]
+    assert down.names == ("model", None)
+
+
+def _mlm_state(mesh, l=32):
+    model = BertMLM(tiny_config(max_len=l), mesh)
+    sample = np.zeros((2, l), np.int32)
+    return create_train_state(model, optax.adam(3e-3), sample, mesh, seed=0)
+
+
+def test_params_sharded_on_tp_mesh(devices8):
+    mesh = make_mesh(MeshConfig(data=2, seq=2, model=2), devices8)
+    state = _mlm_state(mesh)
+    qkv = state.params["layer_0"]["attn"]["qkv"]["kernel"]
+    assert qkv.sharding.spec == P(None, None, "model", None)
+    # Each device holds half the heads (2 of 4).
+    assert qkv.addressable_shards[0].data.shape[2] == 2
+    # Adam slots follow the param sharding (path-suffix matching).
+    mu_qkv = state.opt_state[0].mu["layer_0"]["attn"]["qkv"]["kernel"]
+    assert mu_qkv.sharding.spec == P(None, None, "model", None)
+
+
+@pytest.mark.parametrize("mesh_cfg", [
+    MeshConfig(data=8, seq=1, model=1),   # pure DP
+    MeshConfig(data=2, seq=2, model=2),   # dp + sp + tp composite
+    MeshConfig(data=1, seq=4, model=2),   # sp-dominant long-context
+])
+def test_mlm_trains_on_mesh(devices8, mesh_cfg):
+    mesh = make_mesh(mesh_cfg, devices8)
+    state = _mlm_state(mesh)
+    step = make_train_step(mesh, loss=mlm_loss,
+                           batch_shardings=mlm_batch_shardings(mesh))
+    ds = synthetic_mlm(n=512, seq_len=32, vocab_size=64, seed=0)
+    it = LmBatcher(ds, 64, seed=0).forever()
+    losses = []
+    for _ in range(80):
+        batch = shard_batch(mesh, next(it), seq_axis=1)
+        # dict batches: shard_batch handles pytrees; tokens are [B, L]
+        state, metrics = step(state, batch)
+        losses.append(float(jax.device_get(metrics["loss"])))
+    assert losses[-1] < losses[0] * 0.5, losses[::20]
+
+
+def test_remat_trains(devices8):
+    """cfg.remat=True (jax.checkpoint per block) must produce the same
+    loss as the non-remat path — it changes memory, not math."""
+    mesh = make_mesh(MeshConfig(data=2), devices8[:2])
+    ds = synthetic_mlm(n=64, seq_len=32, vocab_size=64, seed=2)
+    b = next(LmBatcher(ds, 16, seed=0).forever())
+    losses = {}
+    for remat in (False, True):
+        model = BertMLM(tiny_config(max_len=32, remat=remat), mesh)
+        state = create_train_state(model, optax.adam(3e-3),
+                                   np.zeros((2, 32), np.int32), mesh, seed=0)
+        step = make_train_step(mesh, loss=mlm_loss,
+                               batch_shardings=mlm_batch_shardings(mesh),
+                               donate=False)
+        _, metrics = step(state, shard_batch(mesh, b, seq_axis=1))
+        losses[remat] = float(jax.device_get(metrics["loss"]))
+    np.testing.assert_allclose(losses[False], losses[True], rtol=1e-6)
+
+
+def test_bert_mlm_via_registry_and_loop(devices8):
+    """The user-facing path: --model bert_mlm through build_model and
+    the full train loop."""
+    from tensorflow_distributed_tpu.config import TrainConfig
+    from tensorflow_distributed_tpu.train.loop import train
+    cfg = TrainConfig(model="bert_mlm", batch_size=32, train_steps=8,
+                      eval_every=4, log_every=0, eval_batch_size=32,
+                      compute_dtype="float32",
+                      mesh=MeshConfig(data=2, seq=2, model=2))
+    # tiny transformer via the registry's override path
+    from tensorflow_distributed_tpu.models import build_model
+    import tensorflow_distributed_tpu.models as models_pkg
+    orig = models_pkg.build_model
+
+    def tiny_build(name, **kw):
+        kw["size"] = "tiny"
+        kw.setdefault("max_len", 128)
+        return orig(name, **kw)
+
+    import tensorflow_distributed_tpu.train.loop as loop_mod
+    old = loop_mod.build_model
+    loop_mod.build_model = tiny_build
+    try:
+        result = train(cfg)
+    finally:
+        loop_mod.build_model = old
+    assert int(jax.device_get(result.state.step)) == 8
+    assert np.isfinite(result.final_metrics["loss"])
+
+
+def test_mesh_equivalence_dp_vs_composite(devices8):
+    """Same batch, same init: a dp-only mesh and a dp+sp+tp mesh compute
+    the same loss (the TP/SP decomposition is exact, not approximate)."""
+    ds = synthetic_mlm(n=128, seq_len=32, vocab_size=64, seed=1)
+    batch_np = LmBatcher(ds, 32, seed=0).forever()
+    b = next(batch_np)
+
+    losses = {}
+    for name, cfg in [("dp", MeshConfig(data=2, seq=1, model=1)),
+                      ("comp", MeshConfig(data=2, seq=2, model=2))]:
+        n = 2 if name == "dp" else 8
+        mesh = make_mesh(cfg, devices8[:n])
+        state = _mlm_state(mesh)
+        ev = make_eval_step(mesh, loss=mlm_loss,
+                            batch_shardings=mlm_batch_shardings(mesh))
+        m = ev(state, shard_batch(mesh, b, seq_axis=1))
+        losses[name] = float(jax.device_get(m["loss"]))
+    np.testing.assert_allclose(losses["dp"], losses["comp"], rtol=2e-5)
