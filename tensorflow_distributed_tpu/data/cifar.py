@@ -1,0 +1,112 @@
+"""CIFAR-10 + synthetic image datasets for the ResNet scale-out configs.
+
+No reference counterpart (the reference's only dataset is MNIST via
+``input_data.read_data_sets``, mnist_python_m.py:133); this exists so the
+ResNet-20/CIFAR-10 and ResNet-50/ImageNet BASELINE.json configs run on
+the same Dataset/ShardedBatcher contract as MNIST (SURVEY.md N13
+upgrade: disjoint per-process sharding, no network egress).
+
+CIFAR-10 binary format (the "cifar-10-batches-bin" distribution):
+each record is 1 label byte + 3072 image bytes (1024 R, 1024 G, 1024 B,
+row-major 32x32); files data_batch_{1..5}.bin (train) and test_batch.bin.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Tuple
+
+import numpy as np
+
+from tensorflow_distributed_tpu.data.mnist import Dataset, _to_splits
+
+_RECORD = 1 + 3 * 32 * 32
+_TRAIN_FILES = [f"data_batch_{i}.bin" for i in range(1, 6)]
+_TEST_FILE = "test_batch.bin"
+
+
+def parse_cifar_batch(raw: bytes) -> Tuple[np.ndarray, np.ndarray]:
+    """Parse one .bin file -> (images u8 [N,32,32,3], labels i32 [N])."""
+    if len(raw) % _RECORD != 0:
+        raise ValueError(f"cifar: file size {len(raw)} not a multiple of "
+                         f"record size {_RECORD}")
+    rec = np.frombuffer(raw, dtype=np.uint8).reshape(-1, _RECORD)
+    labels = rec[:, 0].astype(np.int32)
+    # CHW planes -> HWC.
+    images = rec[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1).copy()
+    return images, labels
+
+
+def load_cifar10(data_dir: str, validation_size: int = 5000
+                 ) -> Tuple[Dataset, Dataset, Dataset]:
+    """Load the binary CIFAR-10 distribution from ``data_dir`` (directly
+    or under a cifar-10-batches-bin/ subdir)."""
+    for base in (data_dir, os.path.join(data_dir, "cifar-10-batches-bin")):
+        if os.path.exists(os.path.join(base, _TRAIN_FILES[0])):
+            break
+    else:
+        raise FileNotFoundError(
+            f"CIFAR-10 .bin files not found under {data_dir}. This "
+            "environment has no network egress; place the binary "
+            "distribution there or use dataset='cifar10_synthetic'.")
+    ims, labs = [], []
+    for fname in _TRAIN_FILES:
+        with open(os.path.join(base, fname), "rb") as f:
+            i, l = parse_cifar_batch(f.read())
+        ims.append(i)
+        labs.append(l)
+    train_images = np.concatenate(ims).astype(np.float32) / 255.0
+    train_labels = np.concatenate(labs)
+    with open(os.path.join(base, _TEST_FILE), "rb") as f:
+        ti, tl = parse_cifar_batch(f.read())
+    return _to_splits(train_images, train_labels,
+                      ti.astype(np.float32) / 255.0, tl,
+                      validation_size, "cifar10")
+
+
+def synthetic_images(n_train: int, n_test: int, validation_size: int,
+                     shape: Tuple[int, int, int], num_classes: int,
+                     seed: int, name: str
+                     ) -> Tuple[Dataset, Dataset, Dataset]:
+    """Deterministic learnable synthetic image classification set.
+
+    Each class is a fixed smooth color template; samples are the
+    template plus noise — separable by a convnet but not trivially
+    (noise sigma 0.35 vs unit-range templates).
+    """
+    rng = np.random.default_rng(seed)
+    h, w, c = shape
+    n = n_train + n_test
+    # Coarse templates upsampled 4x then cropped — ceil-divide so any
+    # (even non-multiple-of-4, or < 4) h/w yields the exact shape asked.
+    templates = rng.uniform(0.0, 1.0, size=(num_classes, -(-h // 4),
+                                            -(-w // 4), c))
+    templates = np.kron(templates,
+                        np.ones((1, 4, 4, 1)))[:, :h, :w, :]  # smooth upsample
+    labels = rng.integers(0, num_classes, size=n).astype(np.int32)
+    images = templates[labels].astype(np.float32)
+    # f32 noise generated directly — a float64 temporary here would
+    # triple peak host memory for the ImageNet-shaped set.
+    images += 0.35 * rng.standard_normal(images.shape, dtype=np.float32)
+    images = np.clip(images, 0.0, 1.0)
+    return _to_splits(images[:n_train], labels[:n_train],
+                      images[n_train:], labels[n_train:],
+                      validation_size, name)
+
+
+def synthetic_cifar10(n_train: int = 8000, n_test: int = 1000,
+                      validation_size: int = 1000, seed: int = 0
+                      ) -> Tuple[Dataset, Dataset, Dataset]:
+    return synthetic_images(n_train, n_test, validation_size,
+                            (32, 32, 3), 10, seed, "cifar10_synthetic")
+
+
+def synthetic_imagenet(n_train: int = 2048, n_test: int = 512,
+                       validation_size: int = 512, seed: int = 0,
+                       image_size: int = 224, num_classes: int = 1000
+                       ) -> Tuple[Dataset, Dataset, Dataset]:
+    """ImageNet-shaped synthetic data for the ResNet-50 config. Small N
+    by default — this exists to exercise shapes/throughput, not accuracy."""
+    return synthetic_images(n_train, n_test, validation_size,
+                            (image_size, image_size, 3), num_classes, seed,
+                            "imagenet_synthetic")

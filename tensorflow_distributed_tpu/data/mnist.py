@@ -175,8 +175,11 @@ def synthetic_mnist(n_train: int = 12000, n_test: int = 2000,
 
 def load_dataset(dataset: str, data_dir: str, seed: int = 0
                  ) -> Tuple[Dataset, Dataset, Dataset]:
-    """Dispatch: 'mnist' (falling back to synthetic when files are absent,
-    with a warning) or 'synthetic'."""
+    """Dispatch over every vision dataset family. Real datasets
+    ('mnist', 'cifar10') fall back to their synthetic twins with a
+    warning when files are absent (zero-egress environments)."""
+    from tensorflow_distributed_tpu.data import cifar
+
     if dataset == "synthetic":
         return synthetic_mnist(seed=seed)
     if dataset == "mnist":
@@ -185,6 +188,16 @@ def load_dataset(dataset: str, data_dir: str, seed: int = 0
         except FileNotFoundError as e:
             print(f"[data] {e} — falling back to synthetic digits.")
             return synthetic_mnist(seed=seed)
+    if dataset == "cifar10":
+        try:
+            return cifar.load_cifar10(data_dir)
+        except FileNotFoundError as e:
+            print(f"[data] {e} — falling back to synthetic cifar10.")
+            return cifar.synthetic_cifar10(seed=seed)
+    if dataset == "cifar10_synthetic":
+        return cifar.synthetic_cifar10(seed=seed)
+    if dataset == "imagenet_synthetic":
+        return cifar.synthetic_imagenet(seed=seed)
     raise ValueError(f"unknown dataset {dataset!r}")
 
 

@@ -1,13 +1,144 @@
-"""ResNet family for the BASELINE.json scale-out configs
-(ResNet-20/CIFAR-10, ResNet-50/ImageNet). Implemented in a later
-milestone of this round; importable now so the registry stays total."""
+"""ResNet family for the BASELINE.json scale-out configs.
+
+The reference has no ResNet (its only model is the MNIST CNN,
+mnist_python_m.py:104-128); these exist to prove the ps->allreduce port
+generalizes past a toy convnet: ResNet-20/CIFAR-10 and
+ResNet-50/ImageNet-shape reuse the identical train-step/mesh machinery
+under pure data parallelism.
+
+TPU notes:
+- NHWC layout, 3x3/1x1 convs in ``compute_dtype`` (bfloat16 default) so
+  they tile onto the MXU; BatchNorm statistics and residual adds in f32.
+- BatchNorm runs in "sync BN" semantics for free: batch means/variances
+  reduce over the *global* sharded batch inside jit, so XLA inserts the
+  cross-replica allreduce — no wrapper module. The moving averages live
+  in the ``batch_stats`` collection carried by ``TrainState.extra``.
+- He-normal kernel init, zero-init for the final BN scale of each
+  residual branch (the standard "zero-gamma" trick: blocks start as
+  identity, stabilizing early large-batch training).
+"""
 
 from __future__ import annotations
 
+from functools import partial
+from typing import Any, Callable, Sequence, Tuple
 
-def resnet20(**kw):
-    raise NotImplementedError("resnet20 lands in a later milestone")
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+Dtype = Any
 
 
-def resnet50(**kw):
-    raise NotImplementedError("resnet50 lands in a later milestone")
+class BasicBlock(nn.Module):
+    """3x3 -> 3x3 residual block (ResNet-18/20/34)."""
+
+    filters: int
+    strides: Tuple[int, int] = (1, 1)
+    compute_dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *, train: bool = False) -> jax.Array:
+        conv = partial(nn.Conv, use_bias=False, dtype=self.compute_dtype,
+                       kernel_init=nn.initializers.he_normal())
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=jnp.float32)
+        residual = x
+        y = conv(self.filters, (3, 3), self.strides, name="conv1")(x)
+        y = norm(name="bn1")(y)
+        y = nn.relu(y)
+        y = conv(self.filters, (3, 3), name="conv2")(y)
+        y = norm(scale_init=nn.initializers.zeros_init(), name="bn2")(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters, (1, 1), self.strides,
+                            name="proj")(residual)
+            residual = norm(name="bn_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 -> 3x3 -> 1x1 (x4) residual block (ResNet-50/101/152)."""
+
+    filters: int
+    strides: Tuple[int, int] = (1, 1)
+    compute_dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *, train: bool = False) -> jax.Array:
+        conv = partial(nn.Conv, use_bias=False, dtype=self.compute_dtype,
+                       kernel_init=nn.initializers.he_normal())
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=jnp.float32)
+        residual = x
+        y = conv(self.filters, (1, 1), name="conv1")(x)
+        y = norm(name="bn1")(y)
+        y = nn.relu(y)
+        y = conv(self.filters, (3, 3), self.strides, name="conv2")(y)
+        y = norm(name="bn2")(y)
+        y = nn.relu(y)
+        y = conv(self.filters * 4, (1, 1), name="conv3")(y)
+        y = norm(scale_init=nn.initializers.zeros_init(), name="bn3")(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters * 4, (1, 1), self.strides,
+                            name="proj")(residual)
+            residual = norm(name="bn_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    """Configurable ResNet: CIFAR stem (3x3) or ImageNet stem (7x7/2+pool).
+
+    stage_sizes: blocks per stage; filters double each stage.
+    """
+
+    stage_sizes: Sequence[int]
+    block_cls: Callable
+    num_classes: int = 10
+    num_filters: int = 16
+    cifar_stem: bool = True
+    compute_dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *, train: bool = False) -> jax.Array:
+        conv = partial(nn.Conv, use_bias=False, dtype=self.compute_dtype,
+                       kernel_init=nn.initializers.he_normal())
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=jnp.float32)
+        x = x.astype(self.compute_dtype)
+        if self.cifar_stem:
+            x = conv(self.num_filters, (3, 3), name="conv_stem")(x)
+        else:
+            x = conv(self.num_filters, (7, 7), (2, 2), name="conv_stem")(x)
+        x = norm(name="bn_stem")(x)
+        x = nn.relu(x)
+        if not self.cifar_stem:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for stage, n_blocks in enumerate(self.stage_sizes):
+            for block in range(n_blocks):
+                strides = (2, 2) if stage > 0 and block == 0 else (1, 1)
+                x = self.block_cls(
+                    filters=self.num_filters * (2 ** stage), strides=strides,
+                    compute_dtype=self.compute_dtype,
+                    name=f"stage{stage}_block{block}")(x, train=train)
+        x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))  # global avg pool
+        x = nn.Dense(self.num_classes, dtype=jnp.float32,
+                     kernel_init=nn.initializers.he_normal(), name="head")(x)
+        return x.astype(jnp.float32)
+
+
+def resnet20(num_classes: int = 10, compute_dtype: Dtype = jnp.bfloat16,
+             **_ignored) -> ResNet:
+    """CIFAR-10 ResNet-20: 3 stages x 3 basic blocks, 16/32/64 filters
+    (6n+2 with n=3). ~0.27M params."""
+    return ResNet(stage_sizes=(3, 3, 3), block_cls=BasicBlock,
+                  num_classes=num_classes, num_filters=16, cifar_stem=True,
+                  compute_dtype=compute_dtype)
+
+
+def resnet50(num_classes: int = 1000, compute_dtype: Dtype = jnp.bfloat16,
+             **_ignored) -> ResNet:
+    """ImageNet ResNet-50: stages (3,4,6,3) of bottleneck blocks,
+    64-base filters, 7x7/2 stem + maxpool. ~25.6M params."""
+    return ResNet(stage_sizes=(3, 4, 6, 3), block_cls=BottleneckBlock,
+                  num_classes=num_classes, num_filters=64, cifar_stem=False,
+                  compute_dtype=compute_dtype)

@@ -9,10 +9,12 @@ to *prove* and to *measure* what `train.step` does implicitly:
    ``lax.pmean`` over the "data" axis is the entire sync protocol (no
    accumulators, token queues, or chief thread). Tests assert it is
    numerically identical to the implicit-jit step *with dropout
-   disabled*; with dropout on, this formulation draws an independent
-   mask per data shard (fold_in by axis_index, like the reference's
-   workers' independent draws) while the implicit-jit step draws one
-   mask over the global batch — same distribution, different streams.
+   disabled and no BatchNorm*; with dropout on, this formulation draws
+   an independent mask per data shard (fold_in by axis_index, like the
+   reference's workers' independent draws) while the implicit-jit step
+   draws one mask over the global batch — same distribution, different
+   streams. BatchNorm models likewise normalize with local per-shard
+   stats here vs global-batch stats in the jit step (see NOTE inline).
 
 2. ``ps_style_grad_sync`` — an honest emulation of the reference's
    parameter-server topology for the BASELINE.json latency A/B: per-shard
@@ -65,15 +67,23 @@ def make_shardmap_train_step(mesh: Mesh, seed: int = 0):
         dkey = jax.random.fold_in(dkey, jax.lax.axis_index(AXIS_DATA))
         grad_fn = jax.value_and_grad(
             partial(loss_fn, state.apply_fn), has_aux=True)
-        (_, metrics), grads = grad_fn(state.params, (images, labels), dkey, True)
-        # THE sync protocol: one mean-allreduce over ICI.
+        (_, (metrics, new_extra)), grads = grad_fn(
+            state.params, state.extra, (images, labels), dkey, True)
+        # THE sync protocol: one mean-allreduce over ICI. NOTE on
+        # BatchNorm models: normalization here uses LOCAL per-shard
+        # batch stats (torch-DDP-without-SyncBN semantics), and the
+        # running stats are the mean of the per-shard updates — NOT
+        # bitwise the jit step's global-batch (sync-BN) stats. The
+        # numerical-parity contract with the jit step therefore holds
+        # for stat-free models only; BN models agree in expectation.
         grads = jax.lax.pmean(grads, AXIS_DATA)
         metrics = jax.lax.pmean(metrics, AXIS_DATA)
+        new_extra = jax.lax.pmean(new_extra, AXIS_DATA)
         updates, new_opt = state.tx.update(grads, state.opt_state, state.params)
         new_params = jax.tree_util.tree_map(
             lambda p, u: p + u.astype(p.dtype), state.params, updates)
         return state.replace(step=state.step + 1, params=new_params,
-                             opt_state=new_opt), metrics
+                             opt_state=new_opt, extra=new_extra), metrics
 
     state_specs = P()  # params/opt-state replicated across data shards
     shmapped = jax.shard_map(
@@ -95,7 +105,8 @@ def make_per_shard_grads(mesh: Mesh, seed: int = 0):
         dkey = prng.step_key(seed, state.step)
         dkey = jax.random.fold_in(dkey, jax.lax.axis_index(AXIS_DATA))
         grad_fn = jax.grad(
-            lambda p, b: loss_fn(state.apply_fn, p, b, dkey, True)[0])
+            lambda p, b: loss_fn(state.apply_fn, p, state.extra, b,
+                                 dkey, True)[0])
         grads = grad_fn(state.params, (images, labels))
         return jax.tree_util.tree_map(lambda g: g[None], grads)
 

@@ -33,6 +33,12 @@ class TrainState:
     apply_fn: Callable = struct.field(pytree_node=False)
     tx: optax.GradientTransformation = struct.field(pytree_node=False)
 
+    # Non-param variable collections (e.g. BatchNorm "batch_stats").
+    # Updated in the forward pass, not by the optimizer — the moving
+    # averages ride along the state pytree and checkpoint with it.
+    # Empty dict for stat-free models (CNN, transformer).
+    extra: Any = struct.field(default_factory=dict)
+
 
 def create_train_state(model: nn.Module, tx: optax.GradientTransformation,
                        sample_input: jax.Array, mesh: Mesh, seed: int = 0
@@ -50,11 +56,13 @@ def create_train_state(model: nn.Module, tx: optax.GradientTransformation,
         jax.random.key(0))
     # param_sharding maps each metadata box (or bare leaf) to a
     # NamedSharding, yielding a tree with the *unboxed* structure.
-    shardings = param_sharding(mesh, abstract["params"])
+    # Applied to the full variables dict it also covers non-param
+    # collections (batch_stats, ...), which are bare -> replicated.
+    var_shardings = param_sharding(mesh, abstract)
+    shardings = var_shardings["params"]
 
-    def init_params(key):
-        v = model.init(key, sample_input, train=False)
-        return nn.meta.unbox(v["params"])
+    def init_vars(key):
+        return nn.meta.unbox(model.init(key, sample_input, train=False))
 
     # Optimizer-state shardings: slots that mirror a param tensor (Adam
     # m/v, momentum) get that param's sharding; scalars (step counts)
@@ -83,13 +91,15 @@ def create_train_state(model: nn.Module, tx: optax.GradientTransformation,
         opt_leaf_sharding, abstract_opt)
 
     with mesh:
-        params = jax.jit(init_params, out_shardings=shardings)(
+        variables = jax.jit(init_vars, out_shardings=var_shardings)(
             prng.init_key(seed))
+        params = variables["params"]
+        extra = {k: v for k, v in variables.items() if k != "params"}
         opt_state = jax.jit(tx.init, out_shardings=opt_shardings)(params)
         step = jax.device_put(jax.numpy.zeros((), jax.numpy.int32),
                               replicated(mesh))
     return TrainState(step=step, params=params, opt_state=opt_state,
-                      apply_fn=model.apply, tx=tx)
+                      apply_fn=model.apply, tx=tx, extra=extra)
 
 
 def param_count(params: Any) -> int:

@@ -39,21 +39,44 @@ from tensorflow_distributed_tpu.utils import prng
 
 Batch = Any  # task-defined pytree; classification default: (images, labels)
 Metrics = Dict[str, jax.Array]
-# A LossFn maps (apply_fn, params, batch, dropout_key, train) ->
-# (scalar loss, metrics dict). Tasks (vision, masked-LM, ...) plug in
-# here; the step/sync machinery below is task-agnostic.
+# A LossFn maps (apply_fn, params, extra, batch, dropout_key, train) ->
+# (scalar loss, (metrics dict, new_extra)). ``extra`` carries non-param
+# variable collections (BatchNorm stats); stat-free tasks pass {} through
+# unchanged. Tasks (vision, masked-LM, ...) plug in here; the step/sync
+# machinery below is task-agnostic.
 LossFn = Callable
 
 
-def loss_fn(apply_fn: Callable, params: Any, batch: Batch,
-            dropout_key: jax.Array, train: bool) -> Tuple[jax.Array, Metrics]:
+def apply_model(apply_fn: Callable, params: Any, extra: Any, inputs: Any,
+                dropout_key: jax.Array, train: bool) -> Tuple[jax.Array, Any]:
+    """Run the model forward, updating mutable collections when training.
+
+    Returns (outputs, new_extra). BatchNorm batch means/variances are
+    computed over the *global* (sharded) batch inside jit, so XLA inserts
+    the cross-replica stats allreduce automatically — the SPMD analog of
+    synchronized BatchNorm.
+    """
+    variables = {"params": params, **extra}
+    rngs = {"dropout": dropout_key} if train else {}
+    mutable = list(extra) if (train and extra) else False
+    if mutable:
+        out, new_vars = apply_fn(variables, inputs, train=train, rngs=rngs,
+                                 mutable=mutable)
+        return out, dict(new_vars)
+    return apply_fn(variables, inputs, train=train, rngs=rngs), extra
+
+
+def loss_fn(apply_fn: Callable, params: Any, extra: Any, batch: Batch,
+            dropout_key: jax.Array, train: bool
+            ) -> Tuple[jax.Array, Tuple[Metrics, Any]]:
     """Default classification loss — the reference's task
     (mnist_python_m.py:205-207)."""
     images, labels = batch
-    logits = apply_fn({"params": params}, images, train=train,
-                      rngs={"dropout": dropout_key} if train else {})
+    logits, new_extra = apply_model(apply_fn, params, extra, images,
+                                    dropout_key, train)
     loss = softmax_cross_entropy(logits, labels)
-    return loss, {"loss": loss, "accuracy": accuracy(logits, labels)}
+    metrics = {"loss": loss, "accuracy": accuracy(logits, labels)}
+    return loss, (metrics, new_extra)
 
 
 def default_batch_shardings(mesh: Mesh):
@@ -83,12 +106,13 @@ def make_train_step(mesh: Mesh, seed: int = 0, donate: bool = True,
         dkey = prng.step_key(seed, state.step)
         grad_fn = jax.value_and_grad(
             partial(loss, state.apply_fn), has_aux=True)
-        (_, metrics), grads = grad_fn(state.params, batch, dkey, True)
+        (_, (metrics, new_extra)), grads = grad_fn(
+            state.params, state.extra, batch, dkey, True)
         updates, new_opt = state.tx.update(grads, state.opt_state, state.params)
         new_params = jax.tree_util.tree_map(
             lambda p, u: (p + u.astype(p.dtype)), state.params, updates)
         new_state = state.replace(step=state.step + 1, params=new_params,
-                                  opt_state=new_opt)
+                                  opt_state=new_opt, extra=new_extra)
         return new_state, metrics
 
     with mesh:
@@ -109,8 +133,8 @@ def make_eval_step(mesh: Mesh, loss: LossFn = loss_fn,
         batch_shardings = default_batch_shardings(mesh)
 
     def step(state: TrainState, batch: Batch) -> Metrics:
-        _, metrics = loss(state.apply_fn, state.params, batch,
-                          jax.random.key(0), False)
+        _, (metrics, _) = loss(state.apply_fn, state.params, state.extra,
+                               batch, jax.random.key(0), False)
         return metrics
 
     with mesh:

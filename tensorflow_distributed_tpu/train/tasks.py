@@ -39,8 +39,8 @@ class Task:
 
 # --- vision (the reference's task) --------------------------------------
 
-def vision_loss(apply_fn, params, batch, dropout_key, train):
-    return step_lib.loss_fn(apply_fn, params, batch, dropout_key, train)
+def vision_loss(apply_fn, params, extra, batch, dropout_key, train):
+    return step_lib.loss_fn(apply_fn, params, extra, batch, dropout_key, train)
 
 
 def _make_vision_task(cfg: TrainConfig, mesh: Mesh) -> Task:
@@ -68,16 +68,17 @@ def _make_vision_task(cfg: TrainConfig, mesh: Mesh) -> Task:
 
 # --- masked LM (BASELINE.json stretch family) ---------------------------
 
-def mlm_loss(apply_fn, params, batch, dropout_key, train):
+def mlm_loss(apply_fn, params, extra, batch, dropout_key, train):
     """Masked-LM objective over a {tokens, targets, mask} batch."""
-    logits = apply_fn({"params": params}, batch["tokens"], train=train,
-                      rngs={"dropout": dropout_key} if train else {})
+    logits, new_extra = step_lib.apply_model(
+        apply_fn, params, extra, batch["tokens"], dropout_key, train)
     loss = masked_softmax_cross_entropy(logits, batch["targets"],
                                         batch["mask"])
-    return loss, {
+    metrics = {
         "loss": loss,
         "accuracy": masked_accuracy(logits, batch["targets"], batch["mask"]),
     }
+    return loss, (metrics, new_extra)
 
 
 def mlm_batch_shardings(mesh: Mesh) -> Dict[str, NamedSharding]:

@@ -1,0 +1,127 @@
+"""ResNet family + CIFAR data + BatchNorm (mutable collections) tests.
+
+The reference has no ResNet; these guard the scale-out configs
+(BASELINE.json: ResNet-20/CIFAR-10, ResNet-50/ImageNet) and the
+batch_stats plumbing through TrainState.extra.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tensorflow_distributed_tpu.data.cifar import (
+    parse_cifar_batch, synthetic_cifar10, synthetic_imagenet)
+from tensorflow_distributed_tpu.data.mnist import load_dataset
+from tensorflow_distributed_tpu.models.resnet import resnet20, resnet50
+from tensorflow_distributed_tpu.parallel.sharding import shard_batch
+from tensorflow_distributed_tpu.train.state import create_train_state, param_count
+from tensorflow_distributed_tpu.train.step import make_eval_step, make_train_step
+
+
+def _cifar_batch(n=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.uniform(size=(n, 32, 32, 3)).astype(np.float32),
+            rng.integers(0, 10, size=(n,)).astype(np.int32))
+
+
+def test_cifar_bin_parse_roundtrip():
+    rng = np.random.default_rng(0)
+    n = 7
+    labels = rng.integers(0, 10, size=n).astype(np.uint8)
+    images = rng.integers(0, 256, size=(n, 3, 32, 32)).astype(np.uint8)
+    raw = b"".join(bytes([labels[i]]) + images[i].tobytes() for i in range(n))
+    imgs, labs = parse_cifar_batch(raw)
+    assert imgs.shape == (n, 32, 32, 3)
+    np.testing.assert_array_equal(labs, labels.astype(np.int32))
+    # HWC pixel (y,x,c) == CHW plane value
+    np.testing.assert_array_equal(imgs[3, 5, 9], images[3, :, 5, 9])
+
+
+def test_cifar_bin_parse_rejects_bad_size():
+    with pytest.raises(ValueError):
+        parse_cifar_batch(b"\x00" * 100)
+
+
+def test_synthetic_cifar_shapes_and_dispatch():
+    train, val, test = synthetic_cifar10(n_train=256, n_test=64,
+                                         validation_size=32)
+    assert train.images.shape == (224, 32, 32, 3)
+    assert val.images.shape[0] == 32 and test.images.shape[0] == 64
+    # load_dataset falls back to synthetic when .bin files are absent
+    tr2, _, _ = load_dataset("cifar10", "/nonexistent-dir", seed=0)
+    assert tr2.images.shape[1:] == (32, 32, 3)
+    tr3, _, _ = load_dataset("imagenet_synthetic", "", seed=0)
+    assert tr3.images.shape[1:] == (224, 224, 3)
+
+
+def test_resnet20_shapes_params_and_stats(mesh1):
+    model = resnet20(compute_dtype=jnp.float32)
+    state = create_train_state(model, optax.adam(1e-3),
+                               np.zeros((2, 32, 32, 3), np.float32), mesh1)
+    n = param_count(state.params)
+    assert 250_000 < n < 300_000, n  # ResNet-20 is ~0.27M params
+    assert "batch_stats" in state.extra
+    images, _ = _cifar_batch(4)
+    logits = model.apply({"params": state.params, **state.extra},
+                         jnp.asarray(images), train=False)
+    assert logits.shape == (4, 10)
+    assert logits.dtype == jnp.float32
+
+
+def test_resnet50_abstract_shapes():
+    # eval_shape only — no 25M-param allocation in CI
+    model = resnet50(compute_dtype=jnp.bfloat16)
+    abstract = jax.eval_shape(
+        lambda k: model.init(k, jnp.zeros((1, 224, 224, 3)), train=False),
+        jax.random.key(0))
+    n = sum(int(np.prod(x.shape))
+            for x in jax.tree_util.tree_leaves(abstract["params"]))
+    assert 25_000_000 < n < 26_000_000, n
+    out = jax.eval_shape(
+        lambda v, x: model.apply(v, x, train=False),
+        abstract, jnp.zeros((2, 224, 224, 3)))
+    assert out.shape == (2, 1000)
+
+
+def test_resnet20_train_step_updates_stats_8dev(mesh8):
+    model = resnet20(compute_dtype=jnp.float32)
+    state = create_train_state(model, optax.adam(1e-3),
+                               np.zeros((2, 32, 32, 3), np.float32), mesh8)
+    step = make_train_step(mesh8, donate=False)
+    before = jax.device_get(state.extra["batch_stats"])
+    batch = shard_batch(mesh8, _cifar_batch(16))
+    state2, metrics = step(state, batch)
+    assert int(jax.device_get(state2.step)) == 1
+    assert np.isfinite(float(jax.device_get(metrics["loss"])))
+    after = jax.device_get(state2.extra["batch_stats"])
+    changed = jax.tree_util.tree_map(
+        lambda a, b: not np.allclose(a, b), before, after)
+    assert any(jax.tree_util.tree_leaves(changed))
+    # eval path consumes running stats without mutating
+    ev = make_eval_step(mesh8)
+    m = ev(state2, batch)
+    assert np.isfinite(float(jax.device_get(m["loss"])))
+
+
+def test_resnet20_bn_parity_8dev_vs_1dev(mesh8, mesh1):
+    """Global-batch BN inside jit: the 8-device step must produce the
+    same loss and the same updated batch_stats as the 1-device step on
+    the identical global batch (sync-BN semantics by construction)."""
+    model = resnet20(compute_dtype=jnp.float32)
+    batch = _cifar_batch(16)
+    outs = []
+    for mesh in (mesh8, mesh1):
+        state = create_train_state(model, optax.adam(1e-3),
+                                   np.zeros((2, 32, 32, 3), np.float32), mesh)
+        step = make_train_step(mesh, donate=False)
+        state2, metrics = step(state, shard_batch(mesh, batch))
+        outs.append((float(jax.device_get(metrics["loss"])),
+                     jax.device_get(state2.extra["batch_stats"])))
+    l8, s8 = outs[0]
+    l1, s1 = outs[1]
+    assert np.isclose(l8, l1, rtol=1e-4), (l8, l1)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5),
+        s8, s1)
