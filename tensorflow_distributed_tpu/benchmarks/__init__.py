@@ -1,0 +1,6 @@
+"""Benchmark entrypoints.
+
+Each module is runnable (``python -m tensorflow_distributed_tpu.benchmarks.<name>``)
+and prints one JSON line per metric, in the same shape as the repo-root
+``bench.py`` headline benchmark.
+"""

@@ -1,0 +1,104 @@
+"""Gradient-sync latency A/B: ICI allreduce vs parameter-server emulation.
+
+This is the BASELINE.json metric "allreduce vs ps grad-sync latency",
+measured rather than assumed. The reference synchronized gradients by
+routing every worker's full gradient tensor through one parameter-server
+process over gRPC/TCP and pulling the updated weights back — 2x full
+push + 2x full pull per step through a single host NIC
+(mnist_python_m.py:216-233; SURVEY.md §5 "communication backend"). The
+TPU-native replacement is one XLA psum over ICI: gradients never leave
+the chips.
+
+Both sides of the A/B time ONLY the sync protocol on identically-shaped
+gradient pytrees (the MNIST CNN's ~3.2M params by default); gradient
+computation is excluded from both timed spans:
+
+- ``allreduce``: jitted ``lax.pmean`` over the mesh "data" axis
+  (parallel.collectives.allreduce_latency_probe).
+- ``ps``: per-shard grads pulled to host numpy, averaged there,
+  re-broadcast with device_put (parallel.collectives.ps_style_sync_probe)
+  — an honest local-host stand-in for the reference's ps (it still pays
+  device<->host transit + host aggregation, but NOT TCP, so the measured
+  gap is a *lower bound* on the real one).
+
+Prints one JSON line per metric plus a summary speedup line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import time
+from typing import Callable, List
+
+
+def _time_probe(probe: Callable[[], float], iters: int, warmup: int = 3
+                ) -> List[float]:
+    for _ in range(warmup):
+        probe()
+    return [probe() for _ in range(iters)]
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--iters", type=int, default=30)
+    parser.add_argument("--model", default="mnist_cnn",
+                        choices=["mnist_cnn", "resnet20"])
+    args = parser.parse_args(argv)
+
+    import jax
+    import numpy as np
+    import optax
+
+    from tensorflow_distributed_tpu.config import MeshConfig
+    from tensorflow_distributed_tpu.models import build_model
+    from tensorflow_distributed_tpu.parallel.collectives import (
+        allreduce_latency_probe, make_per_shard_grads, ps_style_sync_probe)
+    from tensorflow_distributed_tpu.parallel.mesh import make_mesh
+    from tensorflow_distributed_tpu.parallel.sharding import shard_batch
+    from tensorflow_distributed_tpu.train.state import (
+        create_train_state, param_count)
+
+    n_dev = len(jax.devices())
+    mesh = make_mesh(MeshConfig(data=n_dev))
+    sample = (np.zeros((2, 28, 28, 1), np.float32) if args.model == "mnist_cnn"
+              else np.zeros((2, 32, 32, 3), np.float32))
+    model = build_model(args.model, mesh=mesh, compute_dtype=jax.numpy.float32)
+    state = create_train_state(model, optax.adam(1e-3), sample, mesh)
+    n_params = param_count(state.params)
+
+    # One real gradient computation provides the stacked per-shard grads
+    # the ps probe consumes and the param-shaped buffers the allreduce
+    # probe consumes.
+    rng = np.random.default_rng(0)
+    batch = shard_batch(mesh, (
+        rng.normal(size=(2 * n_dev,) + sample.shape[1:]).astype(np.float32),
+        rng.integers(0, 10, size=(2 * n_dev,)).astype(np.int32)))
+    grad_fn = make_per_shard_grads(mesh)
+    stacked = grad_fn(state, batch[0], batch[1])
+    jax.block_until_ready(stacked)
+
+    ps_probe = ps_style_sync_probe(mesh, stacked)
+    ar_probe = allreduce_latency_probe(mesh, state.params)
+
+    ps_times = _time_probe(ps_probe, args.iters)
+    ar_times = _time_probe(ar_probe, args.iters)
+    ps_ms = statistics.median(ps_times) * 1e3
+    ar_ms = statistics.median(ar_times) * 1e3
+
+    meta = {"model": args.model, "params": n_params, "devices": n_dev}
+    print(json.dumps({
+        "metric": "ps_grad_sync_latency_ms", "value": round(ps_ms, 3),
+        "unit": "ms/step", **meta}))
+    print(json.dumps({
+        "metric": "allreduce_grad_sync_latency_ms", "value": round(ar_ms, 3),
+        "unit": "ms/step", **meta}))
+    print(json.dumps({
+        "metric": "allreduce_vs_ps_speedup",
+        "value": round(ps_ms / ar_ms, 2) if ar_ms > 0 else float("inf"),
+        "unit": "x", **meta}))
+
+
+if __name__ == "__main__":
+    main()

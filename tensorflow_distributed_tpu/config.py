@@ -74,7 +74,9 @@ class TrainConfig:
     # (mnist_python_m.py:292, mnist_single.py:112)
 
     # --- data ------------------------------------------------------------
-    dataset: str = "mnist"  # mnist | synthetic | cifar10 | lm_synthetic
+    # mnist | synthetic | cifar10 | cifar10_synthetic | imagenet_synthetic
+    # | lm_synthetic  (see data.load_dataset dispatch)
+    dataset: str = "mnist"
     data_dir: str = "/tmp/mnist-data"  # reference default, mnist_python_m.py:50
     # Global batch. Reference: 128 per worker x 2 workers = 256 global
     # (mnist_python_m.py:70, replicas_to_aggregate :62-65).

@@ -41,14 +41,23 @@ def load_cifar10(data_dir: str, validation_size: int = 5000
                  ) -> Tuple[Dataset, Dataset, Dataset]:
     """Load the binary CIFAR-10 distribution from ``data_dir`` (directly
     or under a cifar-10-batches-bin/ subdir)."""
+    wanted = _TRAIN_FILES + [_TEST_FILE]
     for base in (data_dir, os.path.join(data_dir, "cifar-10-batches-bin")):
-        if os.path.exists(os.path.join(base, _TRAIN_FILES[0])):
+        present = [f for f in wanted if os.path.exists(os.path.join(base, f))]
+        if present:
             break
-    else:
+    if not present:
         raise FileNotFoundError(
             f"CIFAR-10 .bin files not found under {data_dir}. This "
             "environment has no network egress; place the binary "
             "distribution there or use dataset='cifar10_synthetic'.")
+    if len(present) != len(wanted):
+        # A partial copy must NOT fall through to the synthetic fallback
+        # (load_dataset catches FileNotFoundError) — that would silently
+        # train on synthetic data while the user believes it's CIFAR-10.
+        missing = sorted(set(wanted) - set(present))
+        raise ValueError(
+            f"CIFAR-10 under {base} is incomplete: missing {missing}")
     ims, labs = [], []
     for fname in _TRAIN_FILES:
         with open(os.path.join(base, fname), "rb") as f:
@@ -80,11 +89,11 @@ def synthetic_images(n_train: int, n_test: int, validation_size: int,
     # Coarse templates upsampled 4x then cropped — ceil-divide so any
     # (even non-multiple-of-4, or < 4) h/w yields the exact shape asked.
     templates = rng.uniform(0.0, 1.0, size=(num_classes, -(-h // 4),
-                                            -(-w // 4), c))
-    templates = np.kron(templates,
-                        np.ones((1, 4, 4, 1)))[:, :h, :w, :]  # smooth upsample
+                                            -(-w // 4), c)).astype(np.float32)
+    templates = np.kron(templates, np.ones(
+        (1, 4, 4, 1), np.float32))[:, :h, :w, :]  # smooth upsample
     labels = rng.integers(0, num_classes, size=n).astype(np.int32)
-    images = templates[labels].astype(np.float32)
+    images = templates[labels]
     # f32 noise generated directly — a float64 temporary here would
     # triple peak host memory for the ImageNet-shaped set.
     images += 0.35 * rng.standard_normal(images.shape, dtype=np.float32)

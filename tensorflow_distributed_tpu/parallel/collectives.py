@@ -117,12 +117,23 @@ def make_per_shard_grads(mesh: Mesh, seed: int = 0):
         check_vma=False))
 
 
+def _ps_round_trip(mesh: Mesh, stacked_grads: Any) -> Any:
+    """One full ps round-trip on per-shard-stacked grads: device -> host
+    numpy (the gradient "push", mnist_python_m.py:222 / N4's Send),
+    numpy mean (the ps accumulator take_grad), device_put of the
+    averaged grads to every device (the weight "pull")."""
+    host_grads = jax.tree_util.tree_map(np.asarray, stacked_grads)
+    mean_grads = jax.tree_util.tree_map(
+        lambda g: g.mean(axis=0), host_grads)
+    device_grads = jax.tree_util.tree_map(
+        lambda g: jax.device_put(g, NamedSharding(mesh, P())), mean_grads)
+    jax.block_until_ready(device_grads)
+    return device_grads
+
+
 def ps_style_grad_sync(mesh: Mesh, seed: int = 0):
     """The reference's star topology, emulated honestly on TPU hosts.
 
-    Per step: per-shard grads -> host (the gradient "push",
-    mnist_python_m.py:222 / N4's Send), numpy mean (the ps accumulator
-    take_grad), device_put of the averaged grads (the weight "pull").
     Used only by the latency A/B benchmark — this is the baseline the
     psum path beats.
     """
@@ -131,18 +142,42 @@ def ps_style_grad_sync(mesh: Mesh, seed: int = 0):
     def sync(state: TrainState, batch) -> Tuple[Any, float]:
         t0 = time.perf_counter()
         stacked = grad_step(state, batch[0], batch[1])
-        # Host round-trip: device -> numpy ("push to ps").
-        host_grads = jax.tree_util.tree_map(np.asarray, stacked)
-        # ps-side aggregation.
-        mean_grads = jax.tree_util.tree_map(
-            lambda g: g.mean(axis=0), host_grads)
-        # "Pull": re-broadcast averaged grads to every device.
-        device_grads = jax.tree_util.tree_map(
-            lambda g: jax.device_put(g, NamedSharding(mesh, P())), mean_grads)
-        jax.block_until_ready(device_grads)
+        device_grads = _ps_round_trip(mesh, stacked)
         return device_grads, time.perf_counter() - t0
 
     return sync
+
+
+def ps_style_sync_probe(mesh: Mesh, stacked_grads: Any) -> Callable[[], float]:
+    """Time ONLY the sync portion of the ps emulation — the apples-to-
+    apples counterpart of ``allreduce_latency_probe``.
+
+    Input is a per-shard-stacked grads pytree already resident on the
+    mesh (what ``make_per_shard_grads`` produces). One probe call is one
+    full ps round-trip (``_ps_round_trip``): device->host pull of every
+    shard's gradients (the reference's 2x full gradient push over TCP,
+    SURVEY.md §5), host-side numpy mean (the ConditionalAccumulator
+    take_grad, mnist_python_m.py:216-219), and device_put of the
+    averaged result to every device (the weight pull). Grad
+    *computation* is excluded from the timed span, exactly as it is in
+    the allreduce probe.
+
+    jax.Array caches its host copy after the first ``np.asarray``, which
+    would let every timed iteration after the first skip the
+    device->host transfer entirely; each probe call therefore first
+    materializes FRESH device arrays (an untimed on-device identity op)
+    so the pull is genuinely paid every time.
+    """
+    refresh = jax.jit(partial(jax.tree_util.tree_map, lambda g: g + 0))
+
+    def probe() -> float:
+        fresh = refresh(stacked_grads)
+        jax.block_until_ready(fresh)
+        t0 = time.perf_counter()
+        _ps_round_trip(mesh, fresh)
+        return time.perf_counter() - t0
+
+    return probe
 
 
 def allreduce_latency_probe(mesh: Mesh, grads_like: Any) -> Callable[[], float]:

@@ -1,0 +1,60 @@
+"""Tests for the grad-sync latency A/B (BASELINE.json metric).
+
+Checks that (a) both probes run on an 8-device mesh, (b) the ps
+emulation's averaged gradients are numerically identical to the psum
+path's — i.e. the A/B compares two implementations of the *same* sync
+semantics, which is what makes the latency comparison meaningful.
+"""
+
+import jax
+import numpy as np
+import optax
+
+from tensorflow_distributed_tpu.models.cnn import MnistCNN
+from tensorflow_distributed_tpu.parallel.collectives import (
+    allreduce_latency_probe, make_per_shard_grads, ps_style_grad_sync,
+    ps_style_sync_probe)
+from tensorflow_distributed_tpu.parallel.sharding import shard_batch
+from tensorflow_distributed_tpu.train.state import create_train_state
+
+
+def _state_and_batch(mesh):
+    model = MnistCNN(compute_dtype=jax.numpy.float32, dropout_rate=0.0)
+    state = create_train_state(
+        model, optax.adam(1e-3), np.zeros((2, 28, 28, 1), np.float32), mesh)
+    rng = np.random.default_rng(0)
+    n = 2 * mesh.devices.size
+    batch = shard_batch(mesh, (
+        rng.normal(size=(n, 28, 28, 1)).astype(np.float32),
+        rng.integers(0, 10, size=(n,)).astype(np.int32)))
+    return state, batch
+
+
+def test_probes_run_and_time(mesh8):
+    state, batch = _state_and_batch(mesh8)
+    stacked = make_per_shard_grads(mesh8)(state, batch[0], batch[1])
+    jax.block_until_ready(stacked)
+
+    ps = ps_style_sync_probe(mesh8, stacked)
+    ar = allreduce_latency_probe(mesh8, state.params)
+    assert ps() > 0.0
+    assert ar() > 0.0
+
+
+def test_ps_emulation_matches_psum_mean(mesh8):
+    """The ps round-trip and the on-device mean must agree: same sync
+    semantics, different transport — the whole point of the A/B."""
+    state, batch = _state_and_batch(mesh8)
+    sync = ps_style_grad_sync(mesh8)
+    ps_grads, dt = sync(state, batch)
+    assert dt > 0.0
+
+    stacked = make_per_shard_grads(mesh8)(state, batch[0], batch[1])
+    want = jax.tree_util.tree_map(
+        lambda g: np.asarray(g).mean(axis=0), stacked)
+    got = jax.tree_util.tree_map(np.asarray, ps_grads)
+    flat_w = jax.tree_util.tree_leaves(want)
+    flat_g = jax.tree_util.tree_leaves(got)
+    assert len(flat_w) == len(flat_g)
+    for w, g in zip(flat_w, flat_g):
+        np.testing.assert_allclose(w, g, rtol=1e-6, atol=1e-6)
