@@ -36,8 +36,8 @@ from jax.sharding import Mesh
 
 from tensorflow_distributed_tpu.parallel.mesh import (
     AXIS_DATA, AXIS_MODEL, AXIS_SEQ)
-from tensorflow_distributed_tpu.parallel.ring_attention import (
-    full_attention, ring_attention)
+from tensorflow_distributed_tpu.ops.flash_attention import attention
+from tensorflow_distributed_tpu.parallel.ring_attention import ring_attention
 
 
 @dataclasses.dataclass(frozen=True)
@@ -86,7 +86,9 @@ class SelfAttention(nn.Module):
         if self.mesh is not None and self.mesh.shape[AXIS_SEQ] > 1:
             out = ring_attention(q, k, v, self.mesh)
         else:
-            out = full_attention(q, k, v)
+            # Pallas flash kernel on TPU (shard_mapped over dp x tp when
+            # the mesh is partitioned), XLA oracle elsewhere.
+            out = attention(q, k, v, mesh=self.mesh)
         out = nn.DenseGeneral(
             features=cfg.d_model, axis=(-2, -1), use_bias=True,
             kernel_init=nn.with_partitioning(

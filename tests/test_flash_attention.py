@@ -1,0 +1,110 @@
+"""Pallas flash attention vs the XLA oracle (interpret mode on CPU).
+
+The kernel is validated the way SURVEY.md §4 prescribes for everything
+else: run the real code path on the host platform and compare against
+a plain-XLA reference — here ``full_attention``, which is also the
+ring-attention building block, so the two attention paths are pinned
+to each other.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorflow_distributed_tpu.ops.flash_attention import (
+    NEG_INF, attention, flash_attention, supported)
+from tensorflow_distributed_tpu.parallel.ring_attention import full_attention
+
+B, L, H, D = 2, 256, 2, 64
+
+
+def _qkv(seed=0, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(rng.normal(size=(B, L, H, D)), dtype) * 0.5
+    return mk(), mk(), mk()
+
+
+def _causal_mask():
+    neg = jnp.full((L, L), NEG_INF, jnp.float32)
+    return jnp.triu(neg, k=1)[None]
+
+
+def test_forward_matches_oracle():
+    q, k, v = _qkv()
+    got = flash_attention(q, k, v, interpret=True)
+    want = full_attention(q, k, v)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_forward_causal():
+    q, k, v = _qkv(1)
+    got = flash_attention(q, k, v, causal=True, interpret=True)
+    want = full_attention(q, k, v, _causal_mask())
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_grads_match_oracle(causal):
+    q, k, v = _qkv(2)
+    mask = _causal_mask() if causal else None
+
+    def loss_flash(q, k, v):
+        out = flash_attention(q, k, v, causal=causal, interpret=True)
+        return jnp.sum(jnp.sin(out))  # non-uniform cotangents
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.sin(full_attention(q, k, v, mask)))
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for got, want, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(got, want, atol=5e-5, rtol=5e-4,
+                                   err_msg=f"d{name}")
+
+
+def test_supported_gate():
+    assert supported(256, 256, 64)
+    assert supported(200, 256, 64)       # blocks clamp to short seqs
+    assert not supported(250, 256, 64)   # ragged: 250 % 8 != 0
+    assert not supported(768, 256, 64)   # 768 not divisible by bq=512
+    assert not supported(256, 256, 300)  # head dim too large
+
+
+def test_short_seq_clamped_blocks():
+    q = jnp.ones((1, 40, 2, 16), jnp.float32) * 0.1
+    got = flash_attention(q, q, q, interpret=True)
+    np.testing.assert_allclose(got, full_attention(q, q, q),
+                               atol=2e-6, rtol=2e-6)
+
+
+def test_flash_under_shard_map(mesh8):
+    """The multi-device TPU path: kernel shard_mapped over the batch
+    axis (interpret mode on the 8-device CPU mesh)."""
+    from jax.sharding import PartitionSpec as P
+
+    rng = np.random.default_rng(4)
+    mk = lambda: jnp.asarray(rng.normal(size=(8, 256, 2, 32)), jnp.float32)
+    q, k, v = mk(), mk(), mk()
+    spec = P("data", None, None, None)
+    got = jax.jit(jax.shard_map(
+        lambda q, k, v: flash_attention(q, k, v, interpret=True),
+        mesh=mesh8, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False))(q, k, v)
+    np.testing.assert_allclose(got, full_attention(q, k, v),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ragged_seq_raises():
+    q = jnp.ones((1, 600, 2, 16), jnp.float32)
+    with pytest.raises(ValueError, match="divide"):
+        flash_attention(q, q, q, interpret=True)
+
+
+def test_dispatcher_falls_back_off_tpu():
+    # On CPU the dispatcher must route to the XLA path and still be
+    # numerically the oracle (incl. the causal-mask construction).
+    q, k, v = _qkv(3)
+    np.testing.assert_allclose(attention(q, k, v, causal=True),
+                               full_attention(q, k, v, _causal_mask()),
+                               atol=1e-6)
