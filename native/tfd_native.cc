@@ -1,0 +1,268 @@
+// Native host-side data runtime for the TPU framework.
+//
+// The reference's data path is TensorFlow's C++ input pipeline driven
+// from Python (SURVEY.md N13/N14: idx.gz parsing in
+// tensorflow.examples input_data, then a feed_dict host->runtime copy
+// every step). This is our own native equivalent, built for the TPU
+// host: the Python layer stays the orchestrator, but byte-level work
+// (IDX decode, shuffle, gather, u8->f32 normalize) and the
+// double-buffered batch production run here, off the GIL, so the host
+// can keep the chips fed.
+//
+// Exposed as a plain C ABI consumed via ctypes
+// (tensorflow_distributed_tpu/native/runtime.py). No Python.h
+// dependency — the image has no pybind11 and this keeps the build a
+// single g++ invocation.
+
+#include <zlib.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// ----------------------------------------------------------- utilities
+
+uint64_t splitmix64(uint64_t* s) {
+  uint64_t z = (*s += 0x9e3779b97f4a7c15ULL);
+  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+  return z ^ (z >> 31);
+}
+
+void fisher_yates(int64_t* idx, int64_t n, uint64_t* rng) {
+  for (int64_t i = n - 1; i > 0; --i) {
+    int64_t j = static_cast<int64_t>(splitmix64(rng) % (i + 1));
+    int64_t t = idx[i];
+    idx[i] = idx[j];
+    idx[j] = t;
+  }
+}
+
+void parallel_for(int64_t n, int nthreads,
+                  const std::function<void(int64_t, int64_t)>& fn) {
+  if (nthreads <= 1 || n < 2 * nthreads) {
+    fn(0, n);
+    return;
+  }
+  std::vector<std::thread> ts;
+  int64_t chunk = (n + nthreads - 1) / nthreads;
+  for (int t = 0; t < nthreads; ++t) {
+    int64_t lo = t * chunk;
+    int64_t hi = lo + chunk < n ? lo + chunk : n;
+    if (lo >= hi) break;
+    ts.emplace_back(fn, lo, hi);
+  }
+  for (auto& t : ts) t.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// ------------------------------------------------------------ IDX read
+//
+// Reads an IDX file (optionally gzip-compressed — zlib's gzread
+// transparently handles both), as written by the MNIST distribution
+// the reference downloads (mnist_python_m.py:133). Returns 0 on
+// success; caller frees *out_data with tfd_free.
+//
+// dtype codes from the IDX spec: 0x08 u8, 0x09 i8, 0x0B i16, 0x0C i32,
+// 0x0D f32, 0x0E f64.
+
+int tfd_idx_read(const char* path, void** out_data, int64_t* dims,
+                 int* out_ndim, int* out_dtype) {
+  gzFile f = gzopen(path, "rb");
+  if (!f) return -1;
+  unsigned char magic[4];
+  if (gzread(f, magic, 4) != 4 || magic[0] != 0 || magic[1] != 0) {
+    gzclose(f);
+    return -2;
+  }
+  int dtype = magic[2], ndim = magic[3];
+  if (ndim < 1 || ndim > 4) {
+    gzclose(f);
+    return -3;
+  }
+  static const int sizes[16] = {0, 0, 0, 0, 0, 0, 0, 0,
+                                1, 1, 0, 2, 4, 4, 8, 0};
+  int esize = (dtype >= 0 && dtype < 16) ? sizes[dtype] : 0;
+  if (esize == 0) {
+    gzclose(f);
+    return -4;
+  }
+  int64_t total = 1;
+  for (int i = 0; i < ndim; ++i) {
+    unsigned char b[4];
+    if (gzread(f, b, 4) != 4) {
+      gzclose(f);
+      return -5;
+    }
+    dims[i] = (int64_t(b[0]) << 24) | (int64_t(b[1]) << 16) |
+              (int64_t(b[2]) << 8) | int64_t(b[3]);
+    total *= dims[i];
+  }
+  int64_t nbytes = total * esize;
+  unsigned char* buf = static_cast<unsigned char*>(std::malloc(nbytes));
+  if (!buf) {
+    gzclose(f);
+    return -6;
+  }
+  int64_t got = 0;
+  while (got < nbytes) {
+    int chunk = static_cast<int>(
+        nbytes - got > (1 << 28) ? (1 << 28) : nbytes - got);
+    int r = gzread(f, buf + got, chunk);
+    if (r <= 0) {
+      std::free(buf);
+      gzclose(f);
+      return -7;
+    }
+    got += r;
+  }
+  gzclose(f);
+  // IDX multi-byte ints are big-endian; swap on (x86/ARM) little-endian.
+  if (esize > 1) {
+    unsigned char* p = buf;
+    for (int64_t i = 0; i < total; ++i, p += esize) {
+      for (int b = 0; b < esize / 2; ++b) {
+        unsigned char t = p[b];
+        p[b] = p[esize - 1 - b];
+        p[esize - 1 - b] = t;
+      }
+    }
+  }
+  *out_data = buf;
+  *out_ndim = ndim;
+  *out_dtype = dtype;
+  return 0;
+}
+
+void tfd_free(void* p) { std::free(p); }
+
+// --------------------------------------------- threaded gather+convert
+//
+// out[i, :] = src[idx[i], :] * scale, u8 -> f32, fanned across threads.
+// This is the byte-work under the reference's next_batch + feed_dict
+// (mnist_python_m.py:291-294) done natively.
+
+void tfd_gather_u8_f32(const uint8_t* src, int64_t item,
+                       const int64_t* idx, int64_t n, float scale,
+                       float* out, int nthreads) {
+  parallel_for(n, nthreads, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      const uint8_t* s = src + idx[i] * item;
+      float* d = out + i * item;
+      for (int64_t j = 0; j < item; ++j) d[j] = s[j] * scale;
+    }
+  });
+}
+
+// ------------------------------------------------- prefetch ring buffer
+//
+// Background producer thread emitting shuffled (x: f32 [B, item],
+// y: i32 [B]) batches into a bounded queue — the native analog of the
+// double-buffered device feed (data/prefetch.py) on the host side.
+// Epochs reshuffle with a per-epoch derived seed; batches never cross
+// an epoch boundary (drop_last semantics), matching the sharded
+// batcher's contract.
+
+struct Batch {
+  std::vector<float> x;
+  std::vector<int32_t> y;
+};
+
+struct TfdPrefetcher {
+  const uint8_t* images;
+  const int32_t* labels;
+  int64_t n, item, batch;
+  int depth, nthreads;
+  float scale;
+  uint64_t seed;
+
+  std::deque<Batch> queue;
+  std::mutex mu;
+  std::condition_variable cv_put, cv_get;
+  std::atomic<bool> stop{false};
+  std::thread producer;
+
+  void run() {
+    std::vector<int64_t> order(n);
+    uint64_t epoch = 0;
+    while (!stop.load()) {
+      for (int64_t i = 0; i < n; ++i) order[i] = i;
+      uint64_t rng = seed + 0x632be59bd9b4e019ULL * (epoch + 1);
+      fisher_yates(order.data(), n, &rng);
+      for (int64_t off = 0; off + batch <= n && !stop.load();
+           off += batch) {
+        Batch b;
+        b.x.resize(batch * item);
+        b.y.resize(batch);
+        tfd_gather_u8_f32(images, item, order.data() + off, batch, scale,
+                          b.x.data(), nthreads);
+        for (int64_t i = 0; i < batch; ++i)
+          b.y[i] = labels[order[off + i]];
+        std::unique_lock<std::mutex> lk(mu);
+        cv_put.wait(lk, [&] {
+          return stop.load() || static_cast<int>(queue.size()) < depth;
+        });
+        if (stop.load()) return;
+        queue.push_back(std::move(b));
+        cv_get.notify_one();
+      }
+      ++epoch;
+    }
+  }
+};
+
+TfdPrefetcher* tfd_prefetch_create(const uint8_t* images,
+                                   const int32_t* labels, int64_t n,
+                                   int64_t item, int64_t batch, int depth,
+                                   uint64_t seed, int nthreads,
+                                   float scale) {
+  if (batch > n || batch <= 0) return nullptr;
+  auto* p = new TfdPrefetcher();
+  p->images = images;
+  p->labels = labels;
+  p->n = n;
+  p->item = item;
+  p->batch = batch;
+  p->depth = depth > 0 ? depth : 2;
+  p->nthreads = nthreads > 0 ? nthreads : 1;
+  p->scale = scale;
+  p->seed = seed;
+  p->producer = std::thread([p] { p->run(); });
+  return p;
+}
+
+int tfd_prefetch_next(TfdPrefetcher* p, float* x, int32_t* y) {
+  std::unique_lock<std::mutex> lk(p->mu);
+  p->cv_get.wait(lk, [&] { return p->stop.load() || !p->queue.empty(); });
+  if (p->queue.empty()) return -1;
+  Batch b = std::move(p->queue.front());
+  p->queue.pop_front();
+  p->cv_put.notify_one();
+  lk.unlock();
+  std::memcpy(x, b.x.data(), b.x.size() * sizeof(float));
+  std::memcpy(y, b.y.data(), b.y.size() * sizeof(int32_t));
+  return 0;
+}
+
+void tfd_prefetch_destroy(TfdPrefetcher* p) {
+  if (!p) return;
+  p->stop.store(true);
+  p->cv_put.notify_all();
+  p->cv_get.notify_all();
+  if (p->producer.joinable()) p->producer.join();
+  delete p;
+}
+
+}  // extern "C"

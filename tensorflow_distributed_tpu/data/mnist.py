@@ -16,9 +16,12 @@ deliberate upgrades, both flagged in SURVEY.md N13:
    This loader parses idx files already on disk, and falls back to a
    deterministic synthetic digit set in zero-egress environments.
 
-The numpy path below is the reference implementation; a C++ fast path
-for idx parsing + batch gather (``tensorflow_distributed_tpu.native``)
-plugs in underneath it in a later milestone of this round.
+The numpy path below is the reference implementation; the C++ host
+runtime (``tensorflow_distributed_tpu.native``, native/tfd_native.cc)
+currently backs the idx parse here. Its threaded batch gather and
+background prefetch ring buffer require uint8-backed image storage
+and are exercised by tests pending the u8 storage variant of this
+data path.
 """
 
 from __future__ import annotations
@@ -64,6 +67,14 @@ def parse_idx(raw: bytes) -> np.ndarray:
 
 
 def _read_idx_file(path: str) -> np.ndarray:
+    # Fast path: the C++ runtime parses idx(.gz) off the GIL
+    # (native/tfd_native.cc tfd_idx_read); numpy fallback otherwise.
+    from tensorflow_distributed_tpu.native import runtime as native
+    if native.available():
+        try:
+            return native.idx_read(path)
+        except (IOError, KeyError):
+            pass
     opener = gzip.open if path.endswith(".gz") else open
     with opener(path, "rb") as f:
         return parse_idx(f.read())

@@ -1,0 +1,9 @@
+"""Native (C++) host runtime bindings."""
+
+from tensorflow_distributed_tpu.native.runtime import (  # noqa: F401
+    NativePrefetcher,
+    available,
+    gather_u8_f32,
+    idx_read,
+    load_library,
+)

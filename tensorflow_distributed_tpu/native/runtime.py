@@ -1,0 +1,210 @@
+"""ctypes bindings + on-demand build of the native host runtime.
+
+Build model: one `g++ -O3 -shared` invocation of native/tfd_native.cc
+into <repo>/build/libtfd_native.so, (re)run automatically when the
+source is newer than the library. ctypes instead of pybind11 because
+the image ships no pybind11 and the ABI is 6 plain C functions.
+
+Everything here has a pure-Python/numpy fallback (`available()` gates
+call sites), so the framework degrades gracefully on hosts without a
+toolchain — the reference had the same split: Python drives, TF's C++
+does the byte work (SURVEY.md N13/N14).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "tfd_native.cc")
+_BUILD_DIR = os.environ.get("TFD_TPU_BUILD_DIR",
+                            os.path.join(_REPO_ROOT, "build"))
+_LIB = os.path.join(_BUILD_DIR, "libtfd_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+_IDX_DTYPES = {0x08: np.uint8, 0x09: np.int8, 0x0B: np.int16,
+               0x0C: np.int32, 0x0D: np.float32, 0x0E: np.float64}
+
+
+def _build() -> bool:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    # Compile to a per-process temp name and rename into place: rename
+    # is atomic, so concurrent processes (multi-host launch, xdist)
+    # never dlopen a half-written ELF.
+    tmp = f"{_LIB}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
+           "-o", tmp, _SRC, "-lz", "-pthread"]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _LIB)
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError, OSError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def load_library() -> Optional[ctypes.CDLL]:
+    """Build-if-stale and dlopen the native library; None on failure."""
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _load_failed:
+            return None
+        have_src = os.path.exists(_SRC)
+        stale = (not os.path.exists(_LIB)
+                 or (have_src
+                     and os.path.getmtime(_LIB) < os.path.getmtime(_SRC)))
+        if stale and not (have_src and _build()):
+            _load_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            _load_failed = True
+            return None
+
+        c = ctypes
+        lib.tfd_idx_read.restype = c.c_int
+        lib.tfd_idx_read.argtypes = [
+            c.c_char_p, c.POINTER(c.c_void_p), c.POINTER(c.c_int64),
+            c.POINTER(c.c_int), c.POINTER(c.c_int)]
+        lib.tfd_free.restype = None
+        lib.tfd_free.argtypes = [c.c_void_p]
+        lib.tfd_gather_u8_f32.restype = None
+        lib.tfd_gather_u8_f32.argtypes = [
+            c.c_void_p, c.c_int64, c.c_void_p, c.c_int64, c.c_float,
+            c.c_void_p, c.c_int]
+        lib.tfd_prefetch_create.restype = c.c_void_p
+        lib.tfd_prefetch_create.argtypes = [
+            c.c_void_p, c.c_void_p, c.c_int64, c.c_int64, c.c_int64,
+            c.c_int, c.c_uint64, c.c_int, c.c_float]
+        lib.tfd_prefetch_next.restype = c.c_int
+        lib.tfd_prefetch_next.argtypes = [c.c_void_p, c.c_void_p,
+                                          c.c_void_p]
+        lib.tfd_prefetch_destroy.restype = None
+        lib.tfd_prefetch_destroy.argtypes = [c.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load_library() is not None
+
+
+def idx_read(path: str) -> np.ndarray:
+    """Read an IDX(.gz) file natively (SURVEY.md N13's parse step)."""
+    lib = load_library()
+    if lib is None:
+        raise RuntimeError("native runtime unavailable")
+    data = ctypes.c_void_p()
+    dims = (ctypes.c_int64 * 4)()
+    ndim = ctypes.c_int()
+    dtype = ctypes.c_int()
+    rc = lib.tfd_idx_read(path.encode(), ctypes.byref(data), dims,
+                          ctypes.byref(ndim), ctypes.byref(dtype))
+    if rc != 0:
+        raise IOError(f"tfd_idx_read({path}) failed: {rc}")
+    shape = tuple(dims[i] for i in range(ndim.value))
+    np_dtype = _IDX_DTYPES[dtype.value]
+    n = int(np.prod(shape))
+    buf = ctypes.cast(data, ctypes.POINTER(ctypes.c_uint8 * (
+        n * np.dtype(np_dtype).itemsize))).contents
+    # One copy out of the C buffer (which is freed below); writable,
+    # matching parse_idx's contract.
+    arr = np.frombuffer(buf, dtype=np_dtype).reshape(shape).copy()
+    lib.tfd_free(data)
+    return arr
+
+
+def gather_u8_f32(src: np.ndarray, idx: np.ndarray, scale: float,
+                  nthreads: int = 0) -> np.ndarray:
+    """out[i] = src[idx[i]] * scale, threaded in C++."""
+    lib = load_library()
+    if lib is None:
+        return src[idx].astype(np.float32) * scale
+    src = np.ascontiguousarray(src)
+    assert src.dtype == np.uint8
+    item = int(np.prod(src.shape[1:]))
+    idx = np.ascontiguousarray(idx, dtype=np.int64)
+    out = np.empty((len(idx), *src.shape[1:]), np.float32)
+    nthreads = nthreads or min(8, os.cpu_count() or 1)
+    lib.tfd_gather_u8_f32(
+        src.ctypes.data_as(ctypes.c_void_p), item,
+        idx.ctypes.data_as(ctypes.c_void_p), len(idx), scale,
+        out.ctypes.data_as(ctypes.c_void_p), nthreads)
+    return out
+
+
+class NativePrefetcher:
+    """Background-thread shuffled batch producer over (u8 images,
+    i32 labels), the native replacement for the per-step
+    next_batch + feed_dict host work (mnist_python_m.py:291-294).
+
+    Iterates forever (epochs reshuffle, drop-last)."""
+
+    def __init__(self, images: np.ndarray, labels: np.ndarray,
+                 batch: int, *, seed: int = 0, depth: int = 2,
+                 nthreads: int = 0, scale: float = 1.0 / 255.0):
+        lib = load_library()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        self._lib = lib
+        if images.dtype != np.uint8:
+            # Refuse to silently truncate float [0,1] images to zeros.
+            raise TypeError(
+                f"NativePrefetcher wants uint8 image storage, got "
+                f"{images.dtype}; keep the raw bytes and let the scale "
+                f"argument do the normalization")
+        # Keep references: the C side reads these buffers directly.
+        self._images = np.ascontiguousarray(images)
+        self._labels = np.ascontiguousarray(labels, dtype=np.int32)
+        self._item_shape = self._images.shape[1:]
+        self._batch = batch
+        item = int(np.prod(self._item_shape))
+        self._handle = lib.tfd_prefetch_create(
+            self._images.ctypes.data_as(ctypes.c_void_p),
+            self._labels.ctypes.data_as(ctypes.c_void_p),
+            len(self._images), item, batch, depth, seed,
+            nthreads or min(8, os.cpu_count() or 1), scale)
+        if not self._handle:
+            raise ValueError("bad prefetcher config (batch > n?)")
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> Tuple[np.ndarray, np.ndarray]:
+        if not self._handle:  # closed: don't hand ctypes a NULL
+            raise StopIteration
+        x = np.empty((self._batch, *self._item_shape), np.float32)
+        y = np.empty((self._batch,), np.int32)
+        rc = self._lib.tfd_prefetch_next(
+            self._handle, x.ctypes.data_as(ctypes.c_void_p),
+            y.ctypes.data_as(ctypes.c_void_p))
+        if rc != 0:
+            raise StopIteration
+        return x, y
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.tfd_prefetch_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
