@@ -25,6 +25,10 @@ def main() -> None:
     import jax
     import optax
 
+    from tensorflow_distributed_tpu.utils.compilecache import (
+        enable_persistent_cache)
+    enable_persistent_cache()
+
     from tensorflow_distributed_tpu.config import MeshConfig
     from tensorflow_distributed_tpu.data.mnist import synthetic_mnist
     from tensorflow_distributed_tpu.models.cnn import MnistCNN
@@ -55,15 +59,22 @@ def main() -> None:
     it = prefetch_to_mesh(ShardedBatcher(train_ds, global_batch, 0).forever(),
                           mesh, size=2)
 
-    # Compile + warmup outside the timed window.
+    # Compile + warmup outside the timed window. Host readback, not
+    # just block_until_ready — see the barrier note below.
     for _ in range(5):
         state, metrics = step(state, next(it))
+    float(jax.device_get(metrics["loss"]))
     jax.block_until_ready(state.params)
 
     steps = 200
     t0 = time.perf_counter()
     for _ in range(steps):
         state, metrics = step(state, next(it))
+    # Host readback, not just block_until_ready: on tunneled TPU
+    # runtimes the latter can return before remote execution finishes,
+    # inflating throughput; pulling a scalar that depends on the last
+    # step is an honest barrier.
+    float(jax.device_get(metrics["loss"]))
     jax.block_until_ready(state.params)
     dt = time.perf_counter() - t0
 

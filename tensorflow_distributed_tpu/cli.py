@@ -26,9 +26,12 @@ from typing import Optional, Sequence
 from tensorflow_distributed_tpu.config import parse_args
 from tensorflow_distributed_tpu.parallel.mesh import is_chief
 from tensorflow_distributed_tpu.train.loop import train
+from tensorflow_distributed_tpu.utils.compilecache import (
+    enable_persistent_cache)
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
+    enable_persistent_cache()
     cfg = parse_args(argv)
     result = train(cfg)
     if is_chief():

@@ -115,6 +115,15 @@ class TrainConfig:
     resume: bool = False
     keep_checkpoints: int = 3
 
+    # --- profiling -------------------------------------------------------
+    # Non-empty: the chief captures a jax.profiler trace of steps
+    # [profile_start_step, profile_start_step + profile_num_steps) into
+    # this dir (TensorBoard/Perfetto XPlane). The reference's only
+    # "profiler" was wall-clock prints (SURVEY.md §5).
+    profile_dir: str = ""
+    profile_start_step: int = 10
+    profile_num_steps: int = 5
+
     # --- misc ------------------------------------------------------------
     seed: int = 0
 

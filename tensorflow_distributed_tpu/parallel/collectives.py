@@ -127,7 +127,12 @@ def _ps_round_trip(mesh: Mesh, stacked_grads: Any) -> Any:
         lambda g: g.mean(axis=0), host_grads)
     device_grads = jax.tree_util.tree_map(
         lambda g: jax.device_put(g, NamedSharding(mesh, P())), mean_grads)
+    # Same dependent-scalar readback the allreduce probe uses: on
+    # tunneled runtimes block_until_ready alone can return before the
+    # pull lands, which would undertime the ps side of the A/B.
     jax.block_until_ready(device_grads)
+    leaf = jax.tree_util.tree_leaves(device_grads)[0]
+    float(jax.device_get(jax.numpy.ravel(leaf)[0]))
     return device_grads
 
 
@@ -172,7 +177,11 @@ def ps_style_sync_probe(mesh: Mesh, stacked_grads: Any) -> Callable[[], float]:
 
     def probe() -> float:
         fresh = refresh(stacked_grads)
-        jax.block_until_ready(fresh)
+        # Same honest barrier as the allreduce probe: make sure the
+        # refresh op has truly finished before t0, or its execution
+        # would be charged to the timed ps round-trip.
+        leaf = jax.tree_util.tree_leaves(fresh)[0]
+        float(jax.device_get(jax.numpy.ravel(leaf)[0]))
         t0 = time.perf_counter()
         _ps_round_trip(mesh, fresh)
         return time.perf_counter() - t0
@@ -190,7 +199,12 @@ def allreduce_latency_probe(mesh: Mesh, grads_like: Any) -> Callable[[], float]:
     def probe() -> float:
         t0 = time.perf_counter()
         out = psum(grads_like)
-        jax.block_until_ready(out)
+        # Host readback of a dependent scalar: on tunneled TPU runtimes
+        # block_until_ready can return before remote execution finishes,
+        # which would make this probe dishonestly fast vs the ps side
+        # (whose device_get is a real barrier).
+        leaf = jax.tree_util.tree_leaves(out)[0]
+        float(jax.device_get(jax.numpy.ravel(leaf)[0]))
         return time.perf_counter() - t0
 
     return probe

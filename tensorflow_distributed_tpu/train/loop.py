@@ -29,6 +29,7 @@ from tensorflow_distributed_tpu.train.state import (
 from tensorflow_distributed_tpu.train.step import make_eval_step, make_train_step
 from tensorflow_distributed_tpu.train.tasks import Task, make_task
 from tensorflow_distributed_tpu.utils.logging import MetricLogger, Timer
+from tensorflow_distributed_tpu.utils.profiling import StepProfiler
 
 
 @dataclasses.dataclass
@@ -128,15 +129,21 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
     # XLA:CPU rendezvous aborts after 40s); a 2-deep window preserves the
     # host/device overlap that hides dispatch latency.
     inflight = collections.deque()
+    profiler = StepProfiler(
+        log_dir=cfg.profile_dir if is_chief() else "",
+        start_step=cfg.profile_start_step,
+        num_steps=cfg.profile_num_steps)
 
     with Timer() as train_t:
         for i in range(start_step + steps_done, cfg.train_steps):
+            profiler.observe(i + 1, pending=metrics)
             state, metrics = step_fn(state, next(it))
             inflight.append(metrics)
             if len(inflight) > 2:
                 jax.block_until_ready(inflight.popleft())
             cadence(i + 1, state, metrics)
         jax.block_until_ready(state.params)
+    profiler.stop(pending=metrics)
 
     with Timer() as eval_t:
         final = evaluate(state, eval_fn, task, mesh, cfg.eval_batch_size)

@@ -1,0 +1,80 @@
+"""Tracing/profiling subsystem.
+
+The reference had none — its only observability was `time.time()`
+deltas around the train and validation loops (SURVEY.md §5 "tracing:
+none"; mnist_python_m.py:285-307, mnist_single.py:102,119-134). Here
+profiling is a first-class switch: a step-windowed `jax.profiler`
+trace (XPlane/TensorBoard format, viewable in Perfetto) captures the
+XLA execution timeline — per-op device time, HBM traffic, and the ICI
+collectives that replaced the reference's gRPC ps round-trip.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+from typing import Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named span that shows up on the host timeline of a trace."""
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+@dataclasses.dataclass
+class StepProfiler:
+    """Trace a window of steps: [start_step, start_step + num_steps).
+
+    Inactive (zero overhead) when ``log_dir`` is empty. Only the chief
+    process traces — one XPlane per job, like one `performance` table
+    per job in the reference.
+    """
+
+    log_dir: str = ""
+    start_step: int = 10
+    num_steps: int = 5
+    _running: bool = dataclasses.field(default=False, init=False)
+
+    def observe(self, step: int, pending=None) -> None:
+        """Call once per step with the just-issued step number.
+
+        ``pending``: device values the last traced step produced (e.g.
+        the metrics dict). The training loop dispatches steps
+        asynchronously, so without draining them before stop_trace the
+        XPlane would be missing the tail of the traced window.
+        """
+        if not self.log_dir:
+            return
+        in_window = (self.start_step <= step
+                     < self.start_step + self.num_steps)
+        if not self._running and in_window:
+            # Window test, not equality: a resumed run whose first step
+            # is already past start_step still gets (the tail of) a trace.
+            jax.profiler.start_trace(self.log_dir)
+            self._running = True
+        elif self._running and step >= self.start_step + self.num_steps:
+            self.stop(pending)
+
+    def stop(self, pending=None) -> None:
+        if self._running:
+            if pending is not None:
+                jax.device_get(pending)  # drain in-flight traced steps
+            jax.profiler.stop_trace()
+            self._running = False
+
+
+@contextlib.contextmanager
+def trace(log_dir: Optional[str]) -> Iterator[None]:
+    """Whole-span trace: ``with trace('/tmp/tb'): run()``."""
+    if not log_dir:
+        yield
+        return
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -24,6 +24,13 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+from tensorflow_distributed_tpu.utils.compilecache import (  # noqa: E402
+    enable_persistent_cache)
+
+# CPU test compiles of 8-device SPMD programs are the suite's wall-clock;
+# cache them across runs.
+enable_persistent_cache()
+
 
 @pytest.fixture(scope="session")
 def devices8():
