@@ -37,8 +37,8 @@ def main() -> None:
     from tensorflow_distributed_tpu.train.state import create_train_state
     from tensorflow_distributed_tpu.train.step import make_train_step
 
-    from tensorflow_distributed_tpu.data.mnist import ShardedBatcher
     from tensorflow_distributed_tpu.data.prefetch import prefetch_to_mesh
+    from tensorflow_distributed_tpu.data.u8 import U8Dataset, U8ShardedBatcher
 
     n_dev = len(jax.devices())
     mesh = make_mesh(MeshConfig(data=n_dev))
@@ -56,8 +56,9 @@ def main() -> None:
     # pipeline (gather + device_put, double-buffered) exactly as in
     # training — not a device-resident compute-only loop. (The reference
     # likewise paid its feed_dict path every step.)
-    it = prefetch_to_mesh(ShardedBatcher(train_ds, global_batch, 0).forever(),
-                          mesh, size=2)
+    batcher = U8ShardedBatcher(U8Dataset.from_float(train_ds),
+                               global_batch, 0)
+    it = prefetch_to_mesh(batcher.forever(), mesh, size=2)
 
     # Compile + warmup outside the timed window. Host readback, not
     # just block_until_ready — see the barrier note below.

@@ -70,6 +70,9 @@ class TrainConfig:
     # is what reaches the >=99% target the reference never hits
     # (performance:6 tops out at 95.75%).
     init_scheme: str = "improved"  # improved | reference
+    # Transformer-family size preset ("base"/"small"/"tiny"); empty =
+    # the family's default. Ignored by models without presets.
+    model_size: str = ""
     dropout_rate: float = 0.25  # reference keep_prob 0.75 fed as literal
     # (mnist_python_m.py:292, mnist_single.py:112)
 
@@ -82,6 +85,11 @@ class TrainConfig:
     # (mnist_python_m.py:70, replicas_to_aggregate :62-65).
     batch_size: int = 256
     shuffle_seed: int = 0
+    # "u8_native": keep images as uint8 and gather batches with the C++
+    # threaded gather (data/u8.py; falls back to numpy without a
+    # toolchain). Same deterministic sample stream either way; "numpy"
+    # stays the default so results don't depend on the host toolchain.
+    data_backend: str = "numpy"  # numpy | u8_native
 
     # --- optimization ----------------------------------------------------
     optimizer: str = "adam"  # reference: AdamOptimizer, mnist_python_m.py:208
@@ -138,6 +146,8 @@ class TrainConfig:
             raise ValueError(f"unknown init_scheme {self.init_scheme!r}")
         if self.compute_dtype not in ("bfloat16", "float32"):
             raise ValueError(f"unknown compute_dtype {self.compute_dtype!r}")
+        if self.data_backend not in ("numpy", "u8_native"):
+            raise ValueError(f"unknown data_backend {self.data_backend!r}")
         if self.resume and not self.checkpoint_dir:
             raise ValueError("resume=True requires checkpoint_dir")
         self.mesh.validate()

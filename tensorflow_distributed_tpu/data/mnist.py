@@ -18,10 +18,9 @@ deliberate upgrades, both flagged in SURVEY.md N13:
 
 The numpy path below is the reference implementation; the C++ host
 runtime (``tensorflow_distributed_tpu.native``, native/tfd_native.cc)
-currently backs the idx parse here. Its threaded batch gather and
-background prefetch ring buffer require uint8-backed image storage
-and are exercised by tests pending the u8 storage variant of this
-data path.
+backs the idx parse here and the threaded batch gather in the
+uint8-storage variant of this data path (data/u8.py, selected with
+``data_backend="u8_native"`` or used directly by bench.py).
 """
 
 from __future__ import annotations

@@ -1,0 +1,70 @@
+"""uint8-backed dataset storage + natively-gathered batching.
+
+The reference's loader kept MNIST as float arrays after parsing
+(SURVEY.md N13) and paid a fresh float gather + feed_dict copy per step
+(N14). This variant keeps uint8 bytes resident (4x less steady-state
+host RAM; the transient peak still pays the float parse until the
+loaders grow a direct-to-u8 path) and materializes each batch with the
+C++ threaded gather
+(native/tfd_native.cc::tfd_gather_u8_f32) — u8 -> f32 normalize fanned
+across host cores, off the GIL — falling back to numpy where the
+native library is unavailable.
+
+Batch *order* comes from the shared ``data.batcher.Batcher``
+permutation, so the sample stream is bit-identical to the float
+``ShardedBatcher``'s regardless of backend; only the gather mechanics
+differ. (The C++ ``NativePrefetcher`` with its own shuffle is for
+throughput paths that don't need the deterministic stream.)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from tensorflow_distributed_tpu.data.batcher import Batcher
+from tensorflow_distributed_tpu.data.mnist import Dataset
+
+
+@dataclasses.dataclass
+class U8Dataset:
+    """images uint8 [N, ...]; labels int32 [N]; float = u8 * scale."""
+
+    images: np.ndarray
+    labels: np.ndarray
+    scale: float = 1.0 / 255.0
+    name: str = "u8"
+
+    def __post_init__(self):
+        assert self.images.dtype == np.uint8
+        assert self.images.shape[0] == self.labels.shape[0]
+
+    def __len__(self) -> int:
+        return self.images.shape[0]
+
+    @classmethod
+    def from_float(cls, ds: Dataset) -> "U8Dataset":
+        """Quantize a float [0,1] Dataset to u8 storage. Lossless for
+        data that was u8 on disk (real MNIST/CIFAR); <=0.5/255 rounding
+        error for synthetic floats."""
+        u8 = np.clip(np.rint(ds.images * 255.0), 0, 255).astype(np.uint8)
+        return cls(u8, np.ascontiguousarray(ds.labels, np.int32),
+                   name=ds.name)
+
+    def gather(self, idx: np.ndarray):
+        from tensorflow_distributed_tpu.native import runtime as native
+        images = native.gather_u8_f32(self.images, idx, self.scale)
+        return images, self.labels[idx]
+
+
+class U8ShardedBatcher(Batcher):
+    """Same stream contract as data.mnist.ShardedBatcher, native gather."""
+
+    def __init__(self, ds: U8Dataset, global_batch: int, seed: int = 0,
+                 num_processes: int = 1, process_index: int = 0):
+        self.ds = ds
+        super().__init__(n_items=len(ds), global_batch=global_batch,
+                         gather=ds.gather, seed=seed,
+                         num_processes=num_processes,
+                         process_index=process_index)

@@ -47,10 +47,18 @@ def _make_vision_task(cfg: TrainConfig, mesh: Mesh) -> Task:
     from tensorflow_distributed_tpu.data import ShardedBatcher, load_dataset
 
     train_ds, val_ds, _ = load_dataset(cfg.dataset, cfg.data_dir, cfg.seed)
-    batcher = ShardedBatcher(
-        train_ds, cfg.batch_size, cfg.shuffle_seed,
-        num_processes=jax.process_count(),
-        process_index=jax.process_index())
+    if cfg.data_backend == "u8_native":
+        from tensorflow_distributed_tpu.data.u8 import (
+            U8Dataset, U8ShardedBatcher)
+        batcher = U8ShardedBatcher(
+            U8Dataset.from_float(train_ds), cfg.batch_size,
+            cfg.shuffle_seed, num_processes=jax.process_count(),
+            process_index=jax.process_index())
+    else:
+        batcher = ShardedBatcher(
+            train_ds, cfg.batch_size, cfg.shuffle_seed,
+            num_processes=jax.process_count(),
+            process_index=jax.process_index())
 
     def eval_batches(batch: int) -> Iterator[Any]:
         n = (len(val_ds) // batch) * batch
@@ -88,16 +96,22 @@ def mlm_batch_shardings(mesh: Mesh) -> Dict[str, NamedSharding]:
     return {"tokens": s, "targets": s, "mask": s}
 
 
-def _make_mlm_task(cfg: TrainConfig, mesh: Mesh,
-                   seq_len: int = 128, vocab_size: int = 64) -> Task:
-    from tensorflow_distributed_tpu.data.lm import LmBatcher, synthetic_mlm
+def _make_lm_task(cfg: TrainConfig, mesh: Mesh, objective: str,
+                  seq_len: int = 128, vocab_size: int = 64) -> Task:
+    """Shared LM task body; ``objective``: "mlm" (masked positions) or
+    "clm" (next-token). Both use the {tokens, targets, mask} layout and
+    the same masked-CE loss — what differs is the data generator and
+    the model's attention direction (TransformerConfig.causal)."""
+    from tensorflow_distributed_tpu.data.lm import (
+        LmBatcher, synthetic_clm, synthetic_mlm)
 
+    gen = synthetic_clm if objective == "clm" else synthetic_mlm
     n = max(16 * cfg.batch_size, 4096)
-    train_ds = synthetic_mlm(n=n, seq_len=seq_len, vocab_size=vocab_size,
-                             seed=cfg.seed)
-    val_ds = synthetic_mlm(n=max(4 * cfg.eval_batch_size, 512),
-                           seq_len=seq_len, vocab_size=vocab_size,
-                           seed=cfg.seed + 1)
+    train_ds = gen(n=n, seq_len=seq_len, vocab_size=vocab_size,
+                   seed=cfg.seed)
+    val_ds = gen(n=max(4 * cfg.eval_batch_size, 512),
+                 seq_len=seq_len, vocab_size=vocab_size,
+                 seed=cfg.seed + 1)
     batcher = LmBatcher(train_ds, cfg.batch_size, cfg.shuffle_seed,
                         num_processes=jax.process_count(),
                         process_index=jax.process_index())
@@ -108,15 +122,18 @@ def _make_mlm_task(cfg: TrainConfig, mesh: Mesh,
             yield val_ds.batch(np.arange(lo, lo + batch))
 
     return Task(
-        name="mlm", loss=mlm_loss, batch_shardings=mlm_batch_shardings(mesh),
+        name=objective, loss=mlm_loss,
+        batch_shardings=mlm_batch_shardings(mesh),
         sample_input=np.zeros((2, seq_len), np.int32), seq_axis=1,
         train_stream=batcher.forever, eval_batches=eval_batches,
         eval_size=len(val_ds), steps_per_epoch=batcher.steps_per_epoch)
 
 
 def make_task(cfg: TrainConfig, mesh: Mesh) -> Task:
-    """Model family -> task. bert_mlm trains masked-LM; everything else
-    is image classification."""
+    """Model family -> task. bert_mlm trains masked-LM, gpt_lm trains
+    next-token; everything else is image classification."""
     if cfg.model == "bert_mlm":
-        return _make_mlm_task(cfg, mesh)
+        return _make_lm_task(cfg, mesh, "mlm")
+    if cfg.model == "gpt_lm":
+        return _make_lm_task(cfg, mesh, "clm")
     return _make_vision_task(cfg, mesh)

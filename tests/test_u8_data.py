@@ -1,0 +1,50 @@
+"""u8-backed data path: quantization, native gather parity, stream
+identity with the float batcher."""
+
+import numpy as np
+
+from tensorflow_distributed_tpu.data.mnist import ShardedBatcher, synthetic_mnist
+from tensorflow_distributed_tpu.data.u8 import U8Dataset, U8ShardedBatcher
+
+
+def test_from_float_roundtrip(tiny_data):
+    train, _, _ = tiny_data
+    u8 = U8Dataset.from_float(train)
+    assert u8.images.dtype == np.uint8
+    # Quantization error bounded by half a level.
+    back = u8.images.astype(np.float32) * u8.scale
+    assert float(np.max(np.abs(back - train.images))) <= 0.5 / 255.0 + 1e-6
+
+
+def test_gather_parity_with_numpy(tiny_data):
+    train, _, _ = tiny_data
+    u8 = U8Dataset.from_float(train)
+    idx = np.random.default_rng(0).integers(0, len(u8), size=64)
+    x, y = u8.gather(idx)
+    np.testing.assert_allclose(
+        x, u8.images[idx].astype(np.float32) * u8.scale, atol=1e-7)
+    np.testing.assert_array_equal(y, train.labels[idx])
+
+
+def test_stream_identical_to_float_batcher(tiny_data):
+    """Same Batcher permutation => same sample order, u8 or float."""
+    train, _, _ = tiny_data
+    f = ShardedBatcher(train, global_batch=128, seed=3)
+    u = U8ShardedBatcher(U8Dataset.from_float(train), global_batch=128,
+                         seed=3)
+    fi, ui = f.forever(), u.forever()
+    for _ in range(5):
+        (fx, fy), (ux, uy) = next(fi), next(ui)
+        np.testing.assert_array_equal(fy, uy)
+        assert float(np.max(np.abs(fx - ux))) <= 0.5 / 255.0 + 1e-6
+
+
+def test_sharded_streams_partition(tiny_data):
+    train, _, _ = tiny_data
+    whole = U8ShardedBatcher(U8Dataset.from_float(train), 128, seed=1)
+    parts = [U8ShardedBatcher(U8Dataset.from_float(train), 128, seed=1,
+                              num_processes=4, process_index=p)
+             for p in range(4)]
+    w = next(whole.forever())
+    ps = [next(p.forever()) for p in parts]
+    np.testing.assert_array_equal(w[1], np.concatenate([p[1] for p in ps]))
