@@ -58,6 +58,32 @@ def synthetic_mlm(n: int = 2048, seq_len: int = 128, vocab_size: int = 64,
                      mask=mask.astype(np.float32), vocab_size=vocab_size)
 
 
+def synthetic_clm(n: int = 2048, seq_len: int = 128, vocab_size: int = 64,
+                  seed: int = 0) -> LmDataset:
+    """Synthetic causal-LM data: each sequence is an arithmetic token
+    progression x_t = (start + stride*t) mod V with sparse substitution
+    noise. Predicting x_{t+1} requires inferring the per-sequence
+    stride from earlier tokens — learnable only through (causal)
+    attention, so integration tests show real next-token learning.
+
+    Reuses the {tokens, targets, mask} layout: seq_len+1 tokens are
+    generated so targets (the inputs shifted left one) are genuine
+    continuations at every position — the mask is all-ones.
+    """
+    rng = np.random.default_rng(seed)
+    start = rng.integers(0, vocab_size, size=(n, 1))
+    stride = rng.integers(1, 6, size=(n, 1))
+    t = np.arange(seq_len + 1)[None, :]
+    seq = ((start + stride * t) % vocab_size).astype(np.int32)
+    noise = rng.random((n, seq_len + 1)) < 0.02
+    seq = np.where(noise, rng.integers(0, vocab_size,
+                                       size=(n, seq_len + 1)), seq)
+    seq = seq.astype(np.int32)
+    return LmDataset(tokens=seq[:, :-1], targets=seq[:, 1:],
+                     mask=np.ones((n, seq_len), np.float32),
+                     vocab_size=vocab_size)
+
+
 class LmBatcher(Batcher):
     """{tokens, targets, mask} batches over an LmDataset — the generic
     data.batcher.Batcher with an LM gather."""

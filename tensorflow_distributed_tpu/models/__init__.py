@@ -12,7 +12,7 @@ import jax.numpy as jnp
 
 from tensorflow_distributed_tpu.models.cnn import MnistCNN  # noqa: F401
 
-MODEL_NAMES = ("mnist_cnn", "resnet20", "resnet50", "bert_mlm")
+MODEL_NAMES = ("mnist_cnn", "resnet20", "resnet50", "bert_mlm", "gpt_lm")
 
 
 def build_model(name: str, mesh=None, dropout_rate: Optional[float] = None,
@@ -29,6 +29,8 @@ def build_model(name: str, mesh=None, dropout_rate: Optional[float] = None,
     """
     from tensorflow_distributed_tpu.models import cnn, resnet, transformer
 
+    if name not in ("bert_mlm", "gpt_lm"):
+        overrides.pop("size", None)  # presets are transformer-family only
     if name == "mnist_cnn":
         kw = dict(init_scheme=init_scheme, compute_dtype=compute_dtype)
         if dropout_rate is not None:
@@ -43,4 +45,9 @@ def build_model(name: str, mesh=None, dropout_rate: Optional[float] = None,
             overrides.setdefault("dropout_rate", dropout_rate)
         overrides.setdefault("compute_dtype", compute_dtype)
         return transformer.bert_base_mlm(mesh=mesh, **overrides)
+    if name == "gpt_lm":
+        if dropout_rate is not None:
+            overrides.setdefault("dropout_rate", dropout_rate)
+        overrides.setdefault("compute_dtype", compute_dtype)
+        return transformer.gpt_lm(mesh=mesh, **overrides)
     raise ValueError(f"unknown model {name!r}; have {sorted(MODEL_NAMES)}")

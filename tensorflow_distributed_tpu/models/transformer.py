@@ -51,6 +51,7 @@ class TransformerConfig:
     dropout_rate: float = 0.1
     compute_dtype: Any = jnp.bfloat16
     remat: bool = False              # jax.checkpoint each block
+    causal: bool = False             # autoregressive (GPT) vs bidirectional
 
 
 def bert_base_config(**overrides) -> TransformerConfig:
@@ -84,11 +85,11 @@ class SelfAttention(nn.Module):
             dtype=cfg.compute_dtype, name="qkv")(x)
         q, k, v = (qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :])
         if self.mesh is not None and self.mesh.shape[AXIS_SEQ] > 1:
-            out = ring_attention(q, k, v, self.mesh)
+            out = ring_attention(q, k, v, self.mesh, causal=cfg.causal)
         else:
             # Pallas flash kernel on TPU (shard_mapped over dp x tp when
             # the mesh is partitioned), XLA oracle elsewhere.
-            out = attention(q, k, v, mesh=self.mesh)
+            out = attention(q, k, v, causal=cfg.causal, mesh=self.mesh)
         out = nn.DenseGeneral(
             features=cfg.d_model, axis=(-2, -1), use_bias=True,
             kernel_init=nn.with_partitioning(
@@ -136,18 +137,23 @@ class Block(nn.Module):
         return x + y
 
 
-class BertMLM(nn.Module):
-    """Encoder-only masked-LM: tokens [B, L] int32 -> logits [B, L, V]."""
+class TransformerLM(nn.Module):
+    """Transformer LM backbone: tokens [B, L] int32 -> logits [B, L, V].
+
+    ``extra_vocab`` widens the input embedding only (BERT's [MASK]
+    sentinel); ``cfg.causal`` selects autoregressive attention (the GPT
+    family) vs bidirectional (BERT)."""
 
     cfg: TransformerConfig
     mesh: Optional[Mesh] = None
+    extra_vocab: int = 0
 
     @nn.compact
     def __call__(self, tokens: jax.Array, *, train: bool = False
                  ) -> jax.Array:
         cfg = self.cfg
         B, L = tokens.shape
-        emb = nn.Embed(cfg.vocab_size + 1, cfg.d_model,  # +1: [MASK] id
+        emb = nn.Embed(cfg.vocab_size + self.extra_vocab, cfg.d_model,
                        embedding_init=_dense_init(), name="tok_emb")
         x = emb(tokens)
         pos = nn.Embed(cfg.max_len, cfg.d_model,
@@ -178,14 +184,48 @@ class BertMLM(nn.Module):
         return logits.astype(jnp.float32)
 
 
+class BertMLM(TransformerLM):
+    """Encoder-only masked-LM (bidirectional, +[MASK] sentinel)."""
+
+    extra_vocab: int = 1
+
+
+class CausalLM(TransformerLM):
+    """Decoder-only autoregressive LM (the GPT family). Construct with
+    a ``causal=True`` config (the factories below enforce it)."""
+
+
 def bert_base_mlm(mesh: Optional[Mesh] = None, size: str = "base",
                   **overrides) -> BertMLM:
     """Factory for the registry. ``size``: "base" (BERT-base) or "tiny"
     (test scale); ``overrides`` are TransformerConfig fields."""
-    cfg = bert_base_config(**overrides) if size == "base" else tiny_config(
-        **overrides)
+    if size == "base":
+        cfg = bert_base_config(**overrides)
+    elif size == "tiny":
+        cfg = tiny_config(**overrides)
+    else:
+        raise ValueError(f"bert_mlm size {size!r}; have ('base', 'tiny')")
     return BertMLM(cfg, mesh)
 
 
 def bert_tiny_mlm(mesh: Optional[Mesh] = None, **overrides) -> BertMLM:
     return BertMLM(tiny_config(**overrides), mesh)
+
+
+def gpt_lm(mesh: Optional[Mesh] = None, size: str = "small",
+           **overrides) -> CausalLM:
+    """GPT-style decoder-only LM. ``size``: "small" (GPT-2-small-ish:
+    12L x 768d x 12H, learned positions, pre-LN) or "tiny" (test scale).
+    No reference counterpart (the reference has no sequence models,
+    SURVEY.md §5) — designed TPU-first like the rest of this family."""
+    overrides["causal"] = True
+    if size == "small":
+        cfg = dataclasses.replace(
+            TransformerConfig(vocab_size=50257, d_model=768, n_layers=12,
+                              n_heads=12, d_ff=3072, max_len=1024),
+            **overrides)
+    elif size == "tiny":
+        cfg = tiny_config(**overrides)
+    else:
+        raise ValueError(f"gpt_lm size {size!r}; have ('small', 'tiny')")
+    return CausalLM(cfg, mesh)

@@ -346,7 +346,8 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array,
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False)(q, k, v)
     if causal:
-        neg = jnp.full((L, k.shape[1]), NEG_INF, jnp.float32)
-        cmask = jnp.triu(neg, k=1)[None]
+        from tensorflow_distributed_tpu.parallel.ring_attention import (
+            causal_bias)
+        cmask = causal_bias(L, k.shape[1])
         mask = cmask if mask is None else mask + cmask
     return full_attention(q, k, v, mask)

@@ -32,6 +32,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 from tensorflow_distributed_tpu.parallel.mesh import AXIS_DATA, AXIS_MODEL, AXIS_SEQ
 
 
+_MASK = -1e30  # large-finite additive mask (matches ops.flash_attention)
+
+
 def _block_attend(q, k, v, bias):
     """One Q-block vs one K,V-block partial attention.
 
@@ -45,7 +48,10 @@ def _block_attend(q, k, v, bias):
     s = s * (1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32)))
     if bias is not None:
         s = s + bias[:, None, :, :]
-    m = jnp.max(s, axis=-1)                      # [B,H,Lq]
+    # Clamp the row max away from the mask value so a fully-masked row
+    # (a skipped causal ring block) yields p == exp(-huge) == 0 and a
+    # zero l contribution, instead of exp(0) == 1 garbage.
+    m = jnp.maximum(jnp.max(s, axis=-1), 0.1 * _MASK)  # [B,H,Lq]
     p = jnp.exp(s - m[..., None])                # [B,H,Lq,Lk]
     l = jnp.sum(p, axis=-1)                      # [B,H,Lq]
     o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
@@ -64,43 +70,76 @@ def _merge(m1, l1, o1, m2, l2, o2):
     return m, l, o
 
 
+def causal_bias(Lq: int, Lk: int) -> jax.Array:
+    """[1, Lq, Lk] additive causal mask — the ONE construction shared by
+    the ring path, the flash-attention dispatcher, and the test oracles
+    (keep the mask constant in a single place)."""
+    return jnp.triu(jnp.full((Lq, Lk), _MASK, jnp.float32), k=1)[None]
+
+
 def full_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    mask: Optional[jax.Array] = None) -> jax.Array:
     """Plain exact attention (the mesh.seq == 1 path and the test
-    oracle). q,k,v: [B, L, H, D]; mask: [B, L, L] additive or None."""
+    oracle). q,k,v: [B, L, H, D]; mask: [B, L, L] additive or None.
+    A fully-masked query row returns zeros (not NaN)."""
     m, l, o = _block_attend(q, k, v, mask)
-    out = o / l.transpose(0, 2, 1)[..., None]
+    l_safe = jnp.maximum(l, jnp.finfo(jnp.float32).tiny)
+    out = o / l_safe.transpose(0, 2, 1)[..., None]
     return out.astype(q.dtype)
 
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                   mesh: Mesh, mask: Optional[jax.Array] = None) -> jax.Array:
+                   mesh: Mesh, mask: Optional[jax.Array] = None,
+                   causal: bool = False) -> jax.Array:
     """Exact attention with the sequence axis sharded over mesh "seq".
 
     q,k,v are GLOBAL [B, L, H, D] arrays (call under jit; the seq axis
-    carries the "seq" sharding). Non-causal (bidirectional — the BERT
-    MLM case). ``mask`` is not yet supported with S > 1 ring steps.
+    carries the "seq" sharding). ``causal=True`` applies the
+    autoregressive mask across the ring: at ring step s, device i holds
+    the K,V block of device (i - s) mod S, so the in-block bias is built
+    from the global row/col offsets i*L_loc and src*L_loc; blocks
+    entirely in the future are fully masked and contribute a zero
+    partial (see the clamp in _block_attend). Every device still visits
+    every block — ~2x the minimal causal FLOPs; a load-balanced zigzag
+    schedule is a profiling-driven follow-up. Arbitrary ``mask`` is not
+    supported with S > 1 ring steps.
 
     Degenerate 1-shard ring: identical to full_attention.
     """
     seq_size = mesh.shape[AXIS_SEQ]
     if seq_size == 1:
+        if causal:
+            cmask = causal_bias(q.shape[1], k.shape[1])
+            mask = cmask if mask is None else mask + cmask
         return full_attention(q, k, v, mask)
     if mask is not None:
-        raise NotImplementedError("masked ring attention lands with the "
-                                  "causal-LM family")
+        raise NotImplementedError(
+            "arbitrary masks don't survive the ring rotation; only "
+            "causal=True is supported with a sharded seq axis")
 
     spec = P(AXIS_DATA, AXIS_SEQ, AXIS_MODEL, None)
 
     def per_shard(q_blk, k_blk, v_blk):
         # q_blk etc: [B/dp, L/S, H/tp, D] local blocks.
-        m, l, o = _block_attend(q_blk, k_blk, v_blk, None)
+        i = jax.lax.axis_index(AXIS_SEQ)
+        l_loc = q_blk.shape[1]
+        rows = jax.lax.broadcasted_iota(jnp.int32, (l_loc, l_loc), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (l_loc, l_loc), 1)
+
+        def bias_for(src):
+            if not causal:
+                return None
+            allowed = (i * l_loc + rows) >= (src * l_loc + cols)
+            return jnp.where(allowed, 0.0, _MASK)[None]  # [1, Lq, Lk]
+
+        m, l, o = _block_attend(q_blk, k_blk, v_blk, bias_for(i))
         k_rot, v_rot = k_blk, v_blk
-        perm = [(i, (i + 1) % seq_size) for i in range(seq_size)]
-        for _ in range(seq_size - 1):
+        perm = [(d, (d + 1) % seq_size) for d in range(seq_size)]
+        for s in range(1, seq_size):
             k_rot = jax.lax.ppermute(k_rot, AXIS_SEQ, perm)
             v_rot = jax.lax.ppermute(v_rot, AXIS_SEQ, perm)
-            m2, l2, o2 = _block_attend(q_blk, k_rot, v_rot, None)
+            src = (i - s) % seq_size
+            m2, l2, o2 = _block_attend(q_blk, k_rot, v_rot, bias_for(src))
             m, l, o = _merge(m, l, o, m2, l2, o2)
         out = o / l.transpose(0, 2, 1)[..., None]
         return out.astype(q_blk.dtype)

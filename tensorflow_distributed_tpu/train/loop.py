@@ -74,11 +74,13 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
     mesh = make_mesh(cfg.mesh)
     task = make_task(cfg, mesh)
 
+    size_kw = {"size": cfg.model_size} if cfg.model_size else {}
     model = build_model(
         cfg.model, mesh=mesh, dropout_rate=cfg.dropout_rate,
         init_scheme=cfg.init_scheme,
         compute_dtype=jax.numpy.bfloat16
-        if cfg.compute_dtype == "bfloat16" else jax.numpy.float32)
+        if cfg.compute_dtype == "bfloat16" else jax.numpy.float32,
+        **size_kw)
     tx = make_optimizer(cfg)
     state = create_train_state(model, tx, task.sample_input, mesh, cfg.seed)
 

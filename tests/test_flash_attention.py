@@ -26,8 +26,8 @@ def _qkv(seed=0, dtype=jnp.float32):
 
 
 def _causal_mask():
-    neg = jnp.full((L, L), NEG_INF, jnp.float32)
-    return jnp.triu(neg, k=1)[None]
+    from tensorflow_distributed_tpu.parallel.ring_attention import causal_bias
+    return causal_bias(L, L)
 
 
 def test_forward_matches_oracle():
