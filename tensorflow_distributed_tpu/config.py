@@ -42,16 +42,18 @@ class MeshConfig:
 
     ``data`` is the data-parallel axis (the reference's worker replicas,
     mnist_python_m.py:62-65); ``model`` is tensor parallelism; ``seq`` is
-    sequence/context parallelism (ring attention). A value of -1 for
-    ``data`` means "all remaining devices".
+    sequence/context parallelism (ring attention); ``pipe`` is pipeline
+    parallelism (GPipe microbatch schedule over stage-sharded layers).
+    A value of -1 for ``data`` means "all remaining devices".
     """
 
     data: int = -1
     model: int = 1
     seq: int = 1
+    pipe: int = 1
 
     def validate(self) -> None:
-        for name in ("model", "seq"):
+        for name in ("model", "seq", "pipe"):
             v = getattr(self, name)
             if v < 1:
                 raise ValueError(f"mesh.{name} must be >= 1, got {v}")

@@ -1,0 +1,158 @@
+"""Pipeline-parallel transformer LM.
+
+The transformer block stack as S pipeline stages over the "pipe" mesh
+axis (parallel.pipeline). Embeddings and the LM head run replicated
+outside the pipeline (they're cheap); the block stack — where the
+FLOPs are — runs stage-sharded with the GPipe microbatch schedule.
+
+Unlike models/transformer.py (an nn.Module whose GSPMD sharding comes
+from param metadata), the pipelined variant owns its params as ONE
+stacked pytree (block leaves [n_layers, ...] regrouped to
+[S, layers_per_stage, ...] and pipe-sharded via nn.Partitioned boxes),
+because the pipeline schedule needs to slice stages explicitly inside
+shard_map. It duck-types the flax surface create_train_state/apply_model
+consume: ``init(key, tokens, train=False) -> {"params": ...}`` and
+``apply(variables, tokens, *, train=..., rngs=...)``.
+
+v1 scope: composes with the "data" axis (activations stay
+batch-sharded under GSPMD); "model"/"seq" must be 1 (TP/SP inside a
+pipe-restricted shard_map is a follow-up); dropout is disabled (rng
+plumbing through the scanned schedule isn't wired).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from tensorflow_distributed_tpu.models.transformer import (
+    Block, TransformerConfig, _dense_init, tiny_config)
+from tensorflow_distributed_tpu.parallel.mesh import (
+    AXIS_MODEL, AXIS_PIPE, AXIS_SEQ)
+from tensorflow_distributed_tpu.parallel.pipeline import (
+    pipeline_apply, stack_stage_params)
+
+
+class _Shell(nn.Module):
+    """Embeddings + final LN + LM head — everything outside the pipe."""
+
+    cfg: TransformerConfig
+    extra_vocab: int = 0
+
+    def setup(self):
+        cfg = self.cfg
+        self.tok_emb = nn.Embed(cfg.vocab_size + self.extra_vocab,
+                                cfg.d_model, embedding_init=_dense_init(),
+                                name="tok_emb")
+        self.pos_emb = nn.Embed(cfg.max_len, cfg.d_model,
+                                embedding_init=_dense_init(),
+                                name="pos_emb")
+        self.ln_f = nn.LayerNorm(dtype=jnp.float32, name="ln_f")
+        self.lm_head = nn.Dense(cfg.vocab_size,
+                                kernel_init=_dense_init(),
+                                dtype=cfg.compute_dtype, name="lm_head")
+
+    def embed(self, tokens: jax.Array) -> jax.Array:
+        L = tokens.shape[1]
+        x = self.tok_emb(tokens) + self.pos_emb(jnp.arange(L)[None, :])
+        return x.astype(self.cfg.compute_dtype)
+
+    def head(self, x: jax.Array) -> jax.Array:
+        x = self.ln_f(x).astype(self.cfg.compute_dtype)
+        return self.lm_head(x).astype(jnp.float32)
+
+    def __call__(self, tokens: jax.Array) -> jax.Array:  # init path only
+        return self.head(self.embed(tokens))
+
+
+class PipelinedLM:
+    """Decoder/encoder LM with the block stack pipeline-parallel."""
+
+    def __init__(self, cfg: TransformerConfig, mesh: Mesh,
+                 num_microbatches: int = 4, extra_vocab: int = 0):
+        if cfg.dropout_rate:
+            raise ValueError("pipelined variant: dropout_rate must be 0")
+        if cfg.tp_partitioning:
+            raise ValueError(
+                "pipelined variant needs tp_partitioning=False (flax "
+                "DenseGeneral re-applies the TP constraint inside the "
+                "pipe shard_map; see TransformerConfig.tp_partitioning)")
+        if cfg.use_flash:
+            raise ValueError(
+                "pipelined variant needs use_flash=False (Mosaic calls "
+                "can't sit inside the partial-manual pipe shard_map; "
+                "see TransformerConfig.use_flash)")
+        if mesh.shape[AXIS_MODEL] != 1 or mesh.shape[AXIS_SEQ] != 1:
+            raise ValueError("pipelined variant composes with 'data' "
+                             "only; set mesh model=seq=1")
+        S = mesh.shape[AXIS_PIPE]
+        if cfg.n_layers % S:
+            raise ValueError(
+                f"{cfg.n_layers} layers not divisible by {S} stages")
+        self.cfg = cfg
+        self.mesh = mesh
+        self.num_microbatches = num_microbatches
+        self._shell = _Shell(cfg, extra_vocab)
+        # Blocks see no mesh: inside the pipe-restricted shard_map the
+        # attention dispatcher must not try its own dp/tp shard_map.
+        self._block = Block(cfg, None)
+
+    # -- flax-compatible surface -----------------------------------------
+
+    def init(self, key: jax.Array, tokens: jax.Array,
+             train: bool = False) -> Any:
+        del train
+        cfg = self.cfg
+        k_shell, k_blocks = jax.random.split(key)
+        shell_params = self._shell.init(k_shell, tokens)["params"]
+        x = jnp.zeros((tokens.shape[0], tokens.shape[1], cfg.d_model),
+                      cfg.compute_dtype)
+        layer_keys = jax.random.split(k_blocks, cfg.n_layers)
+        # Unbox inside the vmap: Block's TP partition metadata (rank-N
+        # names) would be stale on the rank-N+2 stacked leaves — the
+        # pipelined variant enforces model=seq=1, so dropping it is
+        # sound; pipe-axis boxes are added below with full-rank names.
+        stacked = jax.vmap(lambda k: nn.meta.unbox(
+            self._block.init(k, x, False)["params"]))(layer_keys)
+        staged = stack_stage_params(stacked,
+                                    self.mesh.shape[AXIS_PIPE])
+        boxed = jax.tree_util.tree_map(
+            lambda p: nn.Partitioned(
+                p, names=(AXIS_PIPE,) + (None,) * (p.ndim - 1)), staged)
+        return {"params": {"shell": shell_params, "blocks": boxed}}
+
+    def apply(self, variables: Any, tokens: jax.Array, *,
+              train: bool = False, rngs: Optional[Any] = None) -> jax.Array:
+        del rngs  # dropout disabled (checked in __init__)
+        p = variables["params"]
+        x = self._shell.apply({"params": p["shell"]}, tokens,
+                              method="embed")
+
+        def stage_fn(stage_params, x_mb):
+            # stage_params leaves: [layers_per_stage, ...]; run the
+            # stage's blocks in order via scan-over-layers.
+            def one_layer(x, layer_p):
+                return self._block.apply({"params": layer_p}, x, False), None
+            y, _ = jax.lax.scan(one_layer, x_mb, stage_params)
+            return y
+
+        x = pipeline_apply(stage_fn, p["blocks"], x, self.mesh,
+                           self.num_microbatches)
+        return self._shell.apply({"params": p["shell"]}, x, method="head")
+
+
+def pipelined_lm(mesh: Mesh, size: str = "tiny", causal: bool = True,
+                 num_microbatches: int = 4, **overrides) -> PipelinedLM:
+    """Registry factory ("pipelined_lm"). Sizes: "tiny" (tests/CI)."""
+    overrides.setdefault("dropout_rate", 0.0)
+    overrides.setdefault("n_layers", 4)  # tiny default (2) < common S
+    overrides["causal"] = causal
+    overrides["tp_partitioning"] = False  # see TransformerConfig notes
+    overrides["use_flash"] = False
+    if size != "tiny":
+        raise ValueError(f"pipelined_lm size {size!r}; have ('tiny',)")
+    return PipelinedLM(tiny_config(**overrides), mesh, num_microbatches)

@@ -33,7 +33,8 @@ from tensorflow_distributed_tpu.config import MeshConfig
 AXIS_DATA = "data"
 AXIS_MODEL = "model"
 AXIS_SEQ = "seq"
-MESH_AXES = (AXIS_DATA, AXIS_SEQ, AXIS_MODEL)
+AXIS_PIPE = "pipe"
+MESH_AXES = (AXIS_DATA, AXIS_PIPE, AXIS_SEQ, AXIS_MODEL)
 
 _bootstrapped = False
 
@@ -86,25 +87,27 @@ def is_chief() -> bool:
 
 def make_mesh(cfg: Optional[MeshConfig] = None,
               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
-    """Build a ``(data, seq, model)`` mesh over the given devices.
+    """Build a ``(data, pipe, seq, model)`` mesh over the given devices.
 
-    ``cfg.data == -1`` means "all devices not consumed by seq/model".
-    A 1-device mesh is valid and is exactly the reference's
-    single-device path (mnist_single.py): same train step, mesh of one.
+    ``cfg.data == -1`` means "all devices not consumed by
+    pipe/seq/model". A 1-device mesh is valid and is exactly the
+    reference's single-device path (mnist_single.py): same train step,
+    mesh of one.
     """
     cfg = cfg or MeshConfig()
     cfg.validate()
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
-    denom = cfg.model * cfg.seq
+    denom = cfg.model * cfg.seq * cfg.pipe
     if n % denom != 0:
         raise ValueError(
-            f"{n} devices not divisible by model*seq = {cfg.model}*{cfg.seq}")
+            f"{n} devices not divisible by pipe*seq*model = "
+            f"{cfg.pipe}*{cfg.seq}*{cfg.model}")
     data = cfg.data if cfg.data != -1 else n // denom
     if data * denom != n:
         raise ValueError(
-            f"mesh {data}x{cfg.seq}x{cfg.model} != {n} devices")
-    arr = np.array(devices).reshape(data, cfg.seq, cfg.model)
+            f"mesh {data}x{cfg.pipe}x{cfg.seq}x{cfg.model} != {n} devices")
+    arr = np.array(devices).reshape(data, cfg.pipe, cfg.seq, cfg.model)
     return Mesh(arr, MESH_AXES)
 
 

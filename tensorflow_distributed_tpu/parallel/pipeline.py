@@ -1,0 +1,100 @@
+"""Pipeline parallelism: GPipe microbatch schedule over the "pipe" axis.
+
+The reference has no pipeline parallelism (single-stage model,
+SURVEY.md §2b checklist) — this is a beyond-reference capability,
+designed TPU-first rather than ported:
+
+- Layer stacks live as ONE stacked pytree (leaves [S, ...], leading dim
+  sharded over the "pipe" mesh axis) instead of per-stage modules —
+  XLA sees one program, each device holding its stage's slice.
+- The schedule is a ``lax.scan`` over T = M + S - 1 ticks inside a
+  ``shard_map`` restricted to the pipe axis (``axis_names={"pipe"}``),
+  so data/tensor/sequence sharding of the activations continues to be
+  handled by the surrounding GSPMD partitioner.
+- Activations hop stage s -> s+1 once per tick via ``lax.ppermute`` —
+  neighbor ICI traffic, the TPU-native analog of NCCL P2P send/recv.
+- Bubble ticks compute on garbage and are masked with ``jnp.where``
+  (predication, not control flow — the compiled program is static).
+  Bubble fraction is the standard (S-1)/(M+S-1).
+
+Everything is differentiable: the backward pipeline falls out of AD
+(scan reverses, ppermute transposes to the opposite rotation).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tensorflow_distributed_tpu.parallel.mesh import AXIS_PIPE
+
+
+def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
+                   stage_params: Any, x: jax.Array, mesh: Mesh,
+                   num_microbatches: int) -> jax.Array:
+    """Run ``x`` through S pipeline stages with an M-microbatch schedule.
+
+    stage_params: pytree whose leaves have leading dim S (sharded
+    ``P("pipe")``); ``stage_fn(one_stage_params, x_mb) -> y_mb`` must
+    preserve the microbatch shape (a transformer block stack does).
+    x: [B, ...] with B % num_microbatches == 0. Returns [B, ...].
+    """
+    S = mesh.shape[AXIS_PIPE]
+    M = num_microbatches
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by microbatches {M}")
+    if M < S:
+        raise ValueError(f"need microbatches >= stages ({M} < {S})")
+    mb = B // M
+
+    def per_pipe(params, x):
+        # Local leaves arrive [1, ...] (this stage's slice); drop the
+        # stage dim.
+        params = jax.tree_util.tree_map(lambda p: p[0], params)
+        s = jax.lax.axis_index(AXIS_PIPE)
+        xm = x.reshape(M, mb, *x.shape[1:])
+        perm = [(i, (i + 1) % S) for i in range(S)]
+
+        def tick(carry, t):
+            state, outs = carry
+            # Stage 0 ingests microbatch t; later stages eat the
+            # activation their neighbor pushed last tick.
+            feed = jax.lax.dynamic_index_in_dim(
+                xm, jnp.clip(t, 0, M - 1), 0, keepdims=False)
+            y = stage_fn(params, jnp.where(s == 0, feed, state))
+            # The last stage commits finished microbatch t-(S-1).
+            oidx = jnp.clip(t - (S - 1), 0, M - 1)
+            prev = jax.lax.dynamic_index_in_dim(outs, oidx, 0,
+                                                keepdims=False)
+            write = jnp.logical_and(s == S - 1, t >= S - 1)
+            outs = jax.lax.dynamic_update_index_in_dim(
+                outs, jnp.where(write, y, prev), oidx, 0)
+            return (jax.lax.ppermute(y, AXIS_PIPE, perm), outs), None
+
+        outs0 = jnp.zeros_like(xm)
+        (_, outs), _ = jax.lax.scan(tick, (jnp.zeros_like(xm[0]), outs0),
+                                    jnp.arange(M + S - 1))
+        # Stage-stacked output: only the last stage's slice is real.
+        return outs.reshape(B, *x.shape[1:])[None]
+
+    out = jax.shard_map(
+        per_pipe, mesh=mesh, axis_names={AXIS_PIPE},
+        in_specs=(P(AXIS_PIPE), P()), out_specs=P(AXIS_PIPE),
+        check_vma=False)(stage_params, x)
+    return out[-1]
+
+
+def stack_stage_params(layer_params: Any, num_stages: int) -> Any:
+    """[n_layers, ...] stacked layer params -> [S, layers_per_stage, ...]
+    stage-major grouping (stage s owns layers [s*Lps, (s+1)*Lps))."""
+    def regroup(p):
+        n = p.shape[0]
+        if n % num_stages:
+            raise ValueError(
+                f"{n} layers not divisible by {num_stages} stages")
+        return p.reshape(num_stages, n // num_stages, *p.shape[1:])
+    return jax.tree_util.tree_map(regroup, layer_params)

@@ -1,0 +1,94 @@
+"""Pipeline parallelism: schedule correctness, gradients, end-to-end."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorflow_distributed_tpu.config import MeshConfig, TrainConfig
+from tensorflow_distributed_tpu.parallel.mesh import make_mesh
+from tensorflow_distributed_tpu.parallel.pipeline import (
+    pipeline_apply, stack_stage_params)
+
+
+def _mesh_pipe4(devices8):
+    return make_mesh(MeshConfig(data=2, pipe=4), devices8)
+
+
+def _mlp_stage(params, x):
+    # One pipeline stage = scan over its layers; each layer a tanh MLP.
+    def layer(x, p):
+        return jnp.tanh(x @ p["w"] + p["b"]), None
+    y, _ = jax.lax.scan(layer, x, params)
+    return y
+
+
+def _stacked_mlp_params(n_layers, d, key):
+    ks = jax.random.split(key, n_layers)
+    return {
+        "w": jnp.stack([jax.random.normal(k, (d, d)) * 0.3 for k in ks]),
+        "b": jnp.zeros((n_layers, d)),
+    }
+
+
+def _sequential(params, x):
+    return _mlp_stage(params, x)  # scan over ALL layers in order
+
+
+@pytest.mark.parametrize("microbatches", [4, 8])
+def test_pipeline_matches_sequential(devices8, microbatches):
+    mesh = _mesh_pipe4(devices8)
+    d, n_layers, B = 16, 8, 32
+    params = _stacked_mlp_params(n_layers, d, jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (B, d))
+    staged = stack_stage_params(params, 4)
+    got = jax.jit(lambda p, x: pipeline_apply(
+        _mlp_stage, p, x, mesh, microbatches))(staged, x)
+    want = _sequential(params, x)
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+def test_pipeline_gradients_match(devices8):
+    mesh = _mesh_pipe4(devices8)
+    d, n_layers, B = 8, 4, 16
+    params = _stacked_mlp_params(n_layers, d, jax.random.key(2))
+    x = jax.random.normal(jax.random.key(3), (B, d))
+
+    def loss_pipe(p, x):
+        staged = stack_stage_params(p, 4)
+        return jnp.sum(jnp.sin(pipeline_apply(_mlp_stage, staged, x,
+                                              mesh, 4)))
+
+    def loss_seq(p, x):
+        return jnp.sum(jnp.sin(_sequential(p, x)))
+
+    gp = jax.jit(jax.grad(loss_pipe))(params, x)
+    gs = jax.grad(loss_seq)(params, x)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=2e-5, rtol=2e-4),
+        gp, gs)
+
+
+def test_pipeline_validates():
+    import jax as j
+    mesh = make_mesh(MeshConfig(data=2, pipe=4), j.devices())
+    x = jnp.zeros((10, 4))
+    p = {"w": jnp.zeros((4, 1, 4, 4))}
+    with pytest.raises(ValueError, match="divisible"):
+        pipeline_apply(_mlp_stage, p, x, mesh, 3)
+    with pytest.raises(ValueError, match="microbatches >= stages"):
+        pipeline_apply(_mlp_stage, p, jnp.zeros((8, 4)), mesh, 2)
+
+
+def test_pipelined_lm_trains(devices8):
+    """End-to-end: 4-stage pipelined causal LM under dp=2 learns the
+    stride progression well above chance."""
+    from tensorflow_distributed_tpu.train.loop import train
+
+    cfg = TrainConfig(model="pipelined_lm", model_size="tiny",
+                      dataset="synthetic", batch_size=64, train_steps=60,
+                      eval_every=0, log_every=0, eval_batch_size=64,
+                      compute_dtype="float32", learning_rate=3e-3,
+                      mesh=MeshConfig(data=2, pipe=4))
+    result = train(cfg)
+    assert result.final_metrics["accuracy"] >= 0.4, result.final_metrics
