@@ -12,7 +12,8 @@ import jax.numpy as jnp
 
 from tensorflow_distributed_tpu.models.cnn import MnistCNN  # noqa: F401
 
-MODEL_NAMES = ("mnist_cnn", "resnet20", "resnet50", "bert_mlm", "gpt_lm")
+MODEL_NAMES = ("mnist_cnn", "resnet20", "resnet50", "bert_mlm", "gpt_lm",
+               "pipelined_lm", "moe_lm")
 
 
 def build_model(name: str, mesh=None, dropout_rate: Optional[float] = None,
@@ -29,7 +30,7 @@ def build_model(name: str, mesh=None, dropout_rate: Optional[float] = None,
     """
     from tensorflow_distributed_tpu.models import cnn, resnet, transformer
 
-    if name not in ("bert_mlm", "gpt_lm"):
+    if name not in ("bert_mlm", "gpt_lm", "pipelined_lm", "moe_lm"):
         overrides.pop("size", None)  # presets are transformer-family only
     if name == "mnist_cnn":
         kw = dict(init_scheme=init_scheme, compute_dtype=compute_dtype)
@@ -50,4 +51,17 @@ def build_model(name: str, mesh=None, dropout_rate: Optional[float] = None,
             overrides.setdefault("dropout_rate", dropout_rate)
         overrides.setdefault("compute_dtype", compute_dtype)
         return transformer.gpt_lm(mesh=mesh, **overrides)
+    if name == "moe_lm":
+        if dropout_rate is not None:
+            overrides.setdefault("dropout_rate", dropout_rate)
+        overrides.setdefault("compute_dtype", compute_dtype)
+        return transformer.moe_lm(mesh=mesh, **overrides)
+    if name == "pipelined_lm":
+        from tensorflow_distributed_tpu.models import pipelined
+        # dropout_rate is ignored: the pipelined variant runs dropout-free
+        # (rng plumbing through the scanned schedule isn't wired).
+        overrides.setdefault("compute_dtype", compute_dtype)
+        if mesh is None:
+            raise ValueError("pipelined_lm needs a mesh (pipe axis)")
+        return pipelined.pipelined_lm(mesh=mesh, **overrides)
     raise ValueError(f"unknown model {name!r}; have {sorted(MODEL_NAMES)}")

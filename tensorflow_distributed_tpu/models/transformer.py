@@ -52,6 +52,26 @@ class TransformerConfig:
     compute_dtype: Any = jnp.bfloat16
     remat: bool = False              # jax.checkpoint each block
     causal: bool = False             # autoregressive (GPT) vs bidirectional
+    # TP partition metadata on kernels. Disabled by the pipelined
+    # variant: flax's DenseGeneral validates params at apply by
+    # eval_shape-ing its init, which flattens multi-dim kernels to 2D
+    # and then applies the 4-axis partition constraint to the flat
+    # value — a rank mismatch that only errors inside a manual-axes
+    # shard_map (the pipeline's). With mesh model == 1 the metadata is
+    # meaningless there anyway.
+    tp_partitioning: bool = True
+    # Pallas flash attention on TPU. Disabled by the pipelined variant:
+    # a Mosaic call inside the pipe-restricted (partial-manual)
+    # shard_map would need the remaining mesh axes manualized too
+    # ("Mosaic kernels cannot be automatically partitioned") — nested
+    # manualization is a follow-up; until then the pipeline uses the
+    # XLA attention path.
+    use_flash: bool = True
+    # Mixture-of-Experts: 0 = dense MLP; > 0 replaces every block's MLP
+    # with an expert-parallel MoeMlp (models/moe.py).
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
 
 
 def bert_base_config(**overrides) -> TransformerConfig:
@@ -70,6 +90,14 @@ def _dense_init():
     return nn.initializers.normal(stddev=0.02)  # BERT-style
 
 
+
+def _maybe_partitioned(cfg, names):
+    """kernel_init with TP metadata, or plain when tp_partitioning=False
+    (see TransformerConfig.tp_partitioning for why)."""
+    init = _dense_init()
+    return nn.with_partitioning(init, names) if cfg.tp_partitioning else init
+
+
 class SelfAttention(nn.Module):
     cfg: TransformerConfig
     mesh: Optional[Mesh] = None
@@ -80,8 +108,7 @@ class SelfAttention(nn.Module):
         h, dh = cfg.n_heads, cfg.d_model // cfg.n_heads
         qkv = nn.DenseGeneral(
             features=(3, h, dh), axis=-1, use_bias=True,
-            kernel_init=nn.with_partitioning(
-                _dense_init(), (None, None, AXIS_MODEL, None)),
+            kernel_init=_maybe_partitioned(cfg, (None, None, AXIS_MODEL, None)),
             dtype=cfg.compute_dtype, name="qkv")(x)
         q, k, v = (qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :])
         if self.mesh is not None and self.mesh.shape[AXIS_SEQ] > 1:
@@ -89,11 +116,11 @@ class SelfAttention(nn.Module):
         else:
             # Pallas flash kernel on TPU (shard_mapped over dp x tp when
             # the mesh is partitioned), XLA oracle elsewhere.
-            out = attention(q, k, v, causal=cfg.causal, mesh=self.mesh)
+            out = attention(q, k, v, causal=cfg.causal, mesh=self.mesh,
+                            allow_flash=cfg.use_flash)
         out = nn.DenseGeneral(
             features=cfg.d_model, axis=(-2, -1), use_bias=True,
-            kernel_init=nn.with_partitioning(
-                _dense_init(), (AXIS_MODEL, None, None)),
+            kernel_init=_maybe_partitioned(cfg, (AXIS_MODEL, None, None)),
             dtype=cfg.compute_dtype, name="out")(out)
         return out
 
@@ -105,13 +132,11 @@ class Mlp(nn.Module):
     def __call__(self, x: jax.Array) -> jax.Array:
         cfg = self.cfg
         x = nn.Dense(cfg.d_ff,
-                     kernel_init=nn.with_partitioning(
-                         _dense_init(), (None, AXIS_MODEL)),
+                     kernel_init=_maybe_partitioned(cfg, (None, AXIS_MODEL)),
                      dtype=cfg.compute_dtype, name="up")(x)
         x = nn.gelu(x)
         x = nn.Dense(cfg.d_model,
-                     kernel_init=nn.with_partitioning(
-                         _dense_init(), (AXIS_MODEL, None)),
+                     kernel_init=_maybe_partitioned(cfg, (AXIS_MODEL, None)),
                      dtype=cfg.compute_dtype, name="down")(x)
         return x
 
@@ -132,7 +157,16 @@ class Block(nn.Module):
         y = nn.Dropout(cfg.dropout_rate, deterministic=not train)(y)
         x = x + y
         y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
-        y = Mlp(cfg, name="mlp")(y.astype(cfg.compute_dtype))
+        if cfg.moe_experts > 0:
+            from tensorflow_distributed_tpu.models.moe import MoeMlp
+            y = MoeMlp(d_model=cfg.d_model, d_ff=cfg.d_ff,
+                       num_experts=cfg.moe_experts, top_k=cfg.moe_top_k,
+                       capacity_factor=cfg.moe_capacity_factor,
+                       compute_dtype=cfg.compute_dtype,
+                       partitioned=cfg.tp_partitioning,
+                       name="moe_mlp")(y.astype(cfg.compute_dtype))
+        else:
+            y = Mlp(cfg, name="mlp")(y.astype(cfg.compute_dtype))
         y = nn.Dropout(cfg.dropout_rate, deterministic=not train)(y)
         return x + y
 
@@ -177,8 +211,7 @@ class TransformerLM(nn.Module):
             x = block(cfg, self.mesh, name=f"layer_{i}")(x, train)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         logits = nn.Dense(cfg.vocab_size,
-                          kernel_init=nn.with_partitioning(
-                              _dense_init(), (None, AXIS_MODEL)),
+                          kernel_init=_maybe_partitioned(cfg, (None, AXIS_MODEL)),
                           dtype=cfg.compute_dtype, name="lm_head")(
             x.astype(cfg.compute_dtype))
         return logits.astype(jnp.float32)
@@ -229,3 +262,14 @@ def gpt_lm(mesh: Optional[Mesh] = None, size: str = "small",
     else:
         raise ValueError(f"gpt_lm size {size!r}; have ('small', 'tiny')")
     return CausalLM(cfg, mesh)
+
+
+def moe_lm(mesh: Optional[Mesh] = None, size: str = "tiny",
+           **overrides) -> CausalLM:
+    """Expert-parallel causal LM ("moe_lm" registry entry): the GPT
+    family with every MLP a top-2 MoE (models/moe.py). No reference
+    counterpart (SURVEY.md §2b "Expert parallel: NO")."""
+    overrides.setdefault("moe_experts", 4)
+    if overrides["moe_experts"] <= 0:
+        raise ValueError("moe_lm needs moe_experts > 0")
+    return gpt_lm(mesh=mesh, size=size, **overrides)

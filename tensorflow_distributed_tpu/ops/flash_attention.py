@@ -317,7 +317,8 @@ def supported(L: int, Lk: int, D: int, block_q: int = 512,
 
 def attention(q: jax.Array, k: jax.Array, v: jax.Array,
               mask: Optional[jax.Array] = None, *,
-              causal: bool = False, mesh=None) -> jax.Array:
+              causal: bool = False, mesh=None,
+              allow_flash: bool = True) -> jax.Array:
     """Dispatcher for the single-shard attention path: the Pallas
     kernel on TPU when shapes allow, the XLA oracle otherwise.
     (Ring attention owns the seq-sharded path.)
@@ -334,7 +335,7 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array,
     from tensorflow_distributed_tpu.parallel.ring_attention import (
         full_attention)
     B, L, H, D = q.shape
-    if (mask is None and jax.default_backend() == "tpu"
+    if (allow_flash and mask is None and jax.default_backend() == "tpu"
             and supported(L, k.shape[1], D)):
         if mesh is None or (mesh.shape[AXIS_DATA] == 1
                             and mesh.shape[AXIS_MODEL] == 1):

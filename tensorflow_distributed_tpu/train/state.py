@@ -22,6 +22,13 @@ from jax.sharding import Mesh
 from tensorflow_distributed_tpu.parallel.sharding import param_sharding, replicated
 from tensorflow_distributed_tpu.utils import prng
 
+# Collections sown per-forward-pass (diagnostics/aux losses), never
+# persisted: carrying an init-time snapshot in TrainState.extra would
+# re-feed it to apply() every step, where sow's tuple-append semantics
+# would stack fresh values on the stale constant (biasing e.g. the MoE
+# load-balance loss) and bloat every checkpoint.
+TRANSIENT_COLLECTIONS = ("moe_aux", "intermediates")
+
 
 @struct.dataclass
 class TrainState:
@@ -94,7 +101,8 @@ def create_train_state(model: nn.Module, tx: optax.GradientTransformation,
         variables = jax.jit(init_vars, out_shardings=var_shardings)(
             prng.init_key(seed))
         params = variables["params"]
-        extra = {k: v for k, v in variables.items() if k != "params"}
+        extra = {k: v for k, v in variables.items()
+                 if k != "params" and k not in TRANSIENT_COLLECTIONS}
         opt_state = jax.jit(tx.init, out_shardings=opt_shardings)(params)
         step = jax.device_put(jax.numpy.zeros((), jax.numpy.int32),
                               replicated(mesh))

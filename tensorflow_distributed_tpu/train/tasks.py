@@ -89,6 +89,32 @@ def mlm_loss(apply_fn, params, extra, batch, dropout_key, train):
     return loss, (metrics, new_extra)
 
 
+MOE_AUX_WEIGHT = 0.01  # Switch-Transformer-style coefficient
+
+
+def moe_loss(apply_fn, params, extra, batch, dropout_key, train):
+    """CLM objective + load-balancing aux from the "moe_aux" collection
+    the MoeMlp layers sow (models/moe.py)."""
+    # moe_aux is transient (state.TRANSIENT_COLLECTIONS) — never feed a
+    # stale copy back in, or sow would append to it.
+    variables = {"params": params,
+                 **{k: v for k, v in extra.items() if k != "moe_aux"}}
+    rngs = {"dropout": dropout_key} if train else {}
+    logits, mut = apply_fn(variables, batch["tokens"], train=train,
+                           rngs=rngs, mutable=["moe_aux"])
+    loss = masked_softmax_cross_entropy(logits, batch["targets"],
+                                        batch["mask"])
+    aux_leaves = jax.tree_util.tree_leaves(mut.get("moe_aux", {}))
+    aux = (sum(aux_leaves) / len(aux_leaves)) if aux_leaves else 0.0
+    total = loss + MOE_AUX_WEIGHT * aux
+    metrics = {
+        "loss": loss, "aux_loss": aux,
+        "accuracy": masked_accuracy(logits, batch["targets"],
+                                    batch["mask"]),
+    }
+    return total, (metrics, extra)
+
+
 def mlm_batch_shardings(mesh: Mesh) -> Dict[str, NamedSharding]:
     """Tokens shard batch over "data" and sequence over "seq" — the
     long-context layout the ring attention consumes without resharding."""
@@ -105,7 +131,7 @@ def _make_lm_task(cfg: TrainConfig, mesh: Mesh, objective: str,
     from tensorflow_distributed_tpu.data.lm import (
         LmBatcher, synthetic_clm, synthetic_mlm)
 
-    gen = synthetic_clm if objective == "clm" else synthetic_mlm
+    gen = synthetic_mlm if objective == "mlm" else synthetic_clm
     n = max(16 * cfg.batch_size, 4096)
     train_ds = gen(n=n, seq_len=seq_len, vocab_size=vocab_size,
                    seed=cfg.seed)
@@ -122,7 +148,8 @@ def _make_lm_task(cfg: TrainConfig, mesh: Mesh, objective: str,
             yield val_ds.batch(np.arange(lo, lo + batch))
 
     return Task(
-        name=objective, loss=mlm_loss,
+        name=objective,
+        loss=moe_loss if objective == "moe_clm" else mlm_loss,
         batch_shardings=mlm_batch_shardings(mesh),
         sample_input=np.zeros((2, seq_len), np.int32), seq_axis=1,
         train_stream=batcher.forever, eval_batches=eval_batches,
@@ -136,4 +163,8 @@ def make_task(cfg: TrainConfig, mesh: Mesh) -> Task:
         return _make_lm_task(cfg, mesh, "mlm")
     if cfg.model == "gpt_lm":
         return _make_lm_task(cfg, mesh, "clm")
+    if cfg.model == "pipelined_lm":
+        return _make_lm_task(cfg, mesh, "clm")
+    if cfg.model == "moe_lm":
+        return _make_lm_task(cfg, mesh, "moe_clm")
     return _make_vision_task(cfg, mesh)
