@@ -100,6 +100,10 @@ class TrainConfig:
     warmup_steps: int = 0
     weight_decay: float = 0.0
     grad_clip_norm: Optional[float] = None
+    # > 1: split each global batch into this many microbatches and
+    # accumulate the mean gradient before the (single) optimizer update
+    # — 1/A the activation memory, same math (train.step).
+    grad_accum_steps: int = 1
     train_steps: int = 500
     # bfloat16 matmuls keep the MXU fed; params/optimizer stay f32.
     compute_dtype: str = "bfloat16"  # bfloat16 | float32
@@ -150,6 +154,13 @@ class TrainConfig:
             raise ValueError(f"unknown compute_dtype {self.compute_dtype!r}")
         if self.data_backend not in ("numpy", "u8_native"):
             raise ValueError(f"unknown data_backend {self.data_backend!r}")
+        if self.grad_accum_steps < 1:
+            raise ValueError(
+                f"grad_accum_steps must be >= 1, got {self.grad_accum_steps}")
+        if self.batch_size % self.grad_accum_steps:
+            raise ValueError(
+                f"batch_size {self.batch_size} not divisible by "
+                f"grad_accum_steps {self.grad_accum_steps}")
         if self.resume and not self.checkpoint_dir:
             raise ValueError("resume=True requires checkpoint_dir")
         self.mesh.validate()

@@ -91,7 +91,8 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
         logger.log_json({"event": "resumed", "step": start_step})
 
     step_fn = make_train_step(mesh, cfg.seed, loss=task.loss,
-                              batch_shardings=task.batch_shardings)
+                              batch_shardings=task.batch_shardings,
+                              accum_steps=cfg.grad_accum_steps)
     eval_fn = make_eval_step(mesh, loss=task.loss,
                              batch_shardings=task.batch_shardings)
     logger.log_json({

@@ -141,3 +141,36 @@ def test_train_batch_not_divisible_raises(mesh8):
     imgs, labels = _batch(30)  # 30 % 8 != 0
     with pytest.raises(Exception):
         step(state, shard_batch(mesh8, (imgs, labels)))
+
+
+def test_grad_accum_matches_full_batch(mesh8, tiny_data):
+    """accum_steps=4 must produce the same update as one full-batch
+    step (dropout-free model config => exact same math up to fp
+    reassociation)."""
+    import optax
+
+    from tensorflow_distributed_tpu.models.cnn import MnistCNN
+    from tensorflow_distributed_tpu.parallel.sharding import shard_batch
+    from tensorflow_distributed_tpu.train.state import create_train_state
+    from tensorflow_distributed_tpu.train.step import make_train_step
+
+    train, _, _ = tiny_data
+    batch = shard_batch(mesh8, (train.images[:64], train.labels[:64]))
+
+    def run(accum):
+        model = MnistCNN(compute_dtype=jnp.float32, dropout_rate=0.0)
+        state = create_train_state(
+            model, optax.sgd(0.1),
+            np.zeros((2, 28, 28, 1), np.float32), mesh8, seed=0)
+        step = make_train_step(mesh8, accum_steps=accum)
+        state, metrics = step(state, batch)
+        return state, metrics
+
+    s1, m1 = run(1)
+    s4, m4 = run(4)
+    np.testing.assert_allclose(float(m1["loss"]), float(m4["loss"]),
+                               rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            jax.device_get(a), jax.device_get(b), atol=2e-6, rtol=2e-5),
+        s1.params, s4.params)
