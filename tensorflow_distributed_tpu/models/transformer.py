@@ -103,7 +103,8 @@ class SelfAttention(nn.Module):
     mesh: Optional[Mesh] = None
 
     @nn.compact
-    def __call__(self, x: jax.Array, *, train: bool = False) -> jax.Array:
+    def __call__(self, x: jax.Array, *, train: bool = False,
+                 decode: bool = False) -> jax.Array:
         cfg = self.cfg
         h, dh = cfg.n_heads, cfg.d_model // cfg.n_heads
         qkv = nn.DenseGeneral(
@@ -111,7 +112,33 @@ class SelfAttention(nn.Module):
             kernel_init=_maybe_partitioned(cfg, (None, None, AXIS_MODEL, None)),
             dtype=cfg.compute_dtype, name="qkv")(x)
         q, k, v = (qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :])
-        if self.mesh is not None and self.mesh.shape[AXIS_SEQ] > 1:
+        if decode:
+            # KV-cache incremental decoding: stash k/v at the running
+            # index, attend q (the L new tokens) against the whole
+            # cache with a position mask. Static shapes throughout —
+            # the cache is always [B, max_len, H, Dh].
+            if not cfg.causal:
+                raise ValueError("decode=True needs a causal config")
+            B, L = x.shape[0], x.shape[1]
+            from tensorflow_distributed_tpu.parallel.ring_attention import (
+                _MASK, full_attention)
+            ck = self.variable("cache", "key", jnp.zeros,
+                               (B, cfg.max_len, h, dh), k.dtype)
+            cv = self.variable("cache", "value", jnp.zeros,
+                               (B, cfg.max_len, h, dh), v.dtype)
+            ci = self.variable("cache", "index",
+                               lambda: jnp.zeros((), jnp.int32))
+            idx = ci.value
+            ck.value = jax.lax.dynamic_update_slice(ck.value, k,
+                                                    (0, idx, 0, 0))
+            cv.value = jax.lax.dynamic_update_slice(cv.value, v,
+                                                    (0, idx, 0, 0))
+            ci.value = idx + L
+            rows = jnp.arange(L)[:, None]              # new-token offsets
+            cols = jnp.arange(cfg.max_len)[None, :]
+            bias = jnp.where(cols <= idx + rows, 0.0, _MASK)[None]
+            out = full_attention(q, ck.value, cv.value, bias)
+        elif self.mesh is not None and self.mesh.shape[AXIS_SEQ] > 1:
             out = ring_attention(q, k, v, self.mesh, causal=cfg.causal)
         else:
             # Pallas flash kernel on TPU (shard_mapped over dp x tp when
@@ -148,12 +175,13 @@ class Block(nn.Module):
     # NOTE: ``train`` is positional (not kw-only) so nn.remat can mark
     # it static by index — (self, x, train) -> static_argnums=(2,).
     @nn.compact
-    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+    def __call__(self, x: jax.Array, train: bool = False,
+                 decode: bool = False) -> jax.Array:
         cfg = self.cfg
         # Pre-LN (trains without warmup games, unlike BERT's post-LN).
         y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
         y = SelfAttention(cfg, self.mesh, name="attn")(
-            y.astype(cfg.compute_dtype), train=train)
+            y.astype(cfg.compute_dtype), train=train, decode=decode)
         y = nn.Dropout(cfg.dropout_rate, deterministic=not train)(y)
         x = x + y
         y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
@@ -183,16 +211,24 @@ class TransformerLM(nn.Module):
     extra_vocab: int = 0
 
     @nn.compact
-    def __call__(self, tokens: jax.Array, *, train: bool = False
-                 ) -> jax.Array:
+    def __call__(self, tokens: jax.Array, *, train: bool = False,
+                 decode: bool = False,
+                 positions: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.cfg
         B, L = tokens.shape
         emb = nn.Embed(cfg.vocab_size + self.extra_vocab, cfg.d_model,
                        embedding_init=_dense_init(), name="tok_emb")
         x = emb(tokens)
+        if positions is None:
+            if decode:
+                # arange(L) would embed a continuation token at position
+                # 0 while the cache attends it at the running index —
+                # silently wrong logits. Make the caller say where.
+                raise ValueError("decode=True requires positions")
+            positions = jnp.arange(L)[None, :]
         pos = nn.Embed(cfg.max_len, cfg.d_model,
                        embedding_init=_dense_init(), name="pos_emb")(
-            jnp.arange(L)[None, :])
+            positions)
         x = (x + pos).astype(cfg.compute_dtype)
         if self.mesh is not None:
             # Pin activation layout: batch over "data", seq over "seq".
@@ -204,11 +240,11 @@ class TransformerLM(nn.Module):
         block = Block
         if cfg.remat:
             # Rematerialize each block on backward: HBM for FLOPs, the
-            # standard long-context trade. train must be static (index 2
-            # counting self) — it selects the dropout branch.
-            block = nn.remat(Block, static_argnums=(2,))
+            # standard long-context trade. train/decode must be static
+            # (indices 2,3 counting self) — they select branches.
+            block = nn.remat(Block, static_argnums=(2, 3))
         for i in range(cfg.n_layers):
-            x = block(cfg, self.mesh, name=f"layer_{i}")(x, train)
+            x = block(cfg, self.mesh, name=f"layer_{i}")(x, train, decode)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         logits = nn.Dense(cfg.vocab_size,
                           kernel_init=_maybe_partitioned(cfg, (None, AXIS_MODEL)),

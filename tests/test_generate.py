@@ -1,0 +1,82 @@
+"""KV-cache decoding: cache-vs-full-forward parity + end-to-end
+generation quality on the learnable stride data."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensorflow_distributed_tpu.models.generate import generate
+from tensorflow_distributed_tpu.models.transformer import CausalLM, tiny_config
+
+
+def _model():
+    return CausalLM(tiny_config(causal=True, compute_dtype=jnp.float32))
+
+
+def test_decode_logits_match_full_forward():
+    """Teacher-forced decode through the cache must reproduce the
+    ordinary causal forward logits position by position."""
+    model = _model()
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 64, size=(2, 12)), jnp.int32)
+    params = model.init(jax.random.key(0), tokens)["params"]
+    full = model.apply({"params": params}, tokens)          # [B, L, V]
+
+    # Prefill 5 tokens, then feed the rest one at a time.
+    logits5, state = model.apply({"params": params}, tokens[:, :5],
+                                 decode=True,
+                                 positions=jnp.arange(5)[None, :],
+                                 mutable=["cache"])
+    np.testing.assert_allclose(logits5, full[:, :5], atol=1e-4, rtol=1e-3)
+    cache = state["cache"]
+    for t in range(5, 12):
+        step_logits, state = model.apply(
+            {"params": params, "cache": cache}, tokens[:, t:t + 1],
+            decode=True, positions=jnp.full((1, 1), t), mutable=["cache"])
+        cache = state["cache"]
+        np.testing.assert_allclose(step_logits[:, 0], full[:, t],
+                                   atol=1e-4, rtol=1e-3,
+                                   err_msg=f"position {t}")
+
+
+def test_generate_shapes_and_determinism():
+    model = _model()
+    prompt = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    params = model.init(jax.random.key(1), prompt)["params"]
+    out1 = generate(model, params, prompt, 8)
+    out2 = generate(model, params, prompt, 8)
+    assert out1.shape == (1, 8)
+    np.testing.assert_array_equal(out1, out2)  # greedy => deterministic
+    sampled = generate(model, params, prompt, 8, temperature=1.0,
+                       key=jax.random.key(2))
+    assert sampled.shape == (1, 8)
+
+
+def test_trained_model_continues_pattern(devices8):
+    """Train tiny GPT on stride progressions, then generate: the greedy
+    continuation must mostly follow x_{t+1} = x_t + stride."""
+    from tensorflow_distributed_tpu.config import MeshConfig, TrainConfig
+    from tensorflow_distributed_tpu.train.loop import train
+
+    cfg = TrainConfig(model="gpt_lm", model_size="tiny",
+                      dataset="synthetic", batch_size=64, train_steps=120,
+                      eval_every=0, log_every=0, eval_batch_size=64,
+                      compute_dtype="float32", learning_rate=3e-3,
+                      mesh=MeshConfig(data=8))
+    result = train(cfg)
+    model = CausalLM(tiny_config(causal=True, compute_dtype=jnp.float32))
+
+    # Short-horizon accuracy over several prompts: free-running
+    # generation compounds errors in a 25k-param model, so judge the
+    # first 4 continuations, averaged over strides/starts.
+    P, N = 16, 4
+    prompts, wants = [], []
+    for stride in (1, 2, 3, 4):
+        for start in (5, 20):
+            prompts.append((start + stride * np.arange(P)) % 64)
+            wants.append((start + stride * (np.arange(N) + P)) % 64)
+    prompt = np.stack(prompts).astype(np.int32)
+    out = np.asarray(generate(model, jax.device_get(result.state.params),
+                              jnp.asarray(prompt), N))
+    acc = float(np.mean(out == np.stack(wants).astype(np.int32)))
+    assert acc >= 0.5, (out.tolist(), acc)
