@@ -17,9 +17,12 @@ from jax.sharding import Mesh
 from tensorflow_distributed_tpu.parallel.sharding import shard_batch
 
 
-def prefetch_to_mesh(it: Iterator[Any], mesh: Mesh, size: int = 2,
-                     seq_axis: Optional[int] = None) -> Iterator[Any]:
-    """Yield batches already device_put against ``mesh``, ``size`` ahead."""
+def prefetch_with(it: Iterator[Any], place: Any, size: int = 2
+                  ) -> Iterator[Any]:
+    """Generic double-buffer: yield ``place(batch)`` results ``size``
+    transfers ahead of the consumer. ``place`` maps a host batch to
+    device arrays (any sharding convention — e.g. the stacked-K layout
+    of train.multistep)."""
     buf = collections.deque()
 
     def enqueue(n: int) -> None:
@@ -28,9 +31,16 @@ def prefetch_to_mesh(it: Iterator[Any], mesh: Mesh, size: int = 2,
                 batch = next(it)
             except StopIteration:
                 return
-            buf.append(shard_batch(mesh, batch, seq_axis=seq_axis))
+            buf.append(place(batch))
 
     enqueue(size)
     while buf:
         yield buf.popleft()
         enqueue(1)
+
+
+def prefetch_to_mesh(it: Iterator[Any], mesh: Mesh, size: int = 2,
+                     seq_axis: Optional[int] = None) -> Iterator[Any]:
+    """Yield batches already device_put against ``mesh``, ``size`` ahead."""
+    return prefetch_with(
+        it, lambda b: shard_batch(mesh, b, seq_axis=seq_axis), size)

@@ -57,14 +57,21 @@ class U8Dataset:
         images = native.gather_u8_f32(self.images, idx, self.scale)
         return images, self.labels[idx]
 
+    def gather_raw(self, idx: np.ndarray):
+        """uint8 batch, no conversion — for pipelines that normalize on
+        device (transfer 1/4 the bytes; train.multistep.preprocess)."""
+        return self.images[idx], self.labels[idx]
+
 
 class U8ShardedBatcher(Batcher):
-    """Same stream contract as data.mnist.ShardedBatcher, native gather."""
+    """Same stream contract as data.mnist.ShardedBatcher, native gather.
+    ``raw=True`` yields uint8 batches (device-side normalization)."""
 
     def __init__(self, ds: U8Dataset, global_batch: int, seed: int = 0,
-                 num_processes: int = 1, process_index: int = 0):
+                 num_processes: int = 1, process_index: int = 0,
+                 raw: bool = False):
         self.ds = ds
         super().__init__(n_items=len(ds), global_batch=global_batch,
-                         gather=ds.gather, seed=seed,
-                         num_processes=num_processes,
+                         gather=ds.gather_raw if raw else ds.gather,
+                         seed=seed, num_processes=num_processes,
                          process_index=process_index)

@@ -86,7 +86,8 @@ def default_batch_shardings(mesh: Mesh):
 def make_train_step(mesh: Mesh, seed: int = 0, donate: bool = True,
                     loss: LossFn = loss_fn,
                     batch_shardings: Any = None,
-                    accum_steps: int = 1
+                    accum_steps: int = 1,
+                    jit: bool = True
                     ) -> Callable[[TrainState, Batch],
                                   Tuple[TrainState, Metrics]]:
     """Build the jitted train step for a mesh.
@@ -168,6 +169,10 @@ def make_train_step(mesh: Mesh, seed: int = 0, donate: bool = True,
                                   opt_state=new_opt, extra=new_extra)
         return new_state, metrics
 
+    if not jit:
+        # Raw step body — for callers that embed it in a larger jitted
+        # program (train.multistep's scan).
+        return step
     with mesh:
         return jax.jit(
             step,
