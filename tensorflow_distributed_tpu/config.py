@@ -154,6 +154,8 @@ class TrainConfig:
             raise ValueError(f"unknown compute_dtype {self.compute_dtype!r}")
         if self.data_backend not in ("numpy", "u8_native"):
             raise ValueError(f"unknown data_backend {self.data_backend!r}")
+        if self.remat not in ("none", "full", "dots"):
+            raise ValueError(f"unknown remat {self.remat!r}")
         if self.grad_accum_steps < 1:
             raise ValueError(
                 f"grad_accum_steps must be >= 1, got {self.grad_accum_steps}")

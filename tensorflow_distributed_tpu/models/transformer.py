@@ -51,6 +51,11 @@ class TransformerConfig:
     dropout_rate: float = 0.1
     compute_dtype: Any = jnp.bfloat16
     remat: bool = False              # jax.checkpoint each block
+    # "full": save only block boundaries (max recompute, min HBM);
+    # "dots": jax.checkpoint_policies.dots_saveable — keep matmul
+    # outputs, recompute the cheap elementwise tail (the usual sweet
+    # spot on TPU where HBM bandwidth, not FLOPs, binds).
+    remat_policy: str = "full"
     causal: bool = False             # autoregressive (GPT) vs bidirectional
     # TP partition metadata on kernels. Disabled by the pipelined
     # variant: flax's DenseGeneral validates params at apply by
@@ -242,7 +247,15 @@ class TransformerLM(nn.Module):
             # Rematerialize each block on backward: HBM for FLOPs, the
             # standard long-context trade. train/decode must be static
             # (indices 2,3 counting self) — they select branches.
-            block = nn.remat(Block, static_argnums=(2, 3))
+            if cfg.remat_policy == "dots":
+                policy = jax.checkpoint_policies.dots_saveable
+            elif cfg.remat_policy == "full":
+                policy = None
+            else:
+                raise ValueError(
+                    f"remat_policy {cfg.remat_policy!r}; have "
+                    f"('full', 'dots')")
+            block = nn.remat(Block, static_argnums=(2, 3), policy=policy)
         for i in range(cfg.n_layers):
             x = block(cfg, self.mesh, name=f"layer_{i}")(x, train, decode)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)

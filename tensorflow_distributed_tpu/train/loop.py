@@ -75,6 +75,10 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
     task = make_task(cfg, mesh)
 
     size_kw = {"size": cfg.model_size} if cfg.model_size else {}
+    if (cfg.remat != "none"
+            and cfg.model in ("bert_mlm", "gpt_lm", "moe_lm",
+                              "pipelined_lm")):
+        size_kw.update(remat=True, remat_policy=cfg.remat)
     model = build_model(
         cfg.model, mesh=mesh, dropout_rate=cfg.dropout_rate,
         init_scheme=cfg.init_scheme,
