@@ -129,8 +129,12 @@ int tfd_idx_read(const char* path, void** out_data, int64_t* dims,
     got += r;
   }
   gzclose(f);
-  // IDX multi-byte ints are big-endian; swap on (x86/ARM) little-endian.
-  if (esize > 1) {
+  // IDX multi-byte ints are big-endian; swap only on little-endian
+  // hosts (x86/ARM) — a big-endian host must keep the bytes as-is.
+  const uint32_t one = 1;
+  const bool little_endian =
+      *reinterpret_cast<const unsigned char*>(&one) == 1;
+  if (esize > 1 && little_endian) {
     unsigned char* p = buf;
     for (int64_t i = 0; i < total; ++i, p += esize) {
       for (int b = 0; b < esize / 2; ++b) {

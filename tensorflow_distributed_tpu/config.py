@@ -80,7 +80,9 @@ class TrainConfig:
 
     # --- data ------------------------------------------------------------
     # mnist | synthetic | cifar10 | cifar10_synthetic | imagenet_synthetic
-    # | lm_synthetic  (see data.load_dataset dispatch)
+    # (see data.load_dataset dispatch). Ignored by the LM families
+    # (bert_mlm/gpt_lm/moe_lm/pipelined_lm), whose synthetic token data
+    # is selected by model family in train.tasks.make_task.
     dataset: str = "mnist"
     data_dir: str = "/tmp/mnist-data"  # reference default, mnist_python_m.py:50
     # Global batch. Reference: 128 per worker x 2 workers = 256 global

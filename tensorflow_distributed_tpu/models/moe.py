@@ -27,6 +27,7 @@ away (any mesh axis name works).
 
 from __future__ import annotations
 
+import math
 from typing import Any
 
 import flax.linen as nn
@@ -56,7 +57,7 @@ class MoeMlp(nn.Module):
     def __call__(self, x: jax.Array) -> jax.Array:
         G, S, M = x.shape
         E, K = self.num_experts, self.top_k
-        C = max(1, int(self.capacity_factor * K * S / E))
+        C = max(1, math.ceil(self.capacity_factor * K * S / E))
 
         gate_w = self.param("gate", self._winit((None, None)), (M, E),
                             jnp.float32)

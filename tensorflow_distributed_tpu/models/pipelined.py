@@ -30,7 +30,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh
 
 from tensorflow_distributed_tpu.models.transformer import (
-    Block, TransformerConfig, _dense_init, tiny_config)
+    Block, TransformerConfig, _dense_init, resolve_remat_policy,
+    tiny_config)
 from tensorflow_distributed_tpu.parallel.mesh import (
     AXIS_MODEL, AXIS_PIPE, AXIS_SEQ)
 from tensorflow_distributed_tpu.parallel.pipeline import (
@@ -137,6 +138,14 @@ class PipelinedLM:
             # stage's blocks in order via scan-over-layers.
             def one_layer(x, layer_p):
                 return self._block.apply({"params": layer_p}, x, False), None
+            if self.cfg.remat:
+                # --remat for the pipelined family: rematerialize each
+                # block on backward (cfg.remat_policy as in
+                # models/transformer.py), so activation memory per stage
+                # is O(1) blocks instead of O(layers_per_stage).
+                one_layer = jax.checkpoint(
+                    one_layer,
+                    policy=resolve_remat_policy(self.cfg.remat_policy))
             y, _ = jax.lax.scan(one_layer, x_mb, stage_params)
             return y
 

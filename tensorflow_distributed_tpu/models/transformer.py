@@ -91,6 +91,16 @@ def tiny_config(**overrides) -> TransformerConfig:
     return dataclasses.replace(base, **overrides)
 
 
+def resolve_remat_policy(name: str):
+    """remat_policy name -> jax.checkpoint policy (the ONE mapping,
+    shared by TransformerLM and PipelinedLM)."""
+    if name == "dots":
+        return jax.checkpoint_policies.dots_saveable
+    if name == "full":
+        return None
+    raise ValueError(f"remat_policy {name!r}; have ('full', 'dots')")
+
+
 def _dense_init():
     return nn.initializers.normal(stddev=0.02)  # BERT-style
 
@@ -247,15 +257,8 @@ class TransformerLM(nn.Module):
             # Rematerialize each block on backward: HBM for FLOPs, the
             # standard long-context trade. train/decode must be static
             # (indices 2,3 counting self) — they select branches.
-            if cfg.remat_policy == "dots":
-                policy = jax.checkpoint_policies.dots_saveable
-            elif cfg.remat_policy == "full":
-                policy = None
-            else:
-                raise ValueError(
-                    f"remat_policy {cfg.remat_policy!r}; have "
-                    f"('full', 'dots')")
-            block = nn.remat(Block, static_argnums=(2, 3), policy=policy)
+            block = nn.remat(Block, static_argnums=(2, 3),
+                             policy=resolve_remat_policy(cfg.remat_policy))
         for i in range(cfg.n_layers):
             x = block(cfg, self.mesh, name=f"layer_{i}")(x, train, decode)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)

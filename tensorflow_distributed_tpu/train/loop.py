@@ -63,6 +63,12 @@ def evaluate(state: TrainState, eval_fn, task: Task, mesh, batch: int
         for k, v in m.items():
             totals[k] = totals.get(k, 0.0) + float(v) * batch
         count += batch
+    if count < task.eval_size and is_chief():
+        # Fixed-size SPMD batches truncate the split to a batch multiple
+        # (exact for the reference's 5x1000 split) — surface the tail
+        # drop instead of silently skewing small-split accuracy.
+        print(f"[eval] split has {task.eval_size} rows; evaluated "
+              f"{count} (remainder dropped by batch size {batch})")
     return {k: v / max(count, 1) for k, v in totals.items()}
 
 
@@ -130,11 +136,13 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
             cadence(start_step + 1, state, metrics)
     steps_done = 1 if cfg.train_steps > start_step else 0
 
-    # Bounded async dispatch: keep at most 2 steps in flight. Unbounded
+    # Bounded async dispatch: block on the oldest pending step once more
+    # than 2 ride in the deque, so at most 2 unconfirmed steps trail the
+    # current dispatch (3 in flight at the dispatch instant). Unbounded
     # dispatch can queue dozens of SPMD programs whose collectives then
     # compete for the same worker threads (on oversubscribed hosts the
-    # XLA:CPU rendezvous aborts after 40s); a 2-deep window preserves the
-    # host/device overlap that hides dispatch latency.
+    # XLA:CPU rendezvous aborts after 40s); a shallow window preserves
+    # the host/device overlap that hides dispatch latency.
     inflight = collections.deque()
     profiler = StepProfiler(
         log_dir=cfg.profile_dir if is_chief() else "",

@@ -147,21 +147,23 @@ def make_train_step(mesh: Mesh, seed: int = 0, donate: bool = True,
             zero_grads = jax.tree_util.tree_map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
 
-            def body(carry, xs):
-                acc_grads, _extra, i = carry
-                mb = xs
+            def body(carry, mb):
+                acc_grads, i = carry
                 mkey = jax.random.fold_in(dkey, i)
                 g, metrics, new_extra = grads_of(state, mb, mkey)
                 acc = jax.tree_util.tree_map(
                     lambda a, b: a + b.astype(jnp.float32) / accum_steps,
                     acc_grads, g)
-                return (acc, new_extra, i + 1), metrics
+                return (acc, i + 1), (metrics, new_extra)
 
-            (grads, new_extra, _), metrics_stack = jax.lax.scan(
-                body, (zero_grads, state.extra, jnp.zeros((), jnp.int32)),
-                micro)
+            (grads, _), (metrics_stack, extra_stack) = jax.lax.scan(
+                body, (zero_grads, jnp.zeros((), jnp.int32)), micro)
             metrics = jax.tree_util.tree_map(
                 lambda m: jnp.mean(m, axis=0), metrics_stack)
+            # Stat collections keep the LAST microbatch's values (each
+            # microbatch recomputes from the closed-over state.extra,
+            # like the last slice of one big batch would).
+            new_extra = jax.tree_util.tree_map(lambda e: e[-1], extra_stack)
         updates, new_opt = state.tx.update(grads, state.opt_state, state.params)
         new_params = jax.tree_util.tree_map(
             lambda p, u: (p + u.astype(p.dtype)), state.params, updates)
