@@ -23,10 +23,15 @@ generic fusion:
 
 On non-TPU backends the kernels run under `interpret=True` (tests) or
 callers use `parallel.ring_attention.full_attention` (the XLA oracle).
-Causal masking is applied in-kernel; fully-masked K blocks are still
-visited (grid steps can't be skipped), which costs ~2x FLOPs for
-causal LMs at these block sizes — acceptable until a skip-index_map
-variant is profiled in.
+Causal masking is applied in-kernel, and fully-masked blocks are
+SKIPPED: TPU grids are rectangular and execute every step, so the
+skip is expressed as (a) a `pl.when` predicate around the compute body
+— Mosaic emits real branches, the MXU never sees the masked block —
+and (b) an index_map that re-points the skipped step's K/V (resp.
+Q/dO) BlockSpec at an already-visited block, so the pipeline issues no
+DMA for it either. Net: causal attention pays ~half the full-grid
+FLOPs (the lower triangle plus the diagonal blocks), in all three
+kernels (fwd, dq, dk/dv).
 """
 
 from __future__ import annotations
@@ -48,10 +53,34 @@ def _causal_mask(s, i_q, i_k, bq, bk):
     return jnp.where(cols <= rows, s, NEG_INF)
 
 
+# Causal block-skip helpers. A (q-block i, k-block j) pair is needed iff
+# its mask isn't all-False: the q block's last row i*bq + bq - 1 must
+# reach the k block's first column j*bk. The index_map twins re-point
+# skipped steps at the last/first needed block so the revisit costs no
+# DMA (Pallas only copies when the block index changes).
+
+def _kv_needed(i, j, bq, bk):
+    return j * bk <= i * bq + (bq - 1)
+
+
+def _causal_kv_map(bq, bk):
+    return lambda b, i, j: (b, jnp.minimum(j, (i * bq + bq - 1) // bk), 0)
+
+
+def _q_needed(i, j, bq, bk):
+    """dkv grid: i is the k-block index, j the q-block index."""
+    return j * bq + (bq - 1) >= i * bk
+
+
+def _causal_q_map(bq, bk):
+    return lambda b, i, j: (b, jnp.maximum(j, (i * bk) // bq), 0)
+
+
 # ---------------------------------------------------------------- forward
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr, *, scale, causal, bq, bk):
+    i = pl.program_id(1)
     j = pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -61,24 +90,33 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    q = q_ref[0]                                   # [bq, D]
-    k = k_ref[0]                                   # [bk, D]
-    v = v_ref[0]
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
-    if causal:
-        s = _causal_mask(s, pl.program_id(1), j, bq, bk)
+    def compute():
+        q = q_ref[0]                               # [bq, D]
+        k = k_ref[0]                               # [bk, D]
+        v = v_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, i, j, bq, bk)
 
-    m_prev = m_scr[:, :1]                          # [bq, 1] f32
-    m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-    alpha = jnp.exp(m_prev - m_cur)
-    p = jnp.exp(s - m_cur)                         # [bq, bk] f32
-    l_new = l_scr[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
-    pv = jax.lax.dot_general(p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    acc_scr[:] = acc_scr[:] * alpha + pv
-    m_scr[:] = jnp.broadcast_to(m_cur, m_scr.shape)
-    l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+        m_prev = m_scr[:, :1]                      # [bq, 1] f32
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur)                     # [bq, bk] f32
+        l_new = l_scr[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(p.astype(v.dtype), v,
+                                 (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc_scr[:] = acc_scr[:] * alpha + pv
+        m_scr[:] = jnp.broadcast_to(m_cur, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    if causal:
+        # Skip fully-masked K blocks (above the diagonal) — a real
+        # branch, not predicated arithmetic: the MXU work is not done.
+        pl.when(_kv_needed(i, j, bq, bk))(compute)
+    else:
+        compute()
 
     @pl.when(j == nk - 1)
     def _():
@@ -99,13 +137,15 @@ def _fwd(q, k, v, causal, bq, bk, interpret):
     grid = (BH, L // bq, Lk // bk)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                bq=bq, bk=bk)
+    kv_map = _causal_kv_map(bq, bk) if causal else (
+        lambda b, i, j: (b, j, 0))
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), kv_map),
+            pl.BlockSpec((1, bk, D), kv_map),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
@@ -136,6 +176,7 @@ def _delta(do, out):
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
                dq_scr, *, scale, causal, bq, bk):
+    i = pl.program_id(1)
     j = pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -143,20 +184,26 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
     def _():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
+    def compute():
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, i, j, bq, bk)
+        lse = lse_ref[0][:, :1]                    # [bq, 1]
+        delta = _delta(do, o_ref[0])               # [bq, 1]
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale              # [bq, bk] f32
+        dq_scr[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
     if causal:
-        s = _causal_mask(s, pl.program_id(1), j, bq, bk)
-    lse = lse_ref[0][:, :1]                        # [bq, 1]
-    delta = _delta(do, o_ref[0])                   # [bq, 1]
-    p = jnp.exp(s - lse)
-    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    ds = p * (dp - delta) * scale                  # [bq, bk] f32
-    dq_scr[:] += jax.lax.dot_general(
-        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
+        pl.when(_kv_needed(i, j, bq, bk))(compute)
+    else:
+        compute()
 
     @pl.when(j == nk - 1)
     def _():
@@ -165,6 +212,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal, bq, bk):
+    i = pl.program_id(1)                           # k-block index
     j = pl.program_id(2)                           # q-block index (inner)
     nq = pl.num_programs(2)
 
@@ -173,23 +221,30 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
+    def compute():
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, j, i, bq, bk)
+        lse = lse_ref[0][:, :1]                    # [bq, 1]
+        delta = _delta(do, o_ref[0])               # [bq, 1]
+        p = jnp.exp(s - lse)                       # [bq, bk]
+        dv_scr[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dk_scr[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
     if causal:
-        s = _causal_mask(s, j, pl.program_id(1), bq, bk)
-    lse = lse_ref[0][:, :1]                        # [bq, 1]
-    delta = _delta(do, o_ref[0])                   # [bq, 1]
-    p = jnp.exp(s - lse)                           # [bq, bk]
-    dv_scr[:] += jax.lax.dot_general(
-        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    ds = p * (dp - delta) * scale
-    dk_scr[:] += jax.lax.dot_general(
-        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
+        # Skip q blocks strictly above this k block's diagonal.
+        pl.when(_q_needed(i, j, bq, bk))(compute)
+    else:
+        compute()
 
     @pl.when(j == nq - 1)
     def _():
@@ -202,14 +257,16 @@ def _bwd(q, k, v, out, lse, do, causal, bq, bk, interpret):
     Lk = k.shape[1]
     scale = 1.0 / (D ** 0.5)
 
+    kv_map = _causal_kv_map(bq, bk) if causal else (
+        lambda b, i, j: (b, j, 0))
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk),
         grid=(BH, L // bq, Lk // bk),
         in_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), kv_map),
+            pl.BlockSpec((1, bk, D), kv_map),
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bq, 8), lambda b, i, j: (b, i, 0)),
@@ -220,17 +277,19 @@ def _bwd(q, k, v, out, lse, do, causal, bq, bk, interpret):
         interpret=interpret,
     )(q, k, v, do, out, lse)
 
+    q_map = _causal_q_map(bq, bk) if causal else (
+        lambda b, i, j: (b, j, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk),
         grid=(BH, Lk // bk, L // bq),
         in_specs=[
-            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bq, D), q_map),
             pl.BlockSpec((1, bk, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bk, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bq, 8), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bq, D), q_map),
+            pl.BlockSpec((1, bq, D), q_map),
+            pl.BlockSpec((1, bq, 8), q_map),
         ],
         out_specs=[
             pl.BlockSpec((1, bk, D), lambda b, i, j: (b, i, 0)),
@@ -271,18 +330,20 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
-                    causal: bool = False, block_q: int = 512,
+                    causal: bool = False, block_q: int = 1024,
                     block_k: int = 1024,
                     interpret: Optional[bool] = None) -> jax.Array:
     """Fused blockwise attention. q,k,v: [B, L, H, D] -> [B, L, H, D].
 
     Differentiable (custom VJP, Pallas both ways). Block sizes clamp to
     the sequence lengths; lengths must divide the (clamped) blocks —
-    `supported()` gates the dispatcher. Defaults (512, 1024) measured
-    ~1.6x faster than XLA's fused full attention at B=4 H=8 L=4096
-    D=64 bf16 on one chip. `interpret=None` auto-selects interpreter
-    mode off-TPU so the same kernel is testable on the 8-device CPU
-    mesh (SURVEY.md §4).
+    `supported()` gates the dispatcher. Defaults (1024, 1024) won a
+    block-size sweep on one v5e chip (B=4 H=8 D=64 bf16, L=1k..8k) for
+    both causal and full; with the causal block skip they measure
+    1.20x/1.42x faster than the full-grid kernel at L=4096/8192 fwd
+    (1.28x/1.50x fwd+bwd), trending to the asymptotic 2x as L grows.
+    `interpret=None` auto-selects interpreter mode off-TPU so the same
+    kernel is testable on the 8-device CPU mesh (SURVEY.md §4).
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -306,7 +367,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     return jnp.transpose(out.reshape(B, H, L, D), (0, 2, 1, 3))
 
 
-def supported(L: int, Lk: int, D: int, block_q: int = 512,
+def supported(L: int, Lk: int, D: int, block_q: int = 1024,
               block_k: int = 1024) -> bool:
     """Whether the Pallas kernel handles these shapes (else use the
     XLA path, parallel.ring_attention.full_attention)."""

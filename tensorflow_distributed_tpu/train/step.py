@@ -148,22 +148,25 @@ def make_train_step(mesh: Mesh, seed: int = 0, donate: bool = True,
                 lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
 
             def body(carry, mb):
-                acc_grads, i = carry
+                # The extra slot in the carry only TRANSPORTS the most
+                # recent microbatch's stat collections out of the scan
+                # in O(1) memory — it is never fed back in: each
+                # microbatch recomputes from the closed-over
+                # state.extra, so the final value is the last
+                # microbatch's, like the last slice of one big batch.
+                acc_grads, _last_extra, i = carry
                 mkey = jax.random.fold_in(dkey, i)
                 g, metrics, new_extra = grads_of(state, mb, mkey)
                 acc = jax.tree_util.tree_map(
                     lambda a, b: a + b.astype(jnp.float32) / accum_steps,
                     acc_grads, g)
-                return (acc, i + 1), (metrics, new_extra)
+                return (acc, new_extra, i + 1), metrics
 
-            (grads, _), (metrics_stack, extra_stack) = jax.lax.scan(
-                body, (zero_grads, jnp.zeros((), jnp.int32)), micro)
+            (grads, new_extra, _), metrics_stack = jax.lax.scan(
+                body, (zero_grads, state.extra, jnp.zeros((), jnp.int32)),
+                micro)
             metrics = jax.tree_util.tree_map(
                 lambda m: jnp.mean(m, axis=0), metrics_stack)
-            # Stat collections keep the LAST microbatch's values (each
-            # microbatch recomputes from the closed-over state.extra,
-            # like the last slice of one big batch would).
-            new_extra = jax.tree_util.tree_map(lambda e: e[-1], extra_stack)
         updates, new_opt = state.tx.update(grads, state.opt_state, state.params)
         new_params = jax.tree_util.tree_map(
             lambda p, u: (p + u.astype(p.dtype)), state.params, updates)

@@ -67,7 +67,8 @@ def test_supported_gate():
     assert supported(256, 256, 64)
     assert supported(200, 256, 64)       # blocks clamp to short seqs
     assert not supported(250, 256, 64)   # ragged: 250 % 8 != 0
-    assert not supported(768, 256, 64)   # 768 not divisible by bq=512
+    assert supported(768, 256, 64)       # clamps to bq=768 (div by 8)
+    assert not supported(1536, 256, 64)  # 1536 not divisible by bq=1024
     assert not supported(256, 256, 300)  # head dim too large
 
 
@@ -96,7 +97,7 @@ def test_flash_under_shard_map(mesh8):
 
 
 def test_ragged_seq_raises():
-    q = jnp.ones((1, 600, 2, 16), jnp.float32)
+    q = jnp.ones((1, 1500, 2, 16), jnp.float32)
     with pytest.raises(ValueError, match="divide"):
         flash_attention(q, q, q, interpret=True)
 
