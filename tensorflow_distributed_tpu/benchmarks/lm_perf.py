@@ -1,0 +1,203 @@
+"""Transformer-LM training benchmark: tokens/s, TFLOP/s, and MFU.
+
+The reference's entire perf surface is its hand-recorded 6-line
+``performance`` table for the MNIST CNN (/root/reference/performance:1-6,
+SURVEY.md §6) — a host-bound workload that says nothing about the MXU.
+This benchmark is its TPU-native successor for the sequence family this
+framework showcases: train the GPT-family causal LM at a real size
+(GPT-2-small: 12L x 768d x 12H, models/transformer.py gpt_lm) and report
+
+- tokens/sec through the full jitted train step (fwd + bwd + Adam),
+- achieved model TFLOP/s and MFU against the chip's bf16 peak,
+- a flash-vs-XLA attention A/B on the SAME training step (the only
+  change is TransformerConfig.use_flash), turning the kernel's claimed
+  speedup into a measured number.
+
+FLOP accounting (the PaLM/MFU convention, matmuls only):
+  per token fwd = 2 * N_matmul  (every matmul param is one MAC/token)
+  attention     = 4 * L * d_model per layer fwd (QK^T and PV), halved
+                  for causal because the kernel skips masked blocks
+  fwd + bwd     = 3x forward
+MFU counts the causal-SKIPPED FLOPs — the useful work, not the work a
+lazier kernel would have done.
+
+The batch lives on device and is reused every step: this measures the
+model/step path (the MXU story); the host->device data path is
+bench.py's story. A loss-decrease assertion guards against benchmarking
+a degenerate graph.
+
+Timing uses a host readback of the final step's loss as the barrier —
+on the tunneled axon runtime block_until_ready alone can return before
+remote execution finishes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+# Chip bf16 peaks for MFU. Only kinds we can meet in this environment;
+# unknown kinds report mfu as None rather than a made-up number.
+PEAK_BF16_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,        # v5p
+    "TPU v6 lite": 918e12,   # v6e / Trillium
+}
+
+
+def matmul_params(params) -> int:
+    """Parameters that participate in matmuls: every kernel of ndim >= 2
+    except the embedding tables (lookups, not matmuls)."""
+    import jax
+
+    total = 0
+    for path, leaf in jax.tree_util.tree_leaves_with_path(params):
+        name = jax.tree_util.keystr(path)
+        if leaf.ndim >= 2 and "emb" not in name:
+            total += leaf.size
+    return total
+
+
+def flops_per_token(params, cfg) -> float:
+    """Model FLOPs per trained token, fwd+bwd (see module docstring)."""
+    n = matmul_params(params)
+    attn_fwd = 4.0 * cfg.max_len * cfg.d_model * cfg.n_layers
+    if cfg.causal:
+        attn_fwd /= 2.0
+    return 3.0 * (2.0 * n + attn_fwd)
+
+
+def _build(size: str, seq_len: int, use_flash: bool, remat: str,
+           batch: int, mesh, seed: int = 0):
+    import jax
+    import numpy as np
+    import optax
+
+    from tensorflow_distributed_tpu.data.lm import synthetic_clm
+    from tensorflow_distributed_tpu.models.transformer import gpt_lm
+    from tensorflow_distributed_tpu.parallel.sharding import shard_batch
+    from tensorflow_distributed_tpu.train.state import create_train_state
+    from tensorflow_distributed_tpu.train.step import make_train_step
+    from tensorflow_distributed_tpu.train.tasks import (
+        mlm_batch_shardings, mlm_loss)
+
+    kw = dict(max_len=seq_len, dropout_rate=0.0, use_flash=use_flash)
+    if remat != "none":
+        kw.update(remat=True, remat_policy=remat)
+    model = gpt_lm(mesh, size=size, **kw)
+    state = create_train_state(
+        model, optax.adam(3e-4), np.zeros((2, seq_len), np.int32), mesh,
+        seed)
+    step = make_train_step(mesh, seed, loss=mlm_loss,
+                           batch_shardings=mlm_batch_shardings(mesh))
+    ds = synthetic_clm(n=batch, seq_len=seq_len,
+                       vocab_size=model.cfg.vocab_size, seed=seed)
+    hb = ds.batch(np.arange(batch))
+    dev_batch = shard_batch(mesh, hb, seq_axis=1)
+    return model, state, step, dev_batch
+
+
+def _timed_steps(step, state, batch, steps: int):
+    """Steady-state steps/sec with async dispatch and an honest final
+    readback barrier. Returns (dt_seconds, final_state, first, last)."""
+    import jax
+
+    state, metrics = step(state, batch)  # compile + step 1
+    first_loss = float(jax.device_get(metrics["loss"]))
+    for _ in range(2):                   # warm
+        state, metrics = step(state, batch)
+    float(jax.device_get(metrics["loss"]))
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch)
+    last_loss = float(jax.device_get(metrics["loss"]))
+    dt = time.perf_counter() - t0
+    return dt, state, first_loss, last_loss
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--size", default="small",
+                        choices=["small", "tiny"])
+    parser.add_argument("--batch", type=int, default=8)
+    parser.add_argument("--seq-len", type=int, default=1024)
+    parser.add_argument("--steps", type=int, default=20)
+    parser.add_argument("--remat", default="none",
+                        choices=["none", "full", "dots"])
+    parser.add_argument("--skip-ab", action="store_true",
+                        help="skip the flash-vs-XLA attention A/B")
+    parser.add_argument("--out", default="",
+                        help="also write the JSON lines to this file")
+    args = parser.parse_args(argv)
+
+    import jax
+    import numpy as np
+
+    from tensorflow_distributed_tpu.config import MeshConfig
+    from tensorflow_distributed_tpu.parallel.mesh import make_mesh
+    from tensorflow_distributed_tpu.train.state import param_count
+    from tensorflow_distributed_tpu.utils.compilecache import (
+        enable_persistent_cache)
+
+    enable_persistent_cache()
+    n_dev = len(jax.devices())
+    mesh = make_mesh(MeshConfig(data=n_dev))
+    kind = jax.devices()[0].device_kind
+    peak = PEAK_BF16_FLOPS.get(kind)
+
+    model, state, step, batch = _build(
+        args.size, args.seq_len, True, args.remat, args.batch, mesh)
+    n_params = param_count(state.params)
+    fpt = flops_per_token(state.params, model.cfg)
+
+    dt, state, first, last = _timed_steps(step, state, batch, args.steps)
+    assert np.isfinite(last), f"non-finite loss {last}"
+    assert last < first, f"loss did not decrease: {first} -> {last}"
+
+    tokens = args.steps * args.batch * args.seq_len
+    tok_s = tokens / dt
+    tflops = tok_s * fpt / 1e12
+    mfu = tflops * 1e12 / (peak * n_dev) if peak else None
+
+    meta = {"model": f"gpt_lm/{args.size}", "params": n_params,
+            "batch": args.batch, "seq_len": args.seq_len,
+            "device": kind, "devices": n_dev, "remat": args.remat}
+    lines = [
+        {"metric": "lm_train_tokens_per_sec", "value": round(tok_s, 1),
+         "unit": "tokens/sec", **meta},
+        {"metric": "lm_train_model_tflops", "value": round(tflops, 2),
+         "unit": "TFLOP/s", **meta},
+        {"metric": "lm_train_mfu",
+         "value": round(100 * mfu, 2) if mfu is not None else None,
+         "unit": "%", **meta},
+    ]
+
+    if not args.skip_ab:
+        # Same step, use_flash=False: attention falls to the XLA path
+        # (parallel.ring_attention.full_attention under jit). Drop the
+        # flash run's state/executable first — two resident GPT-2 train
+        # states don't fit 16G HBM at batch 16.
+        del state, step, batch
+        _, state_x, step_x, batch_x = _build(
+            args.size, args.seq_len, False, args.remat, args.batch, mesh)
+        dt_x, _, _, last_x = _timed_steps(step_x, state_x, batch_x,
+                                          args.steps)
+        assert np.isfinite(last_x)
+        lines.append({
+            "metric": "flash_vs_xla_attention_step_speedup",
+            "value": round(dt_x / dt, 3), "unit": "x",
+            "xla_tokens_per_sec": round(tokens / dt_x, 1), **meta})
+
+    out = "\n".join(json.dumps(l) for l in lines)
+    print(out)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(out + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -252,6 +252,9 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     Degenerate 1-shard ring: identical to full_attention.
     """
+    if schedule not in ("zigzag", "naive"):
+        raise ValueError(f"ring schedule {schedule!r}; have "
+                         "('zigzag', 'naive')")
     seq_size = mesh.shape[AXIS_SEQ]
     if seq_size == 1:
         if causal:
@@ -262,9 +265,6 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         raise NotImplementedError(
             "arbitrary masks don't survive the ring rotation; only "
             "causal=True is supported with a sharded seq axis")
-    if schedule not in ("zigzag", "naive"):
-        raise ValueError(f"ring schedule {schedule!r}; have "
-                         "('zigzag', 'naive')")
 
     spec = P(AXIS_DATA, AXIS_SEQ, AXIS_MODEL, None)
     use_zigzag = (causal and schedule == "zigzag"

@@ -69,6 +69,30 @@ def param_sharding(mesh: Mesh, tree: Any) -> Any:
         one, tree, is_leaf=lambda x: isinstance(x, nn.Partitioned))
 
 
+def process_slice(batch: Any) -> Any:
+    """Slice a replicated host batch down to this process's rows.
+
+    ``shard_batch`` expects PROCESS-LOCAL rows under multi-host (the
+    train stream's ShardedBatcher already yields them); eval paths that
+    materialize the same full batch on every process go through this
+    first. Single-process: identity.
+    """
+    pc = jax.process_count()
+    if pc == 1:
+        return batch
+    pi = jax.process_index()
+
+    def one(x):
+        x = np.asarray(x)
+        if x.shape[0] % pc:
+            raise ValueError(
+                f"batch dim {x.shape[0]} not divisible by {pc} processes")
+        n = x.shape[0] // pc
+        return x[pi * n:(pi + 1) * n]
+
+    return jax.tree_util.tree_map(one, batch)
+
+
 def shard_batch(mesh: Mesh, batch: Any, seq_axis: Optional[int] = None) -> Any:
     """device_put a host batch as a globally-sharded array.
 

@@ -21,7 +21,8 @@ from tensorflow_distributed_tpu.data import prefetch_to_mesh
 from tensorflow_distributed_tpu.models import build_model
 from tensorflow_distributed_tpu.parallel import make_mesh
 from tensorflow_distributed_tpu.parallel.mesh import bootstrap, is_chief
-from tensorflow_distributed_tpu.parallel.sharding import shard_batch
+from tensorflow_distributed_tpu.parallel.sharding import (
+    process_slice, shard_batch)
 from tensorflow_distributed_tpu.train import checkpoint as ckpt
 from tensorflow_distributed_tpu.train.optim import make_optimizer
 from tensorflow_distributed_tpu.train.state import (
@@ -58,7 +59,10 @@ def evaluate(state: TrainState, eval_fn, task: Task, mesh, batch: int
     totals: Dict[str, float] = {}
     count = 0
     for host_batch in task.eval_batches(batch):
-        b = shard_batch(mesh, host_batch, seq_axis=task.seq_axis)
+        # eval_batches yields the same full batch on every process;
+        # shard_batch wants process-local rows under multi-host.
+        b = shard_batch(mesh, process_slice(host_batch),
+                        seq_axis=task.seq_axis)
         m = jax.device_get(eval_fn(state, b))
         for k, v in m.items():
             totals[k] = totals.get(k, 0.0) + float(v) * batch

@@ -1,0 +1,63 @@
+"""Subprocess entry for tests/test_multihost.py — NOT a pytest module.
+
+One worker process of a 2-process CPU "cluster": the TPU-native
+equivalent of launching the reference's differently-defaulted
+ps/worker scripts on three VMs (mnist_python_m.py:146-161). Here every
+process runs THIS same file; identity comes entirely from the
+TPU_PROCESS_ID / TPU_NUM_PROCESSES / TPU_COORDINATOR_ADDRESS env vars
+consumed by parallel.mesh.bootstrap -> jax.distributed.initialize.
+
+Each process owns 4 virtual CPU devices (XLA_FLAGS set by the parent
+test), so the global mesh is 8-wide; the full train() loop then
+exercises the real multi-host code paths that a single-process run
+never reaches:
+  - bootstrap()'s jax.distributed.initialize branch,
+  - ShardedBatcher's process-disjoint row slicing,
+  - shard_batch's make_array_from_process_local_data branch,
+  - process_slice() on the replicated eval batches,
+  - chief-only logging and checkpoint writes.
+
+Writes a JSON result (final metrics + a params checksum) for the
+parent test to compare against its single-process 8-device baseline.
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    out_path = sys.argv[1]
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from tensorflow_distributed_tpu.config import MeshConfig, TrainConfig
+    from tensorflow_distributed_tpu.train.loop import train
+
+    cfg = TrainConfig(
+        model="mnist_cnn", dataset="synthetic", batch_size=64,
+        train_steps=6, eval_every=0, log_every=0, eval_batch_size=128,
+        checkpoint_dir=os.environ["MH_CKPT_DIR"], checkpoint_every=0,
+        compute_dtype="float32", dropout_rate=0.0,
+        mesh=MeshConfig(data=8), seed=0)
+    result = train(cfg)
+
+    params = jax.device_get(result.state.params)
+    checksum = float(sum(abs(x).sum()
+                         for x in jax.tree_util.tree_leaves(params)))
+    with open(out_path, "w") as f:
+        json.dump({
+            "process_index": jax.process_index(),
+            "process_count": jax.process_count(),
+            "global_devices": len(jax.devices()),
+            "local_devices": len(jax.local_devices()),
+            "step": int(jax.device_get(result.state.step)),
+            "final_metrics": {k: float(v)
+                              for k, v in result.final_metrics.items()},
+            "params_checksum": checksum,
+        }, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -109,3 +109,54 @@ def test_dispatcher_falls_back_off_tpu():
     np.testing.assert_allclose(attention(q, k, v, causal=True),
                                full_attention(q, k, v, _causal_mask()),
                                atol=1e-6)
+
+
+def test_causal_multiblock_skip_matches_oracle():
+    """Small blocks at L=256 give an 8x8 block grid where the causal
+    skip predicate and the DMA re-point index_maps actually fire on the
+    28 above-diagonal pairs — an off-by-one in _kv_needed/_q_needed or
+    the re-point floor-divs would corrupt exactly this case (the
+    default-block tests run a 1x1 grid where skip degenerates away)."""
+    rng = np.random.default_rng(7)
+    B, L, H, D = 2, 256, 2, 16
+    mk = lambda: jnp.asarray(rng.normal(size=(B, L, H, D)), jnp.float32)
+    q, k, v = mk(), mk(), mk()
+
+    def flash(q, k, v):
+        return flash_attention(q, k, v, causal=True, block_q=32,
+                               block_k=64, interpret=True)
+
+    from tensorflow_distributed_tpu.parallel.ring_attention import (
+        causal_bias, full_attention)
+    oracle = full_attention(q, k, v, causal_bias(L, L))
+    np.testing.assert_allclose(np.asarray(flash(q, k, v)),
+                               np.asarray(oracle), rtol=2e-5, atol=2e-5)
+
+    # Gradients through all three kernels on the same multi-block grid.
+    gf = jax.grad(lambda q, k, v: jnp.sum(flash(q, k, v) ** 2),
+                  argnums=(0, 1, 2))(q, k, v)
+    go = jax.grad(
+        lambda q, k, v: jnp.sum(full_attention(q, k, v,
+                                               causal_bias(L, L)) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, go):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_causal_multiblock_uneven_blocks():
+    """bq != bk with bq > bk and bk > bq both exercise the floor-div
+    arithmetic in the skip maps."""
+    rng = np.random.default_rng(8)
+    B, L, H, D = 1, 128, 2, 8
+    mk = lambda: jnp.asarray(rng.normal(size=(B, L, H, D)), jnp.float32)
+    q, k, v = mk(), mk(), mk()
+    from tensorflow_distributed_tpu.parallel.ring_attention import (
+        causal_bias, full_attention)
+    oracle = full_attention(q, k, v, causal_bias(L, L))
+    for bq, bk in [(16, 64), (64, 16), (32, 32)]:
+        out = flash_attention(q, k, v, causal=True, block_q=bq,
+                              block_k=bk, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(oracle),
+                                   rtol=2e-5, atol=2e-5,
+                                   err_msg=f"bq={bq} bk={bk}")
