@@ -114,6 +114,11 @@ class TrainConfig:
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     # Remat (jax.checkpoint) policy for big models: none | full | dots
     remat: str = "none"
+    # Pipeline schedule for model=pipelined_lm: "gpipe" (AD through the
+    # forward schedule; per-stage residuals grow O(M)) or "1f1b"
+    # (hand-scheduled backward interleaved with forward; per-stage
+    # state O(S) — train.pipeline_step).
+    pipeline_schedule: str = "gpipe"
 
     # --- eval / logging --------------------------------------------------
     eval_every: int = 100
@@ -158,6 +163,13 @@ class TrainConfig:
             raise ValueError(f"unknown data_backend {self.data_backend!r}")
         if self.remat not in ("none", "full", "dots"):
             raise ValueError(f"unknown remat {self.remat!r}")
+        if self.pipeline_schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"unknown pipeline_schedule {self.pipeline_schedule!r}")
+        if self.pipeline_schedule == "1f1b" and self.grad_accum_steps > 1:
+            raise ValueError(
+                "pipeline_schedule=1f1b already microbatches; it does "
+                "not compose with grad_accum_steps > 1")
         if self.grad_accum_steps < 1:
             raise ValueError(
                 f"grad_accum_steps must be >= 1, got {self.grad_accum_steps}")

@@ -58,8 +58,8 @@ def build_model(name: str, mesh=None, dropout_rate: Optional[float] = None,
         return transformer.moe_lm(mesh=mesh, **overrides)
     if name == "pipelined_lm":
         from tensorflow_distributed_tpu.models import pipelined
-        # dropout_rate is ignored: the pipelined variant runs dropout-free
-        # (rng plumbing through the scanned schedule isn't wired).
+        if dropout_rate is not None:
+            overrides.setdefault("dropout_rate", dropout_rate)
         overrides.setdefault("compute_dtype", compute_dtype)
         if mesh is None:
             raise ValueError("pipelined_lm needs a mesh (pipe axis)")

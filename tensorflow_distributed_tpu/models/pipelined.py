@@ -14,10 +14,17 @@ shard_map. It duck-types the flax surface create_train_state/apply_model
 consume: ``init(key, tokens, train=False) -> {"params": ...}`` and
 ``apply(variables, tokens, *, train=..., rngs=...)``.
 
-v1 scope: composes with the "data" axis (activations stay
-batch-sharded under GSPMD); "model"/"seq" must be 1 (TP/SP inside a
-pipe-restricted shard_map is a follow-up); dropout is disabled (rng
-plumbing through the scanned schedule isn't wired).
+Composition: the pipe shard_map manualizes ONLY the "pipe" axis, so
+"data" (batch) and "model" (TP) sharding of activations and stage
+params continue to be handled by the surrounding GSPMD partitioner.
+TP metadata can't ride flax module boxes here (tp_partitioning=False,
+see TransformerConfig) — instead init() re-attaches Megatron-style
+"model" names to the STACKED leaves by key-path suffix (_TP_SUFFIX
+rules matching models/transformer.py's layout conventions), so
+PP x TP x DP runs from one boxed pytree. "seq" must still be 1 (ring
+attention's own shard_map nested inside the pipe-manual region is a
+follow-up). Dropout is plumbed: pipeline_apply folds the step key over
+(microbatch, stage), stages fold per-layer.
 """
 
 from __future__ import annotations
@@ -36,6 +43,34 @@ from tensorflow_distributed_tpu.parallel.mesh import (
     AXIS_MODEL, AXIS_PIPE, AXIS_SEQ)
 from tensorflow_distributed_tpu.parallel.pipeline import (
     pipeline_apply, stack_stage_params)
+
+# Megatron-style TP ("model" axis) names for stacked block leaves, by
+# key-path suffix — the same layout conventions models/transformer.py
+# attaches via nn.with_partitioning (its module docstring table). Tuples
+# are the names for the leaf's ORIGINAL dims; init() prepends
+# (pipe, None) for the [S, layers_per_stage, ...] stacking dims.
+_TP_SUFFIX = [
+    (("attn", "qkv", "kernel"), (None, None, AXIS_MODEL, None)),
+    (("attn", "qkv", "bias"), (None, AXIS_MODEL, None)),
+    (("attn", "out", "kernel"), (AXIS_MODEL, None, None)),
+    (("mlp", "up", "kernel"), (None, AXIS_MODEL)),
+    (("mlp", "up", "bias"), (AXIS_MODEL,)),
+    (("mlp", "down", "kernel"), (AXIS_MODEL, None)),
+    # MoE expert weights: expert-parallel over the same axis
+    # (models/moe.py's default expert_axis).
+    (("moe_mlp", "wi"), (AXIS_MODEL, None, None)),
+    (("moe_mlp", "wo"), (AXIS_MODEL, None, None)),
+]
+
+
+def _tp_names(path, ndim):
+    keys = tuple(str(getattr(k, "key", getattr(k, "idx", k)))
+                 for k in path)
+    for suffix, names in _TP_SUFFIX:
+        if keys[-len(suffix):] == suffix:
+            assert len(names) == ndim - 2, (keys, names, ndim)
+            return names
+    return (None,) * (ndim - 2)
 
 
 class _Shell(nn.Module):
@@ -75,21 +110,22 @@ class PipelinedLM:
 
     def __init__(self, cfg: TransformerConfig, mesh: Mesh,
                  num_microbatches: int = 4, extra_vocab: int = 0):
-        if cfg.dropout_rate:
-            raise ValueError("pipelined variant: dropout_rate must be 0")
         if cfg.tp_partitioning:
             raise ValueError(
                 "pipelined variant needs tp_partitioning=False (flax "
                 "DenseGeneral re-applies the TP constraint inside the "
-                "pipe shard_map; see TransformerConfig.tp_partitioning)")
+                "pipe shard_map; see TransformerConfig.tp_partitioning)"
+                " — TP names are re-attached to the stacked leaves by "
+                "init() instead")
         if cfg.use_flash:
             raise ValueError(
                 "pipelined variant needs use_flash=False (Mosaic calls "
                 "can't sit inside the partial-manual pipe shard_map; "
                 "see TransformerConfig.use_flash)")
-        if mesh.shape[AXIS_MODEL] != 1 or mesh.shape[AXIS_SEQ] != 1:
-            raise ValueError("pipelined variant composes with 'data' "
-                             "only; set mesh model=seq=1")
+        if mesh.shape[AXIS_SEQ] != 1:
+            raise ValueError("pipelined variant: mesh seq must be 1 "
+                             "(ring attention inside the pipe-manual "
+                             "region is a follow-up); TP/DP compose")
         S = mesh.shape[AXIS_PIPE]
         if cfg.n_layers % S:
             raise ValueError(
@@ -121,23 +157,28 @@ class PipelinedLM:
             self._block.init(k, x, False)["params"]))(layer_keys)
         staged = stack_stage_params(stacked,
                                     self.mesh.shape[AXIS_PIPE])
-        boxed = jax.tree_util.tree_map(
-            lambda p: nn.Partitioned(
-                p, names=(AXIS_PIPE,) + (None,) * (p.ndim - 1)), staged)
+        boxed = jax.tree_util.tree_map_with_path(
+            lambda path, p: nn.Partitioned(
+                p, names=(AXIS_PIPE, None) + _tp_names(path, p.ndim)),
+            staged)
         return {"params": {"shell": shell_params, "blocks": boxed}}
 
-    def apply(self, variables: Any, tokens: jax.Array, *,
-              train: bool = False, rngs: Optional[Any] = None) -> jax.Array:
-        del rngs  # dropout disabled (checked in __init__)
-        p = variables["params"]
-        x = self._shell.apply({"params": p["shell"]}, tokens,
-                              method="embed")
+    def make_stage_fn(self, train: bool, with_rng: bool):
+        """The per-stage compute: scan this stage's blocks in order,
+        folding the (mb, stage)-scoped key per layer so every
+        (mb, stage, layer) dropout mask is distinct. Shared by the
+        GPipe apply() and the 1F1B train step
+        (train.pipeline_step)."""
 
-        def stage_fn(stage_params, x_mb):
-            # stage_params leaves: [layers_per_stage, ...]; run the
-            # stage's blocks in order via scan-over-layers.
-            def one_layer(x, layer_p):
-                return self._block.apply({"params": layer_p}, x, False), None
+        def stage_fn(stage_params, x_mb, key=None):
+            lps = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
+
+            def one_layer(x, xs):
+                layer_p, li = xs
+                r = ({"dropout": jax.random.fold_in(key, li)}
+                     if with_rng else None)
+                return self._block.apply({"params": layer_p}, x, train,
+                                         rngs=r), None
             if self.cfg.remat:
                 # --remat for the pipelined family: rematerialize each
                 # block on backward (cfg.remat_policy as in
@@ -146,18 +187,36 @@ class PipelinedLM:
                 one_layer = jax.checkpoint(
                     one_layer,
                     policy=resolve_remat_policy(self.cfg.remat_policy))
-            y, _ = jax.lax.scan(one_layer, x_mb, stage_params)
+            y, _ = jax.lax.scan(one_layer, x_mb,
+                                (stage_params, jnp.arange(lps)))
             return y
 
+        return stage_fn
+
+    def embed(self, shell_params: Any, tokens: jax.Array) -> jax.Array:
+        return self._shell.apply({"params": shell_params}, tokens,
+                                 method="embed")
+
+    def head(self, shell_params: Any, x: jax.Array) -> jax.Array:
+        return self._shell.apply({"params": shell_params}, x,
+                                 method="head")
+
+    def apply(self, variables: Any, tokens: jax.Array, *,
+              train: bool = False, rngs: Optional[Any] = None) -> jax.Array:
+        p = variables["params"]
+        x = self.embed(p["shell"], tokens)
+        use_dropout = bool(train and self.cfg.dropout_rate
+                           and rngs and "dropout" in rngs)
+        stage_fn = self.make_stage_fn(train, use_dropout)
         x = pipeline_apply(stage_fn, p["blocks"], x, self.mesh,
-                           self.num_microbatches)
-        return self._shell.apply({"params": p["shell"]}, x, method="head")
+                           self.num_microbatches,
+                           rng=rngs["dropout"] if use_dropout else None)
+        return self.head(p["shell"], x)
 
 
 def pipelined_lm(mesh: Mesh, size: str = "tiny", causal: bool = True,
                  num_microbatches: int = 4, **overrides) -> PipelinedLM:
     """Registry factory ("pipelined_lm"). Sizes: "tiny" (tests/CI)."""
-    overrides.setdefault("dropout_rate", 0.0)
     overrides.setdefault("n_layers", 4)  # tiny default (2) < common S
     overrides["causal"] = causal
     overrides["tp_partitioning"] = False  # see TransformerConfig notes

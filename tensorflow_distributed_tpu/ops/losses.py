@@ -36,6 +36,27 @@ def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
     return jnp.mean((pred == labels).astype(jnp.float32))
 
 
+def masked_ce_sums(logits: jax.Array, targets: jax.Array,
+                   mask: jax.Array):
+    """UNNORMALIZED masked-CE pieces: (ce_sum, correct_sum, mask_sum).
+
+    The building block shared by the mean-style losses below and the
+    1F1B pipeline's per-microbatch accumulation (parallel.pipeline),
+    which must sum pieces across microbatches and divide by the GLOBAL
+    mask count once — normalizing per microbatch would silently
+    reweight whenever mask counts differ.
+    """
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(
+        logits, targets[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    mask = mask.astype(jnp.float32)
+    ce_sum = jnp.sum((logz - gold) * mask)
+    pred = jnp.argmax(logits, axis=-1)
+    correct = jnp.sum((pred == targets).astype(jnp.float32) * mask)
+    return ce_sum, correct, jnp.sum(mask)
+
+
 def masked_softmax_cross_entropy(logits: jax.Array, targets: jax.Array,
                                  mask: jax.Array) -> jax.Array:
     """Mean cross-entropy over masked positions only (the MLM objective;
@@ -43,16 +64,11 @@ def masked_softmax_cross_entropy(logits: jax.Array, targets: jax.Array,
 
     logits: [B, L, V]; targets: [B, L] ints; mask: [B, L] {0,1}.
     """
-    logits = logits.astype(jnp.float32)
-    logz = jax.nn.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(
-        logits, targets[..., None].astype(jnp.int32), axis=-1)[..., 0]
-    per_tok = (logz - gold) * mask
-    return jnp.sum(per_tok) / jnp.maximum(jnp.sum(mask), 1.0)
+    ce_sum, _, n = masked_ce_sums(logits, targets, mask)
+    return ce_sum / jnp.maximum(n, 1.0)
 
 
 def masked_accuracy(logits: jax.Array, targets: jax.Array,
                     mask: jax.Array) -> jax.Array:
-    pred = jnp.argmax(logits, axis=-1)
-    hit = (pred == targets).astype(jnp.float32) * mask
-    return jnp.sum(hit) / jnp.maximum(jnp.sum(mask), 1.0)
+    _, correct, n = masked_ce_sums(logits, targets, mask)
+    return correct / jnp.maximum(n, 1.0)

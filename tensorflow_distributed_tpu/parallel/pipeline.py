@@ -32,15 +32,22 @@ from jax.sharding import Mesh, PartitionSpec as P
 from tensorflow_distributed_tpu.parallel.mesh import AXIS_PIPE
 
 
-def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
+def pipeline_apply(stage_fn: Callable[..., jax.Array],
                    stage_params: Any, x: jax.Array, mesh: Mesh,
-                   num_microbatches: int) -> jax.Array:
+                   num_microbatches: int,
+                   rng: Any = None) -> jax.Array:
     """Run ``x`` through S pipeline stages with an M-microbatch schedule.
 
     stage_params: pytree whose leaves have leading dim S (sharded
     ``P("pipe")``); ``stage_fn(one_stage_params, x_mb) -> y_mb`` must
     preserve the microbatch shape (a transformer block stack does).
     x: [B, ...] with B % num_microbatches == 0. Returns [B, ...].
+
+    ``rng``: optional PRNG key for in-stage dropout. When given,
+    stage_fn is called as ``stage_fn(params, x_mb, key)`` with a key
+    folded over (microbatch, stage) so no two (mb, stage) pairs share
+    masks; bubble ticks reuse a clipped mb index (their output is
+    masked out at commit, so their mask content is irrelevant).
     """
     S = mesh.shape[AXIS_PIPE]
     M = num_microbatches
@@ -59,13 +66,20 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
         xm = x.reshape(M, mb, *x.shape[1:])
         perm = [(i, (i + 1) % S) for i in range(S)]
 
+        def run_stage(t, inp):
+            if rng is None:
+                return stage_fn(params, inp)
+            key = jax.random.fold_in(
+                jax.random.fold_in(rng, jnp.clip(t - s, 0, M - 1)), s)
+            return stage_fn(params, inp, key)
+
         def tick(carry, t):
             state, outs = carry
             # Stage 0 ingests microbatch t; later stages eat the
             # activation their neighbor pushed last tick.
             feed = jax.lax.dynamic_index_in_dim(
                 xm, jnp.clip(t, 0, M - 1), 0, keepdims=False)
-            y = stage_fn(params, jnp.where(s == 0, feed, state))
+            y = run_stage(t, jnp.where(s == 0, feed, state))
             # The last stage commits finished microbatch t-(S-1).
             oidx = jnp.clip(t - (S - 1), 0, M - 1)
             prev = jax.lax.dynamic_index_in_dim(outs, oidx, 0,
@@ -86,6 +100,194 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
         in_specs=(P(AXIS_PIPE), P()), out_specs=P(AXIS_PIPE),
         check_vma=False)(stage_params, x)
     return out[-1]
+
+
+def bubble_fraction(num_microbatches: int, num_stages: int,
+                    schedule: str = "gpipe") -> float:
+    """Fraction of stage-ticks spent idle (computing masked garbage).
+
+    gpipe: the classic (S-1)/(M+S-1) over M+S-1 forward ticks (the
+    backward pipeline mirrors it under AD). 1f1b: the paired
+    fwd+bwd schedule runs M + 2(S-1) tick pairs, of which 2(S-1) are
+    ramp-up/drain bubbles."""
+    M, S = num_microbatches, num_stages
+    if schedule == "gpipe":
+        return (S - 1) / (M + S - 1)
+    if schedule == "1f1b":
+        return 2 * (S - 1) / (M + 2 * (S - 1))
+    raise ValueError(f"schedule {schedule!r}; have ('gpipe', '1f1b')")
+
+
+def pipeline_value_and_grad(stage_fn: Callable[..., jax.Array],
+                            last_fn: Callable[[Any, jax.Array, Any],
+                                              tuple],
+                            stage_params: Any, last_params: Any,
+                            x: jax.Array, aux: Any, mesh: Mesh,
+                            num_microbatches: int, rng: Any = None,
+                            cotangent_scale: Any = 1.0):
+    """1F1B pipeline: hand-scheduled forward AND backward in one pass.
+
+    GPipe (``pipeline_apply`` + outer AD) must finish every forward
+    before the first backward, so each stage holds O(M) microbatch
+    residuals. Here backward for microbatch m starts as soon as m
+    clears the last stage — the per-microbatch loss (``last_fn``) is
+    computed AT the last stage inside the schedule, seeding the
+    cotangent that flows back up the ring while later microbatches are
+    still flowing down. Peak per-stage state is the input stash of
+    depth min(2S, M) — INDEPENDENT of M — plus the gradient
+    accumulators; backward ticks recompute the stage forward from the
+    stashed input (jax.vjp), the same trade per-stage remat makes.
+
+    Schedule: T = M + 2(S-1) tick pairs; at tick t stage s runs
+    forward for microbatch t - s and backward for t - 2(S-1) + s (when
+    in range). The last stage's backward of microbatch m lands on the
+    same tick as its forward. Bubbles compute on garbage that is
+    masked out of every accumulator (predication, not control flow).
+    Per tick each stage ppermutes its activation DOWN the ring and its
+    input-cotangent UP — neighbor ICI traffic both ways.
+
+    Interfaces:
+      stage_fn(params, x_mb[, key]) -> y_mb       (same as pipeline_apply)
+      last_fn(last_params, y_mb, aux_mb) -> (scalar_sum, metrics_sums)
+        — UNNORMALIZED per-microbatch sums; the caller normalizes.
+      aux: pytree with leading dim B (targets, masks, ...), microbatch-
+        sliced alongside x.
+      cotangent_scale: seed for d(scalar_sum) — e.g. 1/total_mask so
+        the accumulated grads equal the mean-loss grads exactly.
+
+    Returns (value_sum, metrics_sums, (d_stage_params, d_last_params,
+    d_x)) — d_stage_params stage-stacked [S, ...] like stage_params,
+    d_x [B, ...] (feeds the embedding vjp outside).
+    """
+    S = mesh.shape[AXIS_PIPE]
+    M = num_microbatches
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by microbatches {M}")
+    if M < S:
+        raise ValueError(f"need microbatches >= stages ({M} < {S})")
+    mb = B // M
+    D = min(2 * S, M)  # stash depth >= max in-flight (2S - 1)
+
+    def per_pipe(params, last_p, x, aux, scale):
+        params = jax.tree_util.tree_map(lambda p: p[0], params)
+        s = jax.lax.axis_index(AXIS_PIPE)
+        xm = x.reshape(M, mb, *x.shape[1:])
+        auxm = jax.tree_util.tree_map(
+            lambda a: a.reshape(M, mb, *a.shape[1:]), aux)
+        down = [(i, (i + 1) % S) for i in range(S)]
+        up = [((i + 1) % S, i) for i in range(S)]
+        is_last = s == S - 1
+
+        def with_key(m):
+            if rng is None:
+                return lambda p, xx: stage_fn(p, xx)
+            key = jax.random.fold_in(jax.random.fold_in(rng, m), s)
+            return lambda p, xx: stage_fn(p, xx, key)
+
+        def head(m, y):
+            aux_mb = jax.tree_util.tree_map(
+                lambda a: jax.lax.dynamic_index_in_dim(
+                    a, m, 0, keepdims=False), auxm)
+            val, vjp_fn, met = jax.vjp(
+                lambda lp, yy: last_fn(lp, yy, aux_mb), last_p, y,
+                has_aux=True)
+            dlast, dy = vjp_fn(jnp.asarray(scale, val.dtype))
+            return val, met, dlast, dy
+
+        def masked_add(acc, g, pred):
+            return jax.tree_util.tree_map(
+                lambda a, b: a + jnp.where(pred, b.astype(a.dtype), 0),
+                acc, g)
+
+        zero_dp = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        zero_dlast = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), last_p)
+        met_abs = jax.eval_shape(
+            lambda lp, yy, am: last_fn(lp, yy, am)[1], last_p, xm[0],
+            jax.tree_util.tree_map(lambda a: a[0], auxm))
+        zero_met = jax.tree_util.tree_map(
+            lambda a: jnp.zeros(a.shape, a.dtype), met_abs)
+
+        def tick(carry, t):
+            (fwd_msg, bwd_msg, stash, dp_acc, dlast_acc, dx_buf,
+             val_acc, met_acc) = carry
+
+            # ---- forward half: stage s runs microbatch t - s.
+            mf = t - s
+            mf_valid = jnp.logical_and(mf >= 0, mf < M)
+            mf_c = jnp.clip(mf, 0, M - 1)
+            inp = jnp.where(
+                s == 0,
+                jax.lax.dynamic_index_in_dim(xm, mf_c, 0, keepdims=False),
+                fwd_msg)
+            y = with_key(mf_c)(params, inp)
+            slot = jnp.mod(mf_c, D)
+            prev = jax.lax.dynamic_index_in_dim(stash, slot, 0,
+                                                keepdims=False)
+            stash = jax.lax.dynamic_update_index_in_dim(
+                stash, jnp.where(mf_valid, inp, prev), slot, 0)
+
+            # ---- last-stage loss + cotangent seed for the SAME tick's
+            # backward (masked no-op on other stages).
+            hval, hmet, hdlast, hdy = head(mf_c, y)
+            take_head = jnp.logical_and(is_last, mf_valid)
+            val_acc = val_acc + jnp.where(take_head, hval, 0.0)
+            met_acc = masked_add(met_acc, hmet, take_head)
+            dlast_acc = masked_add(dlast_acc, hdlast, take_head)
+
+            # ---- backward half: stage s runs microbatch t-2(S-1)+s.
+            mbk = t - 2 * (S - 1) + s
+            b_valid = jnp.logical_and(mbk >= 0, mbk < M)
+            mb_c = jnp.clip(mbk, 0, M - 1)
+            x_saved = jax.lax.dynamic_index_in_dim(
+                stash, jnp.mod(mb_c, D), 0, keepdims=False)
+            cot = jnp.where(is_last, hdy, bwd_msg)
+            _, vjp_fn = jax.vjp(with_key(mb_c), params, x_saved)
+            dp, dx = vjp_fn(cot.astype(x_saved.dtype))
+            dp_acc = masked_add(dp_acc, dp, b_valid)
+            take_dx = jnp.logical_and(b_valid, s == 0)
+            prev_dx = jax.lax.dynamic_index_in_dim(dx_buf, mb_c, 0,
+                                                   keepdims=False)
+            dx_buf = jax.lax.dynamic_update_index_in_dim(
+                dx_buf, jnp.where(take_dx, dx.astype(dx_buf.dtype),
+                                  prev_dx), mb_c, 0)
+
+            # ---- ring hops: activations down, cotangents up.
+            if S > 1:
+                fwd_msg = jax.lax.ppermute(y, AXIS_PIPE, down)
+                bwd_msg = jax.lax.ppermute(dx, AXIS_PIPE, up)
+            return (fwd_msg, bwd_msg, stash, dp_acc, dlast_acc, dx_buf,
+                    val_acc, met_acc), None
+
+        zero_x = jnp.zeros_like(xm[0])
+        carry0 = (zero_x, zero_x,
+                  jnp.zeros((D,) + xm[0].shape, xm.dtype),
+                  zero_dp, zero_dlast,
+                  jnp.zeros((M,) + xm[0].shape, x.dtype),
+                  jnp.zeros((), jnp.float32), zero_met)
+        T = M + 2 * (S - 1)
+        (_, _, _, dp_acc, dlast_acc, dx_buf, val_acc, met_acc), _ = (
+            jax.lax.scan(tick, carry0, jnp.arange(T)))
+
+        # Only the owning stage holds real values for dlast (last
+        # stage), dx/val/metrics (stage 0 / last) — everyone else holds
+        # zeros, so a pipe-psum replicates the true values.
+        dlast_acc = jax.lax.psum(dlast_acc, AXIS_PIPE)
+        dx_out = jax.lax.psum(dx_buf, AXIS_PIPE).reshape(B, *x.shape[1:])
+        val_acc = jax.lax.psum(val_acc, AXIS_PIPE)
+        met_acc = jax.lax.psum(met_acc, AXIS_PIPE)
+        dp_out = jax.tree_util.tree_map(lambda g: g[None], dp_acc)
+        return dp_out, dlast_acc, dx_out, val_acc, met_acc
+
+    dp, dlast, dx, val, met = jax.shard_map(
+        per_pipe, mesh=mesh, axis_names={AXIS_PIPE},
+        in_specs=(P(AXIS_PIPE), P(), P(), P(), P()),
+        out_specs=(P(AXIS_PIPE), P(), P(), P(), P()),
+        check_vma=False)(stage_params, last_params, x, aux,
+                         cotangent_scale)
+    return val, met, (dp, dlast, dx)
 
 
 def stack_stage_params(layer_params: Any, num_stages: int) -> Any:

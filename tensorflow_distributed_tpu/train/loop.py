@@ -104,9 +104,15 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
         start_step = int(jax.device_get(state.step))
         logger.log_json({"event": "resumed", "step": start_step})
 
-    step_fn = make_train_step(mesh, cfg.seed, loss=task.loss,
-                              batch_shardings=task.batch_shardings,
-                              accum_steps=cfg.grad_accum_steps)
+    if cfg.model == "pipelined_lm" and cfg.pipeline_schedule == "1f1b":
+        from tensorflow_distributed_tpu.train.pipeline_step import (
+            make_1f1b_train_step)
+        step_fn = make_1f1b_train_step(model, mesh, cfg.seed,
+                                       batch_shardings=task.batch_shardings)
+    else:
+        step_fn = make_train_step(mesh, cfg.seed, loss=task.loss,
+                                  batch_shardings=task.batch_shardings,
+                                  accum_steps=cfg.grad_accum_steps)
     eval_fn = make_eval_step(mesh, loss=task.loss,
                              batch_shardings=task.batch_shardings)
     logger.log_json({

@@ -1,0 +1,109 @@
+"""The 1F1B pipelined train step.
+
+The standard train step (train.step) gets pipeline parallelism "for
+free" by differentiating through ``pipeline_apply`` — GPipe semantics:
+all forwards, then AD replays all backwards, so every stage stashes
+O(M) microbatch residuals. This module is the 1F1B alternative: it
+does NOT call jax.grad over the pipeline at all. Gradients come from
+``parallel.pipeline.pipeline_value_and_grad``, which schedules
+backward microbatches into the same scan as the forwards (the loss is
+computed at the last stage inside the schedule), bounding per-stage
+activation state to an input stash of depth min(2S, M) — independent
+of the microbatch count.
+
+What remains under ordinary AD is only the embedding (outside the
+pipe): its gradient is assembled from the d_x the scheduled backward
+emits at stage 0, via one jax.vjp around the embed call. Head (final
+LN + lm_head) gradients come out of the schedule's last stage. The two
+shell contributions add: grads_shell = d(embed path) + d(head path).
+
+Loss parity: per-microbatch CE pieces are UNNORMALIZED sums seeded
+with cotangent_scale = 1/total_mask, so accumulated gradients and the
+reported loss equal the mean-masked-CE of the whole global batch
+exactly — the same objective mlm_loss computes (train.tasks), which is
+what makes the 1F1B-vs-GPipe parity test exact rather than approximate.
+
+No reference counterpart: the reference has no pipeline parallelism at
+all (SURVEY.md §2b checklist) — both schedules are beyond-reference,
+TPU-native designs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from tensorflow_distributed_tpu.models.pipelined import PipelinedLM
+from tensorflow_distributed_tpu.ops.losses import masked_ce_sums
+from tensorflow_distributed_tpu.parallel.pipeline import (
+    pipeline_value_and_grad)
+from tensorflow_distributed_tpu.train.state import TrainState
+from tensorflow_distributed_tpu.train.tasks import mlm_batch_shardings
+from tensorflow_distributed_tpu.utils import prng
+
+
+def make_1f1b_train_step(model: PipelinedLM, mesh: Mesh, seed: int = 0,
+                         batch_shardings: Any = None, donate: bool = True,
+                         jit: bool = True
+                         ) -> Callable[[TrainState, Any],
+                                       Tuple[TrainState, Dict]]:
+    """Build the jitted 1F1B step for a PipelinedLM.
+
+    Consumes the same {tokens, targets, mask} batches, TrainState, and
+    optimizer as the standard step — only the schedule differs.
+    """
+    if batch_shardings is None:
+        batch_shardings = mlm_batch_shardings(mesh)
+    use_dropout = bool(model.cfg.dropout_rate)
+
+    def step(state: TrainState, batch: Any) -> Tuple[TrainState, Dict]:
+        tokens, targets = batch["tokens"], batch["targets"]
+        mask = batch["mask"]
+        total = jnp.maximum(jnp.sum(mask.astype(jnp.float32)), 1.0)
+        shell, blocks = state.params["shell"], state.params["blocks"]
+        dkey = prng.step_key(seed, state.step)
+
+        x, embed_vjp = jax.vjp(lambda sp: model.embed(sp, tokens), shell)
+
+        stage_fn = model.make_stage_fn(train=True, with_rng=use_dropout)
+
+        def last_fn(sp, y_mb, aux_mb):
+            logits = model.head(sp, y_mb)
+            tgt, msk = aux_mb
+            ce_sum, correct, n = masked_ce_sums(logits, tgt, msk)
+            return ce_sum, {"correct": correct, "mask": n}
+
+        ce_sum, sums, (d_blocks, d_shell_head, d_x) = (
+            pipeline_value_and_grad(
+                stage_fn, last_fn, blocks, shell, x, (targets, mask),
+                mesh, model.num_microbatches,
+                rng=dkey if use_dropout else None,
+                cotangent_scale=1.0 / total))
+        (d_shell_embed,) = embed_vjp(d_x.astype(x.dtype))
+        d_shell = jax.tree_util.tree_map(
+            lambda a, b: a.astype(jnp.float32) + b.astype(jnp.float32),
+            d_shell_embed, d_shell_head)
+        grads = {"shell": d_shell, "blocks": d_blocks}
+
+        updates, new_opt = state.tx.update(grads, state.opt_state,
+                                           state.params)
+        new_params = jax.tree_util.tree_map(
+            lambda p, u: p + u.astype(p.dtype), state.params, updates)
+        metrics = {"loss": ce_sum / total,
+                   "accuracy": sums["correct"] / jnp.maximum(
+                       sums["mask"], 1.0)}
+        new_state = state.replace(step=state.step + 1, params=new_params,
+                                  opt_state=new_opt)
+        return new_state, metrics
+
+    if not jit:
+        return step
+    with mesh:
+        return jax.jit(
+            step,
+            in_shardings=(None, batch_shardings),
+            donate_argnums=(0,) if donate else (),
+        )
