@@ -177,10 +177,13 @@ def main(argv=None) -> None:
     ]
 
     if not args.skip_ab:
-        # Same step, use_flash=False: attention falls to the XLA path
-        # (parallel.ring_attention.full_attention under jit). Drop the
-        # flash run's state/executable first — two resident GPT-2 train
-        # states don't fit 16G HBM at batch 16.
+        # STEP-LEVEL A/B, not a kernel microbenchmark: use_flash=False
+        # re-jits the whole step (attention falls to the XLA path,
+        # parallel.ring_attention.full_attention), so remat/fusion
+        # differences elsewhere ride into the ratio too — the metric
+        # name says "step_speedup" deliberately. Drop the flash run's
+        # state/executable first — two resident GPT-2 train states
+        # don't fit 16G HBM at batch 16.
         del state, step, batch
         _, state_x, step_x, batch_x = _build(
             args.size, args.seq_len, False, args.remat, args.batch, mesh)
