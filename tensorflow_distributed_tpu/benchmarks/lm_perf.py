@@ -71,7 +71,7 @@ def flops_per_token(params, cfg) -> float:
 
 
 def _build(size: str, seq_len: int, use_flash: bool, remat: str,
-           batch: int, mesh, seed: int = 0):
+           batch: int, mesh, seed: int = 0, pipeline_mb: int = 0):
     import jax
     import numpy as np
     import optax
@@ -87,12 +87,27 @@ def _build(size: str, seq_len: int, use_flash: bool, remat: str,
     kw = dict(max_len=seq_len, dropout_rate=0.0, use_flash=use_flash)
     if remat != "none":
         kw.update(remat=True, remat_policy=remat)
-    model = gpt_lm(mesh, size=size, **kw)
+    if pipeline_mb > 0:
+        # The flagship through the pipeline: pipelined_lm + the
+        # hand-scheduled 1F1B step, flash kernel inside the pipe
+        # shard_map (models/pipelined.py).
+        from tensorflow_distributed_tpu.models.pipelined import (
+            pipelined_lm)
+        from tensorflow_distributed_tpu.train.pipeline_step import (
+            make_1f1b_train_step)
+        model = pipelined_lm(mesh, size=size,
+                             num_microbatches=pipeline_mb, **kw)
+    else:
+        model = gpt_lm(mesh, size=size, **kw)
     state = create_train_state(
         model, optax.adam(3e-4), np.zeros((2, seq_len), np.int32), mesh,
         seed)
-    step = make_train_step(mesh, seed, loss=mlm_loss,
-                           batch_shardings=mlm_batch_shardings(mesh))
+    if pipeline_mb > 0:
+        step = make_1f1b_train_step(
+            model, mesh, seed, batch_shardings=mlm_batch_shardings(mesh))
+    else:
+        step = make_train_step(mesh, seed, loss=mlm_loss,
+                               batch_shardings=mlm_batch_shardings(mesh))
     ds = synthetic_clm(n=batch, seq_len=seq_len,
                        vocab_size=model.cfg.vocab_size, seed=seed)
     hb = ds.batch(np.arange(batch))
@@ -130,6 +145,12 @@ def main(argv=None) -> None:
                         choices=["none", "full", "dots"])
     parser.add_argument("--skip-ab", action="store_true",
                         help="skip the flash-vs-XLA attention A/B")
+    parser.add_argument("--pipeline-microbatches", type=int, default=0,
+                        help="> 0: run the pipelined flagship instead "
+                        "(1F1B schedule, flash inside the pipe "
+                        "shard_map) with this many microbatches; the "
+                        "mesh becomes (data=1, pipe=n_devices). The "
+                        "flash-vs-XLA A/B is skipped in this mode")
     parser.add_argument("--out", default="",
                         help="also write the JSON lines to this file")
     args = parser.parse_args(argv)
@@ -145,12 +166,15 @@ def main(argv=None) -> None:
 
     enable_persistent_cache()
     n_dev = len(jax.devices())
-    mesh = make_mesh(MeshConfig(data=n_dev))
+    pmb = args.pipeline_microbatches
+    mesh = make_mesh(MeshConfig(data=1, pipe=n_dev) if pmb > 0
+                     else MeshConfig(data=n_dev))
     kind = jax.devices()[0].device_kind
     peak = PEAK_BF16_FLOPS.get(kind)
 
     model, state, step, batch = _build(
-        args.size, args.seq_len, True, args.remat, args.batch, mesh)
+        args.size, args.seq_len, True, args.remat, args.batch, mesh,
+        pipeline_mb=pmb)
     n_params = param_count(state.params)
     fpt = flops_per_token(state.params, model.cfg)
 
@@ -163,9 +187,12 @@ def main(argv=None) -> None:
     tflops = tok_s * fpt / 1e12
     mfu = tflops * 1e12 / (peak * n_dev) if peak else None
 
-    meta = {"model": f"gpt_lm/{args.size}", "params": n_params,
+    family = ("pipelined_lm/1f1b" if pmb > 0 else "gpt_lm")
+    meta = {"model": f"{family}/{args.size}", "params": n_params,
             "batch": args.batch, "seq_len": args.seq_len,
             "device": kind, "devices": n_dev, "remat": args.remat}
+    if pmb > 0:
+        meta["pipeline_microbatches"] = pmb
     lines = [
         {"metric": "lm_train_tokens_per_sec", "value": round(tok_s, 1),
          "unit": "tokens/sec", **meta},
@@ -176,7 +203,12 @@ def main(argv=None) -> None:
          "unit": "%", **meta},
     ]
 
-    if not args.skip_ab:
+    if not args.skip_ab and pmb > 0:
+        import sys
+        print("[lm_perf] flash-vs-XLA A/B skipped in pipeline mode "
+              "(run without --pipeline-microbatches for it)",
+              file=sys.stderr)
+    if not args.skip_ab and pmb == 0:
         # STEP-LEVEL A/B, not a kernel microbenchmark: use_flash=False
         # re-jits the whole step (attention falls to the XLA path,
         # parallel.ring_attention.full_attention), so remat/fusion

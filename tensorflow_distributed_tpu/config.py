@@ -43,7 +43,9 @@ class MeshConfig:
     ``data`` is the data-parallel axis (the reference's worker replicas,
     mnist_python_m.py:62-65); ``model`` is tensor parallelism; ``seq`` is
     sequence/context parallelism (ring attention); ``pipe`` is pipeline
-    parallelism (GPipe microbatch schedule over stage-sharded layers).
+    parallelism (GPipe microbatch schedule over stage-sharded layers);
+    ``expert`` is a dedicated expert-parallel axis for MoE (experts
+    alias the "model" axis when it is 1 — see models/moe.py).
     A value of -1 for ``data`` means "all remaining devices".
     """
 
@@ -51,9 +53,10 @@ class MeshConfig:
     model: int = 1
     seq: int = 1
     pipe: int = 1
+    expert: int = 1
 
     def validate(self) -> None:
-        for name in ("model", "seq", "pipe"):
+        for name in ("model", "seq", "pipe", "expert"):
             v = getattr(self, name)
             if v < 1:
                 raise ValueError(f"mesh.{name} must be >= 1, got {v}")
@@ -110,6 +113,16 @@ class TrainConfig:
     # bfloat16 matmuls keep the MXU fed; params/optimizer stay f32.
     compute_dtype: str = "bfloat16"  # bfloat16 | float32
 
+    # --- MoE (transformer families only) ---------------------------------
+    # > 0 overrides the family's expert count (moe_lm defaults to 4;
+    # gpt_lm/bert_mlm/pipelined_lm default dense). Any transformer
+    # family with experts trains with the MoE objective.
+    moe_experts: int = 0
+    # Switch-Transformer-style load-balancing coefficient.
+    moe_aux_weight: float = 0.01
+    # ST-MoE router z-loss coefficient (0 = off).
+    moe_zloss_weight: float = 0.0
+
     # --- mesh / parallelism ---------------------------------------------
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     # Remat (jax.checkpoint) policy for big models: none | full | dots
@@ -119,6 +132,10 @@ class TrainConfig:
     # (hand-scheduled backward interleaved with forward; per-stage
     # state O(S) — train.pipeline_step).
     pipeline_schedule: str = "gpipe"
+    # Microbatches per pipeline step (M): batch_size % M == 0 and
+    # M >= mesh.pipe. More microbatches shrink the bubble,
+    # (S-1)/(M+S-1) for gpipe (parallel.pipeline.bubble_fraction).
+    pipeline_microbatches: int = 4
 
     # --- eval / logging --------------------------------------------------
     eval_every: int = 100
@@ -170,9 +187,34 @@ class TrainConfig:
             raise ValueError(
                 "pipeline_schedule=1f1b already microbatches; it does "
                 "not compose with grad_accum_steps > 1")
+        if self.pipeline_microbatches < 1:
+            raise ValueError(
+                f"pipeline_microbatches must be >= 1, "
+                f"got {self.pipeline_microbatches}")
+        if (self.model == "pipelined_lm"
+                and self.batch_size % self.pipeline_microbatches):
+            raise ValueError(
+                f"batch_size {self.batch_size} not divisible by "
+                f"pipeline_microbatches {self.pipeline_microbatches}")
+        if (self.model == "pipelined_lm"
+                and self.pipeline_microbatches < self.mesh.pipe):
+            raise ValueError(
+                f"pipeline_microbatches {self.pipeline_microbatches} "
+                f"< mesh.pipe {self.mesh.pipe}: every stage needs at "
+                f"least one microbatch in flight")
         if self.grad_accum_steps < 1:
             raise ValueError(
                 f"grad_accum_steps must be >= 1, got {self.grad_accum_steps}")
+        if self.moe_experts < 0:
+            raise ValueError(
+                f"moe_experts must be >= 0, got {self.moe_experts}")
+        if self.moe_experts > 0 and self.model not in (
+                "bert_mlm", "gpt_lm", "moe_lm", "pipelined_lm"):
+            raise ValueError(
+                f"moe_experts > 0 needs a transformer family, "
+                f"got model={self.model!r}")
+        if self.moe_aux_weight < 0 or self.moe_zloss_weight < 0:
+            raise ValueError("moe_aux_weight/moe_zloss_weight must be >= 0")
         if self.batch_size % self.grad_accum_steps:
             raise ValueError(
                 f"batch_size {self.batch_size} not divisible by "

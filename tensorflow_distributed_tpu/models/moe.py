@@ -17,12 +17,25 @@ Mechanics (top-2, capacity-factor c):
 - dispatch [G, S, E, C] (0/1) routes tokens to expert buffers
   [G, E, C, M]; experts apply their own MLP weights [E, M, H]/[E, H, M];
   combine (dispatch * gate prob) returns them to [G, S, M].
-- Switch-style load-balancing aux loss (E * mean_e f_e * p_e) is sown
-  into the "moe_aux" collection; the MoE task adds it to the objective.
+
+Observability / losses, sown into the "moe_aux" collection (the MoE
+loss collects them with ``collect_aux`` and weights the first two into
+the objective; see train.tasks.make_moe_loss):
+- "load_balance": E * sum_e f_e * p_e over ALL top-k assignments
+  (f_e = routed fraction / K, so sum_e f_e == 1 and a uniform router
+  scores exactly 1.0) — the Switch loss when K == 1, the
+  DeepSeek/Mixtral-style generalization when K > 1.
+- "z_loss": mean (logsumexp of router logits)^2 — the ST-MoE router
+  z-loss that keeps gate logits from drifting to magnitudes where
+  softmax saturates (weight 0 by default; a TrainConfig knob).
+- "dropped_fraction": fraction of (token, k) routing slots past
+  expert capacity — drops are silent passthroughs in the math, so
+  this is the ONLY place overflow is visible. Reported as a train
+  metric, never part of the objective.
 
 Expert axis: "model" by default — expert parallelism composes with the
-existing mesh without a fifth axis; a dedicated axis is a config knob
-away (any mesh axis name works).
+existing mesh without a fifth axis; a dedicated "expert" mesh axis
+(MeshConfig.expert) is supported via the ``expert_axis`` knob.
 """
 
 from __future__ import annotations
@@ -35,6 +48,31 @@ import jax
 import jax.numpy as jnp
 
 from tensorflow_distributed_tpu.parallel.mesh import AXIS_MODEL
+
+# Every MoeMlp sows exactly these names (in this order) per apply.
+AUX_NAMES = ("load_balance", "z_loss", "dropped_fraction")
+
+
+def collect_aux(col) -> dict:
+    """Mean per sow-name over every MoE layer in a "moe_aux" collection.
+
+    ``col`` is the (possibly nested) dict flax returns for the mutable
+    "moe_aux" collection: {layer_path...: {name: (value, ...)}}. Returns
+    {name: scalar} with each layer's sown values averaged — the shape
+    the MoE objective and train metrics consume (train.tasks).
+    """
+    acc: dict = {}
+
+    def walk(node):
+        for k, v in node.items():
+            if isinstance(v, dict):
+                walk(v)
+            else:  # a tuple of sown values (one per sow call)
+                vals = list(v) if isinstance(v, (tuple, list)) else [v]
+                acc.setdefault(k, []).extend(vals)
+
+    walk(col)
+    return {k: sum(v) / len(v) for k, v in acc.items()}
 
 
 class MoeMlp(nn.Module):
@@ -61,8 +99,8 @@ class MoeMlp(nn.Module):
 
         gate_w = self.param("gate", self._winit((None, None)), (M, E),
                             jnp.float32)
-        probs = jax.nn.softmax(
-            x.astype(jnp.float32) @ gate_w, axis=-1)       # [G, S, E]
+        logits = x.astype(jnp.float32) @ gate_w            # [G, S, E]
+        probs = jax.nn.softmax(logits, axis=-1)
 
         # Top-k one-hot masks + gates, built iteratively (K is 1 or 2).
         masks, gates = [], []
@@ -82,11 +120,20 @@ class MoeMlp(nn.Module):
             pos.append(jnp.sum(cum * mask, axis=-1))       # [G, S]
             used = used + jnp.sum(mask, axis=1, keepdims=True)
 
-        # Load-balancing aux loss on the top-1 distribution
-        # (Switch Transformer eq. 4-6): E * sum_e f_e * p_e.
-        f = jnp.mean(masks[0], axis=(0, 1))                # [E]
+        # Load-balancing aux loss over ALL top-k assignments: f_e is the
+        # routed fraction across every (token, k) slot divided by K, so
+        # sum_e f_e == 1 and a perfectly uniform router scores exactly
+        # 1.0 for any K. Reduces to Switch Transformer eq. 4-6 at K=1;
+        # the K>1 form is the DeepSeek/Mixtral-style generalization.
+        f = jnp.mean(sum(masks), axis=(0, 1)) / K          # [E]
         p = jnp.mean(probs, axis=(0, 1))                   # [E]
         self.sow("moe_aux", "load_balance", E * jnp.sum(f * p))
+        # ST-MoE router z-loss: mean squared logsumexp of the gate
+        # logits — bounds logit magnitudes so the routing softmax stays
+        # in a trainable regime. Objective weight is a config knob
+        # (train.tasks.make_moe_loss); 0 disables it.
+        z = jax.nn.logsumexp(logits, axis=-1)              # [G, S]
+        self.sow("moe_aux", "z_loss", jnp.mean(jnp.square(z)))
 
         # dispatch/combine [G, S, E, C]; tokens past capacity drop out.
         dispatch = jnp.zeros((G, S, E, C), jnp.float32)
@@ -101,6 +148,12 @@ class MoeMlp(nn.Module):
             dispatch = dispatch + sel
             gk = g / jnp.maximum(denom, 1e-9) if denom is not None else g
             combine = combine + sel * gk[..., None, None]
+
+        # Overflowed routing slots are silent zeros in the math (the
+        # token passes through the residual unchanged) — surface them.
+        kept = jnp.sum(dispatch) / (G * S * K)
+        self.sow("moe_aux", "dropped_fraction",
+                 jax.lax.stop_gradient(1.0 - kept))
 
         wi = self.param("wi", self._winit((self.expert_axis, None, None)),
                         (E, M, self.d_ff), jnp.float32)

@@ -117,15 +117,16 @@ class PipelinedLM:
                 "pipe shard_map; see TransformerConfig.tp_partitioning)"
                 " — TP names are re-attached to the stacked leaves by "
                 "init() instead")
-        if cfg.use_flash:
-            raise ValueError(
-                "pipelined variant needs use_flash=False (Mosaic calls "
-                "can't sit inside the partial-manual pipe shard_map; "
-                "see TransformerConfig.use_flash)")
         if mesh.shape[AXIS_SEQ] != 1:
             raise ValueError("pipelined variant: mesh seq must be 1 "
                              "(ring attention inside the pipe-manual "
                              "region is a follow-up); TP/DP compose")
+        if dict(mesh.shape).get("expert", 1) != 1:
+            raise ValueError(
+                "pipelined variant: mesh expert must be 1 — the "
+                "stacked-leaf TP name table (_TP_SUFFIX) pins expert "
+                "weights to the \"model\" axis; use mesh.model for EP "
+                "with the pipeline")
         S = mesh.shape[AXIS_PIPE]
         if cfg.n_layers % S:
             raise ValueError(
@@ -134,9 +135,14 @@ class PipelinedLM:
         self.mesh = mesh
         self.num_microbatches = num_microbatches
         self._shell = _Shell(cfg, extra_vocab)
-        # Blocks see no mesh: inside the pipe-restricted shard_map the
-        # attention dispatcher must not try its own dp/tp shard_map.
-        self._block = Block(cfg, None)
+        # use_flash=True: the Block keeps the mesh so the attention
+        # dispatcher (ops.flash_attention.attention) can wrap the
+        # Mosaic kernel in its own NESTED shard_map over the remaining
+        # auto axes (data/model) — the pipe shard_map manualizes only
+        # {"pipe"}, and a Mosaic call needs fully-manual axes. With
+        # use_flash=False the Block sees no mesh and the XLA attention
+        # path partitions under GSPMD as before.
+        self._block = Block(cfg, mesh if cfg.use_flash else None)
 
     # -- flax-compatible surface -----------------------------------------
 
@@ -163,22 +169,42 @@ class PipelinedLM:
             staged)
         return {"params": {"shell": shell_params, "blocks": boxed}}
 
-    def make_stage_fn(self, train: bool, with_rng: bool):
+    def make_stage_fn(self, train: bool, with_rng: bool,
+                      with_aux: bool = False):
         """The per-stage compute: scan this stage's blocks in order,
         folding the (mb, stage)-scoped key per layer so every
         (mb, stage, layer) dropout mask is distinct. Shared by the
-        GPipe apply() and the 1F1B train step
-        (train.pipeline_step)."""
+        GPipe apply() and the 1F1B train step (train.pipeline_step).
+
+        ``with_aux``: collect each MoE block's sown "moe_aux" values
+        (models/moe.py AUX_NAMES) and return ``(y, aux_sums)`` — the
+        pipeline schedules mask bubble ticks and total these across
+        (stage, microbatch); without it the sows are silently dropped
+        (flax no-ops sow on immutable collections), which is exactly
+        the router-collapse trap this flag exists to close."""
+        from tensorflow_distributed_tpu.models.moe import (
+            AUX_NAMES, collect_aux)
 
         def stage_fn(stage_params, x_mb, key=None):
             lps = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
 
-            def one_layer(x, xs):
+            def one_layer(carry, xs):
+                x, aux = carry
                 layer_p, li = xs
                 r = ({"dropout": jax.random.fold_in(key, li)}
                      if with_rng else None)
-                return self._block.apply({"params": layer_p}, x, train,
-                                         rngs=r), None
+                if with_aux:
+                    y, mut = self._block.apply(
+                        {"params": layer_p}, x, train, rngs=r,
+                        mutable=["moe_aux"])
+                    layer_aux = collect_aux(mut["moe_aux"])
+                    aux = {k: aux[k] + jnp.asarray(layer_aux[k],
+                                                   jnp.float32)
+                           for k in AUX_NAMES}
+                else:
+                    y = self._block.apply({"params": layer_p}, x, train,
+                                          rngs=r)
+                return (y, aux), None
             if self.cfg.remat:
                 # --remat for the pipelined family: rematerialize each
                 # block on backward (cfg.remat_policy as in
@@ -187,9 +213,11 @@ class PipelinedLM:
                 one_layer = jax.checkpoint(
                     one_layer,
                     policy=resolve_remat_policy(self.cfg.remat_policy))
-            y, _ = jax.lax.scan(one_layer, x_mb,
-                                (stage_params, jnp.arange(lps)))
-            return y
+            aux0 = ({k: jnp.zeros((), jnp.float32) for k in AUX_NAMES}
+                    if with_aux else ())
+            (y, aux), _ = jax.lax.scan(one_layer, (x_mb, aux0),
+                                       (stage_params, jnp.arange(lps)))
+            return (y, aux) if with_aux else y
 
         return stage_fn
 
@@ -202,25 +230,70 @@ class PipelinedLM:
                                  method="head")
 
     def apply(self, variables: Any, tokens: jax.Array, *,
-              train: bool = False, rngs: Optional[Any] = None) -> jax.Array:
+              train: bool = False, rngs: Optional[Any] = None,
+              mutable: Any = ()):
+        """Forward pass. ``mutable=["moe_aux"]`` (the flax collection
+        surface train.tasks.make_moe_loss speaks) additionally returns
+        the router losses collected THROUGH the pipeline schedule —
+        normalized to per-layer-per-microbatch means so they compare
+        exactly with the non-pipelined families' sown values."""
+        # Normalize the flax-style mutable forms: str | bool | iterable.
+        if isinstance(mutable, str):
+            mutable = (mutable,)
+        elif isinstance(mutable, bool):
+            mutable = ("moe_aux",) if mutable else ()
+        mutable = tuple(mutable)
+        unsupported = set(mutable) - {"moe_aux"}
+        if unsupported:
+            # Fail fast: silently returning a bare array would make a
+            # flax-style `out, mut = apply(...)` unpack split the batch
+            # dim instead of erroring.
+            raise ValueError(
+                f"PipelinedLM.apply supports mutable=['moe_aux'] only; "
+                f"got {sorted(unsupported)}")
+        want_aux = "moe_aux" in mutable
         p = variables["params"]
         x = self.embed(p["shell"], tokens)
         use_dropout = bool(train and self.cfg.dropout_rate
                            and rngs and "dropout" in rngs)
-        stage_fn = self.make_stage_fn(train, use_dropout)
+        if want_aux and self.cfg.moe_experts <= 0:
+            raise ValueError("mutable=['moe_aux'] needs moe_experts > 0")
+        stage_fn = self.make_stage_fn(train, use_dropout,
+                                      with_aux=want_aux)
+        rng = rngs["dropout"] if use_dropout else None
+        if want_aux:
+            x, aux_sums = pipeline_apply(
+                stage_fn, p["blocks"], x, self.mesh,
+                self.num_microbatches, rng=rng, stage_aux=True)
+            denom = self.cfg.n_layers * self.num_microbatches
+            mut = {"moe_aux": {"pipeline": {
+                k: (v / denom,) for k, v in aux_sums.items()}}}
+            return self.head(p["shell"], x), mut
         x = pipeline_apply(stage_fn, p["blocks"], x, self.mesh,
-                           self.num_microbatches,
-                           rng=rngs["dropout"] if use_dropout else None)
+                           self.num_microbatches, rng=rng)
         return self.head(p["shell"], x)
 
 
 def pipelined_lm(mesh: Mesh, size: str = "tiny", causal: bool = True,
                  num_microbatches: int = 4, **overrides) -> PipelinedLM:
-    """Registry factory ("pipelined_lm"). Sizes: "tiny" (tests/CI)."""
-    overrides.setdefault("n_layers", 4)  # tiny default (2) < common S
+    """Registry factory ("pipelined_lm"). Sizes: "tiny" (tests/CI) or
+    "small" (GPT-2-small: 12L x 768d x 12H — the flagship config, run
+    pipelined). ``num_microbatches`` is CLI-exposed as
+    --pipeline-microbatches (config.TrainConfig)."""
     overrides["causal"] = causal
     overrides["tp_partitioning"] = False  # see TransformerConfig notes
-    overrides["use_flash"] = False
-    if size != "tiny":
-        raise ValueError(f"pipelined_lm size {size!r}; have ('tiny',)")
-    return PipelinedLM(tiny_config(**overrides), mesh, num_microbatches)
+    # Pallas flash attention works inside the pipe via a nested
+    # shard_map (see PipelinedLM.__init__); default on like the rest
+    # of the GPT family, opt out with use_flash=False.
+    overrides.setdefault("use_flash", True)
+    if size == "tiny":
+        overrides.setdefault("n_layers", 4)  # tiny default (2) < common S
+        cfg = tiny_config(**overrides)
+    elif size == "small":
+        from tensorflow_distributed_tpu.models.transformer import (
+            gpt2_small_config)
+        cfg = gpt2_small_config(**overrides)
+    else:
+        raise ValueError(
+            f"pipelined_lm size {size!r}; have ('tiny', 'small')")
+    return PipelinedLM(cfg, mesh, num_microbatches)

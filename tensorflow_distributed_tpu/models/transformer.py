@@ -65,18 +65,22 @@ class TransformerConfig:
     # shard_map (the pipeline's). With mesh model == 1 the metadata is
     # meaningless there anyway.
     tp_partitioning: bool = True
-    # Pallas flash attention on TPU. Disabled by the pipelined variant:
-    # a Mosaic call inside the pipe-restricted (partial-manual)
-    # shard_map would need the remaining mesh axes manualized too
-    # ("Mosaic kernels cannot be automatically partitioned") — nested
-    # manualization is a follow-up; until then the pipeline uses the
-    # XLA attention path.
+    # Pallas flash attention on TPU. Works in the pipelined variant
+    # too: the dispatcher (ops.flash_attention.attention) nests a
+    # shard_map over the remaining auto axes inside the pipe-manual
+    # region, so the Mosaic call sees fully-manual axes ("Mosaic
+    # kernels cannot be automatically partitioned" otherwise).
     use_flash: bool = True
     # Mixture-of-Experts: 0 = dense MLP; > 0 replaces every block's MLP
     # with an expert-parallel MoeMlp (models/moe.py).
     moe_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    # Mesh axis the expert dim shards over: "model" (the default — EP
+    # composes with TP's axis) or the dedicated "expert" axis
+    # (MeshConfig.expert). moe_lm auto-selects "expert" when the mesh
+    # has one.
+    moe_expert_axis: str = AXIS_MODEL
 
 
 def bert_base_config(**overrides) -> TransformerConfig:
@@ -103,6 +107,16 @@ def resolve_remat_policy(name: str):
 
 def _dense_init():
     return nn.initializers.normal(stddev=0.02)  # BERT-style
+
+
+def _auto_expert_axis(mesh, overrides) -> None:
+    """Any MoE config on a mesh with a real dedicated "expert" axis
+    defaults to sharding experts over it — otherwise wi/wo would name
+    the size-1 "model" axis and the expert-axis device group would do
+    fully redundant work with no warning."""
+    if (overrides.get("moe_experts", 0) > 0 and mesh is not None
+            and dict(mesh.shape).get("expert", 1) > 1):
+        overrides.setdefault("moe_expert_axis", "expert")
 
 
 
@@ -206,6 +220,7 @@ class Block(nn.Module):
                        num_experts=cfg.moe_experts, top_k=cfg.moe_top_k,
                        capacity_factor=cfg.moe_capacity_factor,
                        compute_dtype=cfg.compute_dtype,
+                       expert_axis=cfg.moe_expert_axis,
                        partitioned=cfg.tp_partitioning,
                        name="moe_mlp")(y.astype(cfg.compute_dtype))
         else:
@@ -284,6 +299,7 @@ def bert_base_mlm(mesh: Optional[Mesh] = None, size: str = "base",
                   **overrides) -> BertMLM:
     """Factory for the registry. ``size``: "base" (BERT-base) or "tiny"
     (test scale); ``overrides`` are TransformerConfig fields."""
+    _auto_expert_axis(mesh, overrides)
     if size == "base":
         cfg = bert_base_config(**overrides)
     elif size == "tiny":
@@ -297,6 +313,17 @@ def bert_tiny_mlm(mesh: Optional[Mesh] = None, **overrides) -> BertMLM:
     return BertMLM(tiny_config(**overrides), mesh)
 
 
+def gpt2_small_config(**overrides) -> TransformerConfig:
+    """GPT-2-small (12L x 768d x 12H, learned positions, pre-LN) — the
+    flagship config, shared by gpt_lm and the pipelined factory so the
+    two families can never drift apart."""
+    return dataclasses.replace(
+        TransformerConfig(vocab_size=50257, d_model=768, n_layers=12,
+                          n_heads=12, d_ff=3072, max_len=1024,
+                          causal=True),
+        **overrides)
+
+
 def gpt_lm(mesh: Optional[Mesh] = None, size: str = "small",
            **overrides) -> CausalLM:
     """GPT-style decoder-only LM. ``size``: "small" (GPT-2-small-ish:
@@ -304,11 +331,9 @@ def gpt_lm(mesh: Optional[Mesh] = None, size: str = "small",
     No reference counterpart (the reference has no sequence models,
     SURVEY.md §5) — designed TPU-first like the rest of this family."""
     overrides["causal"] = True
+    _auto_expert_axis(mesh, overrides)
     if size == "small":
-        cfg = dataclasses.replace(
-            TransformerConfig(vocab_size=50257, d_model=768, n_layers=12,
-                              n_heads=12, d_ff=3072, max_len=1024),
-            **overrides)
+        cfg = gpt2_small_config(**overrides)
     elif size == "tiny":
         cfg = tiny_config(**overrides)
     else:
@@ -324,4 +349,4 @@ def moe_lm(mesh: Optional[Mesh] = None, size: str = "tiny",
     overrides.setdefault("moe_experts", 4)
     if overrides["moe_experts"] <= 0:
         raise ValueError("moe_lm needs moe_experts > 0")
-    return gpt_lm(mesh=mesh, size=size, **overrides)
+    return gpt_lm(mesh=mesh, size=size, **overrides)  # auto expert axis

@@ -391,26 +391,59 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array,
     ``mesh``: when the surrounding step is GSPMD-partitioned over a
     multi-device mesh, the Mosaic custom call has no partitioning rule
     of its own, so the kernel is wrapped in a shard_map over the
-    (batch="data", heads="model") axes — each device runs the kernel on
-    its local shard; no cross-device comms are needed because batch and
-    heads are embarrassingly parallel in attention.
+    (batch="data", heads="model") axes (+ "expert", where activations
+    are replicated) — each device runs the kernel on its local shard;
+    no cross-device comms are needed because batch and heads are
+    embarrassingly parallel in attention. The shard_map names only
+    those axes, NOT "pipe": inside the pipelined family's pipe-manual
+    shard_map this nests as a partial manualization of the remaining
+    auto axes, which is what lets the Mosaic kernel run inside the
+    pipeline ("seq" stays auto and is 1 on every path that reaches
+    flash — ring attention owns seq > 1).
+
+    Setting TFD_FLASH_INTERPRET=1 forces this flash path off-TPU with
+    the interpreter, so tests can exercise the full nested-shard_map
+    structure on the 8-device CPU mesh.
     """
+    import os
+
     from tensorflow_distributed_tpu.parallel.mesh import (
-        AXIS_DATA, AXIS_MODEL)
+        AXIS_DATA, AXIS_EXPERT, AXIS_MODEL)
     from tensorflow_distributed_tpu.parallel.ring_attention import (
         full_attention)
     B, L, H, D = q.shape
-    if (allow_flash and mask is None and jax.default_backend() == "tpu"
+    on_tpu = jax.default_backend() == "tpu"
+    force = os.environ.get("TFD_FLASH_INTERPRET", "") == "1"
+    if (allow_flash and mask is None and (on_tpu or force)
             and supported(L, k.shape[1], D)):
-        if mesh is None or (mesh.shape[AXIS_DATA] == 1
-                            and mesh.shape[AXIS_MODEL] == 1):
-            return flash_attention(q, k, v, causal=causal)
         from jax.sharding import PartitionSpec as P
         spec = P(AXIS_DATA, None, AXIS_MODEL, None)
+        kernel = lambda q, k, v: flash_attention(  # noqa: E731
+            q, k, v, causal=causal)
+        ctx = jax.sharding.get_abstract_mesh()
+        if ctx.manual_axes:
+            # Inside an enclosing shard_map (the pipelined family's
+            # pipe-manual region): Mosaic refuses to lower while ANY
+            # axis is still auto — even a size-1 one — so nest a
+            # shard_map over every remaining auto axis, handing it the
+            # CONTEXT abstract mesh (the one whose "pipe" is already
+            # Manual), not the concrete mesh. "seq" is always 1 on the
+            # flash path (ring attention owns seq > 1), so leaving it
+            # out of the specs replicates correctly.
+            remaining = set(ctx.axis_names) - set(ctx.manual_axes)
+            return jax.shard_map(
+                kernel, mesh=ctx, in_specs=(spec, spec, spec),
+                out_specs=spec, axis_names=remaining,
+                check_vma=False)(q, k, v)
+        if mesh is None or all(
+                mesh.shape[a] == 1
+                for a in (AXIS_DATA, AXIS_MODEL, AXIS_EXPERT)):
+            return flash_attention(q, k, v, causal=causal)
+        # GSPMD-partitioned step: fully-manual shard_map over the mesh;
+        # batch and heads are embarrassingly parallel, no comms.
         return jax.shard_map(
-            lambda q, k, v: flash_attention(q, k, v, causal=causal),
-            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-            check_vma=False)(q, k, v)
+            kernel, mesh=mesh, in_specs=(spec, spec, spec),
+            out_specs=spec, check_vma=False)(q, k, v)
     if causal:
         from tensorflow_distributed_tpu.parallel.ring_attention import (
             causal_bias)

@@ -34,7 +34,8 @@ AXIS_DATA = "data"
 AXIS_MODEL = "model"
 AXIS_SEQ = "seq"
 AXIS_PIPE = "pipe"
-MESH_AXES = (AXIS_DATA, AXIS_PIPE, AXIS_SEQ, AXIS_MODEL)
+AXIS_EXPERT = "expert"
+MESH_AXES = (AXIS_DATA, AXIS_PIPE, AXIS_SEQ, AXIS_MODEL, AXIS_EXPERT)
 
 _bootstrapped = False
 
@@ -98,16 +99,18 @@ def make_mesh(cfg: Optional[MeshConfig] = None,
     cfg.validate()
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
-    denom = cfg.model * cfg.seq * cfg.pipe
+    denom = cfg.model * cfg.seq * cfg.pipe * cfg.expert
     if n % denom != 0:
         raise ValueError(
-            f"{n} devices not divisible by pipe*seq*model = "
-            f"{cfg.pipe}*{cfg.seq}*{cfg.model}")
+            f"{n} devices not divisible by pipe*seq*model*expert = "
+            f"{cfg.pipe}*{cfg.seq}*{cfg.model}*{cfg.expert}")
     data = cfg.data if cfg.data != -1 else n // denom
     if data * denom != n:
         raise ValueError(
-            f"mesh {data}x{cfg.pipe}x{cfg.seq}x{cfg.model} != {n} devices")
-    arr = np.array(devices).reshape(data, cfg.pipe, cfg.seq, cfg.model)
+            f"mesh {data}x{cfg.pipe}x{cfg.seq}x{cfg.model}x{cfg.expert}"
+            f" != {n} devices")
+    arr = np.array(devices).reshape(data, cfg.pipe, cfg.seq, cfg.model,
+                                    cfg.expert)
     return Mesh(arr, MESH_AXES)
 
 

@@ -35,7 +35,7 @@ from tensorflow_distributed_tpu.parallel.mesh import AXIS_PIPE
 def pipeline_apply(stage_fn: Callable[..., jax.Array],
                    stage_params: Any, x: jax.Array, mesh: Mesh,
                    num_microbatches: int,
-                   rng: Any = None) -> jax.Array:
+                   rng: Any = None, stage_aux: bool = False):
     """Run ``x`` through S pipeline stages with an M-microbatch schedule.
 
     stage_params: pytree whose leaves have leading dim S (sharded
@@ -48,6 +48,13 @@ def pipeline_apply(stage_fn: Callable[..., jax.Array],
     folded over (microbatch, stage) so no two (mb, stage) pairs share
     masks; bubble ticks reuse a clipped mb index (their output is
     masked out at commit, so their mask content is irrelevant).
+
+    ``stage_aux``: when True, stage_fn returns ``(y_mb, aux)`` with
+    ``aux`` a pytree of scalars (e.g. MoE router losses); bubble-tick
+    aux is masked out and the call returns ``(out, aux_sums)`` where
+    aux_sums are summed over all (stage, microbatch) pairs —
+    differentiable, so AD through this schedule back-propagates router
+    losses too.
     """
     S = mesh.shape[AXIS_PIPE]
     M = num_microbatches
@@ -68,18 +75,31 @@ def pipeline_apply(stage_fn: Callable[..., jax.Array],
 
         def run_stage(t, inp):
             if rng is None:
-                return stage_fn(params, inp)
-            key = jax.random.fold_in(
-                jax.random.fold_in(rng, jnp.clip(t - s, 0, M - 1)), s)
-            return stage_fn(params, inp, key)
+                out = stage_fn(params, inp)
+            else:
+                key = jax.random.fold_in(
+                    jax.random.fold_in(rng, jnp.clip(t - s, 0, M - 1)), s)
+                out = stage_fn(params, inp, key)
+            return out if stage_aux else (out, ())
+
+        if stage_aux:
+            aux0 = jax.eval_shape(lambda: run_stage(0, xm[0])[1])
+            aux0 = jax.tree_util.tree_map(
+                lambda a: jnp.zeros(a.shape, a.dtype), aux0)
+        else:
+            aux0 = ()
 
         def tick(carry, t):
-            state, outs = carry
+            state, outs, aux_acc = carry
             # Stage 0 ingests microbatch t; later stages eat the
             # activation their neighbor pushed last tick.
             feed = jax.lax.dynamic_index_in_dim(
                 xm, jnp.clip(t, 0, M - 1), 0, keepdims=False)
-            y = run_stage(t, jnp.where(s == 0, feed, state))
+            y, aux = run_stage(t, jnp.where(s == 0, feed, state))
+            # Stage s does real work for microbatch t - s only.
+            valid = jnp.logical_and(t - s >= 0, t - s < M)
+            aux_acc = jax.tree_util.tree_map(
+                lambda a, b: a + jnp.where(valid, b, 0), aux_acc, aux)
             # The last stage commits finished microbatch t-(S-1).
             oidx = jnp.clip(t - (S - 1), 0, M - 1)
             prev = jax.lax.dynamic_index_in_dim(outs, oidx, 0,
@@ -87,19 +107,25 @@ def pipeline_apply(stage_fn: Callable[..., jax.Array],
             write = jnp.logical_and(s == S - 1, t >= S - 1)
             outs = jax.lax.dynamic_update_index_in_dim(
                 outs, jnp.where(write, y, prev), oidx, 0)
-            return (jax.lax.ppermute(y, AXIS_PIPE, perm), outs), None
+            return (jax.lax.ppermute(y, AXIS_PIPE, perm), outs,
+                    aux_acc), None
 
         outs0 = jnp.zeros_like(xm)
-        (_, outs), _ = jax.lax.scan(tick, (jnp.zeros_like(xm[0]), outs0),
-                                    jnp.arange(M + S - 1))
+        (_, outs, aux_acc), _ = jax.lax.scan(
+            tick, (jnp.zeros_like(xm[0]), outs0, aux0),
+            jnp.arange(M + S - 1))
         # Stage-stacked output: only the last stage's slice is real.
-        return outs.reshape(B, *x.shape[1:])[None]
+        # Aux is real on EVERY stage; psum totals it over the pipe.
+        aux_tot = jax.tree_util.tree_map(
+            lambda a: jax.lax.psum(a, AXIS_PIPE), aux_acc)
+        return outs.reshape(B, *x.shape[1:])[None], aux_tot
 
-    out = jax.shard_map(
+    out, aux = jax.shard_map(
         per_pipe, mesh=mesh, axis_names={AXIS_PIPE},
-        in_specs=(P(AXIS_PIPE), P()), out_specs=P(AXIS_PIPE),
+        in_specs=(P(AXIS_PIPE), P()),
+        out_specs=(P(AXIS_PIPE), P()),
         check_vma=False)(stage_params, x)
-    return out[-1]
+    return (out[-1], aux) if stage_aux else out[-1]
 
 
 def bubble_fraction(num_microbatches: int, num_stages: int,
@@ -124,7 +150,8 @@ def pipeline_value_and_grad(stage_fn: Callable[..., jax.Array],
                             stage_params: Any, last_params: Any,
                             x: jax.Array, aux: Any, mesh: Mesh,
                             num_microbatches: int, rng: Any = None,
-                            cotangent_scale: Any = 1.0):
+                            cotangent_scale: Any = 1.0,
+                            stage_aux_cotangent: Any = None):
     """1F1B pipeline: hand-scheduled forward AND backward in one pass.
 
     GPipe (``pipeline_apply`` + outer AD) must finish every forward
@@ -158,6 +185,15 @@ def pipeline_value_and_grad(stage_fn: Callable[..., jax.Array],
     Returns (value_sum, metrics_sums, (d_stage_params, d_last_params,
     d_x)) — d_stage_params stage-stacked [S, ...] like stage_params,
     d_x [B, ...] (feeds the embedding vjp outside).
+
+    ``stage_aux_cotangent``: when not None, stage_fn returns
+    ``(y_mb, aux)`` (aux a pytree of scalars — MoE router losses) and
+    this argument is the matching pytree of objective weights: each
+    backward tick seeds the stage vjp with (d_y, stage_aux_cotangent),
+    so router-loss gradients flow into both the stage params and the
+    upstream activations exactly like any other loss term. The return
+    grows a 4th element: aux sums over all (stage, microbatch) pairs
+    — (value_sum, metrics_sums, aux_sums, grads).
     """
     S = mesh.shape[AXIS_PIPE]
     M = num_microbatches
@@ -179,11 +215,16 @@ def pipeline_value_and_grad(stage_fn: Callable[..., jax.Array],
         up = [((i + 1) % S, i) for i in range(S)]
         is_last = s == S - 1
 
+        aux_on = stage_aux_cotangent is not None
+
         def with_key(m):
             if rng is None:
-                return lambda p, xx: stage_fn(p, xx)
-            key = jax.random.fold_in(jax.random.fold_in(rng, m), s)
-            return lambda p, xx: stage_fn(p, xx, key)
+                fn = lambda p, xx: stage_fn(p, xx)  # noqa: E731
+            else:
+                key = jax.random.fold_in(jax.random.fold_in(rng, m), s)
+                fn = lambda p, xx: stage_fn(p, xx, key)  # noqa: E731
+            # Normalize to (y, aux) so forward/backward share one shape.
+            return fn if aux_on else (lambda p, xx: (fn(p, xx), ()))
 
         def head(m, y):
             aux_mb = jax.tree_util.tree_map(
@@ -204,6 +245,16 @@ def pipeline_value_and_grad(stage_fn: Callable[..., jax.Array],
             lambda p: jnp.zeros(p.shape, jnp.float32), params)
         zero_dlast = jax.tree_util.tree_map(
             lambda p: jnp.zeros(p.shape, jnp.float32), last_p)
+        if aux_on:
+            aux_abs = jax.eval_shape(
+                lambda: with_key(0)(params, xm[0])[1])
+            zero_aux = jax.tree_util.tree_map(
+                lambda a: jnp.zeros(a.shape, a.dtype), aux_abs)
+            aux_seed = jax.tree_util.tree_map(
+                lambda w, a: jnp.asarray(w, a.dtype),
+                stage_aux_cotangent, zero_aux)
+        else:
+            zero_aux, aux_seed = (), ()
         met_abs = jax.eval_shape(
             lambda lp, yy, am: last_fn(lp, yy, am)[1], last_p, xm[0],
             jax.tree_util.tree_map(lambda a: a[0], auxm))
@@ -212,7 +263,7 @@ def pipeline_value_and_grad(stage_fn: Callable[..., jax.Array],
 
         def tick(carry, t):
             (fwd_msg, bwd_msg, stash, dp_acc, dlast_acc, dx_buf,
-             val_acc, met_acc) = carry
+             val_acc, met_acc, aux_acc) = carry
 
             # ---- forward half: stage s runs microbatch t - s.
             mf = t - s
@@ -222,7 +273,8 @@ def pipeline_value_and_grad(stage_fn: Callable[..., jax.Array],
                 s == 0,
                 jax.lax.dynamic_index_in_dim(xm, mf_c, 0, keepdims=False),
                 fwd_msg)
-            y = with_key(mf_c)(params, inp)
+            y, aux_v = with_key(mf_c)(params, inp)
+            aux_acc = masked_add(aux_acc, aux_v, mf_valid)
             slot = jnp.mod(mf_c, D)
             prev = jax.lax.dynamic_index_in_dim(stash, slot, 0,
                                                 keepdims=False)
@@ -245,7 +297,7 @@ def pipeline_value_and_grad(stage_fn: Callable[..., jax.Array],
                 stash, jnp.mod(mb_c, D), 0, keepdims=False)
             cot = jnp.where(is_last, hdy, bwd_msg)
             _, vjp_fn = jax.vjp(with_key(mb_c), params, x_saved)
-            dp, dx = vjp_fn(cot.astype(x_saved.dtype))
+            dp, dx = vjp_fn((cot.astype(x_saved.dtype), aux_seed))
             dp_acc = masked_add(dp_acc, dp, b_valid)
             take_dx = jnp.logical_and(b_valid, s == 0)
             prev_dx = jax.lax.dynamic_index_in_dim(dx_buf, mb_c, 0,
@@ -259,34 +311,39 @@ def pipeline_value_and_grad(stage_fn: Callable[..., jax.Array],
                 fwd_msg = jax.lax.ppermute(y, AXIS_PIPE, down)
                 bwd_msg = jax.lax.ppermute(dx, AXIS_PIPE, up)
             return (fwd_msg, bwd_msg, stash, dp_acc, dlast_acc, dx_buf,
-                    val_acc, met_acc), None
+                    val_acc, met_acc, aux_acc), None
 
         zero_x = jnp.zeros_like(xm[0])
         carry0 = (zero_x, zero_x,
                   jnp.zeros((D,) + xm[0].shape, xm.dtype),
                   zero_dp, zero_dlast,
                   jnp.zeros((M,) + xm[0].shape, x.dtype),
-                  jnp.zeros((), jnp.float32), zero_met)
+                  jnp.zeros((), jnp.float32), zero_met, zero_aux)
         T = M + 2 * (S - 1)
-        (_, _, _, dp_acc, dlast_acc, dx_buf, val_acc, met_acc), _ = (
-            jax.lax.scan(tick, carry0, jnp.arange(T)))
+        (_, _, _, dp_acc, dlast_acc, dx_buf, val_acc, met_acc,
+         aux_acc), _ = jax.lax.scan(tick, carry0, jnp.arange(T))
 
         # Only the owning stage holds real values for dlast (last
         # stage), dx/val/metrics (stage 0 / last) — everyone else holds
-        # zeros, so a pipe-psum replicates the true values.
+        # zeros, so a pipe-psum replicates the true values. Stage aux is
+        # real on EVERY stage; its psum is the total over stages.
         dlast_acc = jax.lax.psum(dlast_acc, AXIS_PIPE)
         dx_out = jax.lax.psum(dx_buf, AXIS_PIPE).reshape(B, *x.shape[1:])
         val_acc = jax.lax.psum(val_acc, AXIS_PIPE)
         met_acc = jax.lax.psum(met_acc, AXIS_PIPE)
+        aux_out = jax.tree_util.tree_map(
+            lambda a: jax.lax.psum(a, AXIS_PIPE), aux_acc)
         dp_out = jax.tree_util.tree_map(lambda g: g[None], dp_acc)
-        return dp_out, dlast_acc, dx_out, val_acc, met_acc
+        return dp_out, dlast_acc, dx_out, val_acc, met_acc, aux_out
 
-    dp, dlast, dx, val, met = jax.shard_map(
+    dp, dlast, dx, val, met, aux_sums = jax.shard_map(
         per_pipe, mesh=mesh, axis_names={AXIS_PIPE},
         in_specs=(P(AXIS_PIPE), P(), P(), P(), P()),
-        out_specs=(P(AXIS_PIPE), P(), P(), P(), P()),
+        out_specs=(P(AXIS_PIPE), P(), P(), P(), P(), P()),
         check_vma=False)(stage_params, last_params, x, aux,
                          cotangent_scale)
+    if stage_aux_cotangent is not None:
+        return val, met, aux_sums, (dp, dlast, dx)
     return val, met, (dp, dlast, dx)
 
 

@@ -89,6 +89,10 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
             and cfg.model in ("bert_mlm", "gpt_lm", "moe_lm",
                               "pipelined_lm")):
         size_kw.update(remat=True, remat_policy=cfg.remat)
+    if cfg.moe_experts > 0:  # validated: transformer families only
+        size_kw["moe_experts"] = cfg.moe_experts
+    if cfg.model == "pipelined_lm":
+        size_kw["num_microbatches"] = cfg.pipeline_microbatches
     model = build_model(
         cfg.model, mesh=mesh, dropout_rate=cfg.dropout_rate,
         init_scheme=cfg.init_scheme,
@@ -108,7 +112,9 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
         from tensorflow_distributed_tpu.train.pipeline_step import (
             make_1f1b_train_step)
         step_fn = make_1f1b_train_step(model, mesh, cfg.seed,
-                                       batch_shardings=task.batch_shardings)
+                                       batch_shardings=task.batch_shardings,
+                                       moe_aux_weight=cfg.moe_aux_weight,
+                                       moe_zloss_weight=cfg.moe_zloss_weight)
     else:
         step_fn = make_train_step(mesh, cfg.seed, loss=task.loss,
                                   batch_shardings=task.batch_shardings,

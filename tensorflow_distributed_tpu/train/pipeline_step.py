@@ -41,23 +41,32 @@ from tensorflow_distributed_tpu.ops.losses import masked_ce_sums
 from tensorflow_distributed_tpu.parallel.pipeline import (
     pipeline_value_and_grad)
 from tensorflow_distributed_tpu.train.state import TrainState
-from tensorflow_distributed_tpu.train.tasks import mlm_batch_shardings
+from tensorflow_distributed_tpu.train.tasks import (
+    MOE_AUX_WEIGHT, mlm_batch_shardings)
 from tensorflow_distributed_tpu.utils import prng
 
 
 def make_1f1b_train_step(model: PipelinedLM, mesh: Mesh, seed: int = 0,
                          batch_shardings: Any = None, donate: bool = True,
-                         jit: bool = True
+                         jit: bool = True,
+                         moe_aux_weight: float = MOE_AUX_WEIGHT,
+                         moe_zloss_weight: float = 0.0
                          ) -> Callable[[TrainState, Any],
                                        Tuple[TrainState, Dict]]:
     """Build the jitted 1F1B step for a PipelinedLM.
 
     Consumes the same {tokens, targets, mask} batches, TrainState, and
-    optimizer as the standard step — only the schedule differs.
+    optimizer as the standard step — only the schedule differs. When
+    the model is MoE (cfg.moe_experts > 0), the router losses sown
+    inside the pipeline are collected through the schedule and seeded
+    as extra vjp cotangents, so the objective matches the non-pipelined
+    MoE loss: CE + moe_aux_weight * load_balance
+    + moe_zloss_weight * z_loss (train.tasks.make_moe_loss).
     """
     if batch_shardings is None:
         batch_shardings = mlm_batch_shardings(mesh)
     use_dropout = bool(model.cfg.dropout_rate)
+    moe = model.cfg.moe_experts > 0
 
     def step(state: TrainState, batch: Any) -> Tuple[TrainState, Dict]:
         tokens, targets = batch["tokens"], batch["targets"]
@@ -68,7 +77,8 @@ def make_1f1b_train_step(model: PipelinedLM, mesh: Mesh, seed: int = 0,
 
         x, embed_vjp = jax.vjp(lambda sp: model.embed(sp, tokens), shell)
 
-        stage_fn = model.make_stage_fn(train=True, with_rng=use_dropout)
+        stage_fn = model.make_stage_fn(train=True, with_rng=use_dropout,
+                                       with_aux=moe)
 
         def last_fn(sp, y_mb, aux_mb):
             logits = model.head(sp, y_mb)
@@ -76,12 +86,31 @@ def make_1f1b_train_step(model: PipelinedLM, mesh: Mesh, seed: int = 0,
             ce_sum, correct, n = masked_ce_sums(logits, tgt, msk)
             return ce_sum, {"correct": correct, "mask": n}
 
-        ce_sum, sums, (d_blocks, d_shell_head, d_x) = (
-            pipeline_value_and_grad(
-                stage_fn, last_fn, blocks, shell, x, (targets, mask),
-                mesh, model.num_microbatches,
-                rng=dkey if use_dropout else None,
-                cotangent_scale=1.0 / total))
+        kw = dict(rng=dkey if use_dropout else None,
+                  cotangent_scale=1.0 / total)
+        aux_metrics = {}
+        if moe:
+            # Each (layer, microbatch) sow contributes 1/denom to the
+            # mean the objective weights — the cotangent seed per stage
+            # call is therefore weight/denom.
+            denom = model.cfg.n_layers * model.num_microbatches
+            aux_cot = {"load_balance": moe_aux_weight / denom,
+                       "z_loss": moe_zloss_weight / denom,
+                       "dropped_fraction": 0.0}
+            ce_sum, sums, aux_sums, (d_blocks, d_shell_head, d_x) = (
+                pipeline_value_and_grad(
+                    stage_fn, last_fn, blocks, shell, x,
+                    (targets, mask), mesh, model.num_microbatches,
+                    stage_aux_cotangent=aux_cot, **kw))
+            aux_metrics = {"aux_loss": aux_sums["load_balance"] / denom,
+                           "z_loss": aux_sums["z_loss"] / denom,
+                           "dropped_frac":
+                               aux_sums["dropped_fraction"] / denom}
+        else:
+            ce_sum, sums, (d_blocks, d_shell_head, d_x) = (
+                pipeline_value_and_grad(
+                    stage_fn, last_fn, blocks, shell, x,
+                    (targets, mask), mesh, model.num_microbatches, **kw))
         (d_shell_embed,) = embed_vjp(d_x.astype(x.dtype))
         d_shell = jax.tree_util.tree_map(
             lambda a, b: a.astype(jnp.float32) + b.astype(jnp.float32),
@@ -94,7 +123,7 @@ def make_1f1b_train_step(model: PipelinedLM, mesh: Mesh, seed: int = 0,
             lambda p, u: p + u.astype(p.dtype), state.params, updates)
         metrics = {"loss": ce_sum / total,
                    "accuracy": sums["correct"] / jnp.maximum(
-                       sums["mask"], 1.0)}
+                       sums["mask"], 1.0), **aux_metrics}
         new_state = state.replace(step=state.step + 1, params=new_params,
                                   opt_state=new_opt)
         return new_state, metrics

@@ -92,27 +92,40 @@ def mlm_loss(apply_fn, params, extra, batch, dropout_key, train):
 MOE_AUX_WEIGHT = 0.01  # Switch-Transformer-style coefficient
 
 
-def moe_loss(apply_fn, params, extra, batch, dropout_key, train):
-    """CLM objective + load-balancing aux from the "moe_aux" collection
-    the MoeMlp layers sow (models/moe.py)."""
-    # moe_aux is transient (state.TRANSIENT_COLLECTIONS) — never feed a
-    # stale copy back in, or sow would append to it.
-    variables = {"params": params,
-                 **{k: v for k, v in extra.items() if k != "moe_aux"}}
-    rngs = {"dropout": dropout_key} if train else {}
-    logits, mut = apply_fn(variables, batch["tokens"], train=train,
-                           rngs=rngs, mutable=["moe_aux"])
-    loss = masked_softmax_cross_entropy(logits, batch["targets"],
-                                        batch["mask"])
-    aux_leaves = jax.tree_util.tree_leaves(mut.get("moe_aux", {}))
-    aux = (sum(aux_leaves) / len(aux_leaves)) if aux_leaves else 0.0
-    total = loss + MOE_AUX_WEIGHT * aux
-    metrics = {
-        "loss": loss, "aux_loss": aux,
-        "accuracy": masked_accuracy(logits, batch["targets"],
-                                    batch["mask"]),
-    }
-    return total, (metrics, extra)
+def make_moe_loss(aux_weight: float = MOE_AUX_WEIGHT,
+                  zloss_weight: float = 0.0):
+    """CLM objective + router losses from the "moe_aux" collection the
+    MoeMlp layers sow (models/moe.py): load-balance (weighted by
+    ``aux_weight``), router z-loss (``zloss_weight``), and the
+    dropped-token fraction (metric only, never in the objective)."""
+    from tensorflow_distributed_tpu.models.moe import collect_aux
+
+    def moe_loss(apply_fn, params, extra, batch, dropout_key, train):
+        # moe_aux is transient (state.TRANSIENT_COLLECTIONS) — never
+        # feed a stale copy back in, or sow would append to it.
+        variables = {"params": params,
+                     **{k: v for k, v in extra.items() if k != "moe_aux"}}
+        rngs = {"dropout": dropout_key} if train else {}
+        logits, mut = apply_fn(variables, batch["tokens"], train=train,
+                               rngs=rngs, mutable=["moe_aux"])
+        loss = masked_softmax_cross_entropy(logits, batch["targets"],
+                                            batch["mask"])
+        aux = collect_aux(mut.get("moe_aux", {}))
+        lb = aux.get("load_balance", 0.0)
+        z = aux.get("z_loss", 0.0)
+        total = loss + aux_weight * lb + zloss_weight * z
+        metrics = {
+            "loss": loss, "aux_loss": lb, "z_loss": z,
+            "dropped_frac": aux.get("dropped_fraction", 0.0),
+            "accuracy": masked_accuracy(logits, batch["targets"],
+                                        batch["mask"]),
+        }
+        return total, (metrics, extra)
+
+    return moe_loss
+
+
+moe_loss = make_moe_loss()  # default-weight instance (tests, eval)
 
 
 def mlm_batch_shardings(mesh: Mesh) -> Dict[str, NamedSharding]:
@@ -125,13 +138,14 @@ def mlm_batch_shardings(mesh: Mesh) -> Dict[str, NamedSharding]:
 def _make_lm_task(cfg: TrainConfig, mesh: Mesh, objective: str,
                   seq_len: int = 128, vocab_size: int = 64) -> Task:
     """Shared LM task body; ``objective``: "mlm" (masked positions) or
-    "clm" (next-token). Both use the {tokens, targets, mask} layout and
-    the same masked-CE loss — what differs is the data generator and
-    the model's attention direction (TransformerConfig.causal)."""
+    "clm" (next-token), with a "moe_" prefix selecting the MoE-aware
+    loss (masked CE + router losses). All use the {tokens, targets,
+    mask} layout — what differs is the data generator and the model's
+    attention direction (TransformerConfig.causal)."""
     from tensorflow_distributed_tpu.data.lm import (
         LmBatcher, synthetic_clm, synthetic_mlm)
 
-    gen = synthetic_mlm if objective == "mlm" else synthetic_clm
+    gen = (synthetic_mlm if objective.endswith("mlm") else synthetic_clm)
     n = max(16 * cfg.batch_size, 4096)
     train_ds = gen(n=n, seq_len=seq_len, vocab_size=vocab_size,
                    seed=cfg.seed)
@@ -149,7 +163,8 @@ def _make_lm_task(cfg: TrainConfig, mesh: Mesh, objective: str,
 
     return Task(
         name=objective,
-        loss=moe_loss if objective == "moe_clm" else mlm_loss,
+        loss=(make_moe_loss(cfg.moe_aux_weight, cfg.moe_zloss_weight)
+              if objective.startswith("moe_") else mlm_loss),
         batch_shardings=mlm_batch_shardings(mesh),
         sample_input=np.zeros((2, seq_len), np.int32), seq_axis=1,
         train_stream=batcher.forever, eval_batches=eval_batches,
@@ -159,12 +174,13 @@ def _make_lm_task(cfg: TrainConfig, mesh: Mesh, objective: str,
 def make_task(cfg: TrainConfig, mesh: Mesh) -> Task:
     """Model family -> task. bert_mlm trains masked-LM, gpt_lm trains
     next-token; everything else is image classification."""
+    moe = cfg.moe_experts > 0
     if cfg.model == "bert_mlm":
-        return _make_lm_task(cfg, mesh, "mlm")
-    if cfg.model == "gpt_lm":
-        return _make_lm_task(cfg, mesh, "clm")
-    if cfg.model == "pipelined_lm":
-        return _make_lm_task(cfg, mesh, "clm")
+        # The moe objective is masked-CE + router losses — it works for
+        # the MLM data stream too; only the generator differs.
+        return _make_lm_task(cfg, mesh, "moe_mlm" if moe else "mlm")
+    if cfg.model in ("gpt_lm", "pipelined_lm"):
+        return _make_lm_task(cfg, mesh, "moe_clm" if moe else "clm")
     if cfg.model == "moe_lm":
         return _make_lm_task(cfg, mesh, "moe_clm")
     return _make_vision_task(cfg, mesh)

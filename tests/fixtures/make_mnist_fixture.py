@@ -1,0 +1,63 @@
+"""Generate the committed MNIST idx fixture (tests/fixtures/mnist/).
+
+This environment has no network egress, so the repo cannot carry the
+true MNIST pixels; what the fixture pins is the exact ON-DISK BYTE
+FORMAT the reference's loader consumed (idx1/idx3, big-endian headers,
+magic 0x801/0x803 — mnist_python_m.py:133 via input_data.read_data_sets)
+so ``load_mnist`` and the C++ reader (native/tfd_native.cc tfd_idx_read)
+are exercised on real idx bytes, gz and plain, not on synthetic arrays
+handed past the parser. Pixel content is the deterministic glyph set
+(data/mnist.py synthetic_mnist) quantized to u8.
+
+Rerun to regenerate:  python tests/fixtures/make_mnist_fixture.py
+"""
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from tensorflow_distributed_tpu.data.mnist import synthetic_mnist
+
+OUT = os.path.join(os.path.dirname(__file__), "mnist")
+N_TRAIN, N_TEST = 1024, 256
+
+
+def idx3(images_u8: np.ndarray) -> bytes:
+    n, r, c = images_u8.shape
+    return struct.pack(">iiii", 2051, n, r, c) + images_u8.tobytes()
+
+
+def idx1(labels_u8: np.ndarray) -> bytes:
+    return struct.pack(">ii", 2049, len(labels_u8)) + labels_u8.tobytes()
+
+
+def main() -> None:
+    os.makedirs(OUT, exist_ok=True)
+    train, val, test = synthetic_mnist(n_train=N_TRAIN, n_test=N_TEST,
+                                       validation_size=0, seed=7)
+    # Re-join train (validation_size=0 keeps it whole) and quantize.
+    tr_img = (train.images[..., 0] * 255).round().astype(np.uint8)
+    te_img = (test.images[..., 0] * 255).round().astype(np.uint8)
+    blobs = {
+        "train-images-idx3-ubyte.gz": idx3(tr_img),
+        "train-labels-idx1-ubyte.gz": idx1(
+            train.labels.astype(np.uint8)),
+        # Test pair stays UNcompressed so both opener paths are pinned.
+        "t10k-images-idx3-ubyte": idx3(te_img),
+        "t10k-labels-idx1-ubyte": idx1(test.labels.astype(np.uint8)),
+    }
+    for name, blob in blobs.items():
+        path = os.path.join(OUT, name)
+        if name.endswith(".gz"):
+            # mtime=0 => reproducible bytes.
+            with open(path, "wb") as f:
+                f.write(gzip.compress(blob, mtime=0))
+        else:
+            with open(path, "wb") as f:
+                f.write(blob)
+        print(name, os.path.getsize(path), "bytes")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,5 +1,6 @@
 """Causal-LM family: causal ring attention parity, GPT training."""
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -35,6 +36,7 @@ def test_noncausal_ring_unchanged(devices8):
                                atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_gpt_learns_next_token(devices8):
     """Integration bar: tiny GPT on the stride-progression data must
     beat chance by a wide margin within a tiny budget (chance = 1/64;

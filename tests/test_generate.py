@@ -1,6 +1,7 @@
 """KV-cache decoding: cache-vs-full-forward parity + end-to-end
 generation quality on the learnable stride data."""
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -52,6 +53,7 @@ def test_generate_shapes_and_determinism():
     assert sampled.shape == (1, 8)
 
 
+@pytest.mark.slow
 def test_trained_model_continues_pattern(devices8):
     """Train tiny GPT on stride progressions, then generate: the greedy
     continuation must mostly follow x_{t+1} = x_t + stride."""

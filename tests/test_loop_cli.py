@@ -16,6 +16,7 @@ def _cfg(**kw):
     return TrainConfig(**base)
 
 
+@pytest.mark.slow
 def test_train_reaches_accuracy_bar():
     """The integration bar: the loop must reach high accuracy on the
     synthetic digits within a small budget (the analog of the
@@ -27,6 +28,7 @@ def test_train_reaches_accuracy_bar():
     assert result.images_per_sec > 0
 
 
+@pytest.mark.slow
 def test_train_resume_roundtrip(tmp_path):
     cfg = _cfg(train_steps=10, checkpoint_dir=str(tmp_path),
                checkpoint_every=5)
@@ -45,6 +47,7 @@ def test_performance_table_emitted():
     assert len(lines) >= 3  # header + 2 eval rows
 
 
+@pytest.mark.slow
 def test_cli_main_runs():
     from tensorflow_distributed_tpu.cli import main
     rc = main(["--dataset", "synthetic", "--train-steps", "5",
@@ -87,6 +90,7 @@ def test_first_step_hits_log_and_checkpoint_cadence(tmp_path):
     assert 1 in ckpt.available_steps(str(tmp_path))
 
 
+@pytest.mark.slow
 def test_resume_continues_sample_stream():
     """A resumed run must consume the same batches an uninterrupted run
     would have (data-stream fast-forward on resume)."""

@@ -13,17 +13,23 @@ from tensorflow_distributed_tpu.parallel.sharding import (
 
 def test_make_mesh_all_data(devices8):
     m = meshlib.make_mesh(MeshConfig(data=-1), devices8)
-    assert m.shape == {"data": 8, "pipe": 1, "seq": 1, "model": 1}
+    assert m.shape == {"data": 8, "pipe": 1, "seq": 1, "model": 1, "expert": 1}
 
 
 def test_make_mesh_2d(devices8):
     m = meshlib.make_mesh(MeshConfig(data=4, model=2), devices8)
-    assert m.shape == {"data": 4, "pipe": 1, "seq": 1, "model": 2}
+    assert m.shape == {"data": 4, "pipe": 1, "seq": 1, "model": 2, "expert": 1}
 
 
 def test_make_mesh_seq(devices8):
     m = meshlib.make_mesh(MeshConfig(data=2, seq=4), devices8)
-    assert m.shape == {"data": 2, "pipe": 1, "seq": 4, "model": 1}
+    assert m.shape == {"data": 2, "pipe": 1, "seq": 4, "model": 1, "expert": 1}
+
+
+def test_make_mesh_expert_axis(devices8):
+    m = meshlib.make_mesh(MeshConfig(data=2, expert=4), devices8)
+    assert m.shape == {"data": 2, "pipe": 1, "seq": 1, "model": 1,
+                       "expert": 4}
 
 
 def test_make_mesh_rejects_indivisible(devices8):
@@ -33,7 +39,7 @@ def test_make_mesh_rejects_indivisible(devices8):
 
 def test_single_device_mesh_is_same_code_path(devices8):
     m = meshlib.single_device_mesh(devices8[0])
-    assert m.shape == {"data": 1, "pipe": 1, "seq": 1, "model": 1}
+    assert m.shape == {"data": 1, "pipe": 1, "seq": 1, "model": 1, "expert": 1}
 
 
 def test_batch_sharding_splits_leading_axis(mesh8):

@@ -1,5 +1,6 @@
 """MoE layer: routing correctness, capacity, EP parity, training."""
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -69,14 +70,110 @@ def test_capacity_drops_tokens():
 
 
 def test_moe_aux_loss_sown():
+    from tensorflow_distributed_tpu.models.moe import AUX_NAMES, collect_aux
+
     layer = _layer()
     x = jnp.ones((2, 8, 16), jnp.float32)
     params = layer.init(jax.random.key(3), x)["params"]
     _, mut = layer.apply({"params": params}, x, mutable=["moe_aux"])
-    leaves = jax.tree_util.tree_leaves(mut["moe_aux"])
-    assert len(leaves) == 1
-    # E * sum f_e p_e >= 1 by Cauchy-Schwarz; == 1 iff perfectly uniform.
-    assert float(leaves[0]) >= 1.0 - 1e-5
+    aux = collect_aux(mut["moe_aux"])
+    assert set(aux) == set(AUX_NAMES)
+    # With identical tokens, every token routes to the top-k experts,
+    # whose mean prob >= the overall mean 1/E => aux >= 1 (== 1 iff
+    # perfectly uniform).
+    assert float(aux["load_balance"]) >= 1.0 - 1e-5
+    assert float(aux["z_loss"]) >= 0.0
+    # Huge capacity => nothing dropped.
+    assert float(aux["dropped_fraction"]) == 0.0
+
+
+def test_moe_dropped_fraction_reported_on_overflow():
+    """Induce capacity overflow; the drop fraction must be reported and
+    nonzero (drops are otherwise silent zeros in the math)."""
+    from tensorflow_distributed_tpu.models.moe import collect_aux
+
+    layer = MoeMlp(d_model=8, d_ff=16, num_experts=2, top_k=1,
+                   capacity_factor=2.0 / 16.0,  # C = 1 per expert
+                   compute_dtype=jnp.float32, partitioned=False)
+    x = jnp.asarray(np.random.default_rng(5).normal(size=(1, 16, 8)),
+                    jnp.float32)
+    params = layer.init(jax.random.key(5), x)["params"]
+    _, mut = layer.apply({"params": params}, x, mutable=["moe_aux"])
+    aux = collect_aux(mut["moe_aux"])
+    # 16 tokens, 2 experts x capacity 1 => at least 14/16 dropped.
+    assert float(aux["dropped_fraction"]) >= 14.0 / 16.0 - 1e-6
+
+
+def test_moe_loss_surfaces_router_metrics(devices8):
+    """The train-metric path: moe_loss must report dropped_frac and
+    z_loss, and the z-loss knob must change the objective."""
+    import optax
+
+    from tensorflow_distributed_tpu.models import build_model
+    from tensorflow_distributed_tpu.train.state import create_train_state
+    from tensorflow_distributed_tpu.train.tasks import make_moe_loss
+
+    mesh = make_mesh(MeshConfig(data=8), devices8)
+    model = build_model("moe_lm", mesh=mesh, size="tiny",
+                        compute_dtype=jnp.float32,
+                        moe_capacity_factor=0.25)  # force overflow
+    state = create_train_state(model, optax.adam(1e-3),
+                               np.zeros((2, 16), np.int32), mesh)
+    rng = np.random.default_rng(6)
+    batch = {
+        "tokens": jnp.asarray(rng.integers(0, 64, (8, 16)), jnp.int32),
+        "targets": jnp.asarray(rng.integers(0, 64, (8, 16)), jnp.int32),
+        "mask": jnp.ones((8, 16), jnp.float32),
+    }
+    key = jax.random.key(0)
+
+    def run(loss_fn):
+        total, (metrics, _) = loss_fn(model.apply, state.params,
+                                      state.extra, batch, key, True)
+        return float(total), jax.device_get(metrics)
+
+    base, m = run(make_moe_loss(0.01, 0.0))
+    assert m["dropped_frac"] > 0.0, m
+    assert m["z_loss"] > 0.0, m
+    zed, mz = run(make_moe_loss(0.01, 1.0))
+    np.testing.assert_allclose(zed - base, float(mz["z_loss"]),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_moe_dedicated_expert_axis(devices8):
+    """EP over a dedicated "expert" mesh axis (not aliasing "model")
+    matches the unsharded oracle."""
+    layer = MoeMlp(d_model=16, d_ff=32, num_experts=4, top_k=2,
+                   capacity_factor=10.0, compute_dtype=jnp.float32,
+                   expert_axis="expert", partitioned=False)
+    x = jnp.asarray(np.random.default_rng(7).normal(size=(4, 8, 16)),
+                    jnp.float32)
+    params = layer.init(jax.random.key(7), x)["params"]
+    want, _ = layer.apply({"params": params}, x, mutable=["moe_aux"])
+
+    mesh = make_mesh(MeshConfig(data=2, expert=4), devices8)
+    from tensorflow_distributed_tpu.parallel.sharding import batch_sharding
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    with mesh:
+        xs = jax.device_put(x, batch_sharding(mesh, 3))
+        ps = jax.tree_util.tree_map(
+            lambda p: jax.device_put(p, NamedSharding(mesh, P())), params)
+        for k in ("wi", "wo"):
+            ps[k] = jax.device_put(params[k],
+                                   NamedSharding(mesh, P("expert")))
+        got, _ = jax.jit(
+            lambda p, x: layer.apply({"params": p}, x,
+                                     mutable=["moe_aux"]))(ps, xs)
+    np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-3)
+
+
+def test_moe_lm_auto_selects_expert_axis(devices8):
+    from tensorflow_distributed_tpu.models import build_model
+
+    mesh = make_mesh(MeshConfig(data=4, expert=2), devices8)
+    model = build_model("moe_lm", mesh=mesh, size="tiny",
+                        compute_dtype=jnp.float32)
+    assert model.cfg.moe_expert_axis == "expert"
 
 
 def test_expert_parallel_matches_single(devices8):
@@ -125,10 +222,12 @@ def test_moe_aux_not_persisted_in_state(devices8):
     _, mut = model.apply({"params": state.params},
                          jnp.zeros((8, 16), jnp.int32),
                          mutable=["moe_aux"])
+    # Each MoE layer sows load_balance + z_loss + dropped_fraction.
     assert len(jax.tree_util.tree_leaves(mut["moe_aux"])) == \
-        model.cfg.n_layers
+        3 * model.cfg.n_layers
 
 
+@pytest.mark.slow
 def test_moe_lm_trains(devices8):
     from tensorflow_distributed_tpu.train.loop import train
 

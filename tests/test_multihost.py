@@ -25,6 +25,8 @@ import sys
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # real 2-process cluster, 540 s budget
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

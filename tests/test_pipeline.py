@@ -80,6 +80,7 @@ def test_pipeline_validates():
         pipeline_apply(_mlp_stage, p, jnp.zeros((8, 4)), mesh, 2)
 
 
+@pytest.mark.slow
 def test_pipelined_lm_trains(devices8):
     """End-to-end: 4-stage pipelined causal LM under dp=2 learns the
     stride progression well above chance."""

@@ -52,8 +52,24 @@ def test_synthetic_cifar_shapes_and_dispatch():
     # load_dataset falls back to synthetic when .bin files are absent
     tr2, _, _ = load_dataset("cifar10", "/nonexistent-dir", seed=0)
     assert tr2.images.shape[1:] == (32, 32, 3)
-    tr3, _, _ = load_dataset("imagenet_synthetic", "", seed=0)
+    # Direct small-N call (load_dataset's default-size imagenet twin
+    # allocates ~1.2 GB of random pixels — too heavy for the fast tier).
+    tr3, _, _ = synthetic_imagenet(n_train=16, n_test=8,
+                                   validation_size=8)
     assert tr3.images.shape[1:] == (224, 224, 3)
+
+
+def test_imagenet_synthetic_dispatch(monkeypatch):
+    """The load_dataset("imagenet_synthetic") registry branch, with the
+    generator shrunk so the fast tier doesn't pay the 1.2 GB default."""
+    from tensorflow_distributed_tpu.data import cifar
+
+    real = cifar.synthetic_imagenet
+    small = lambda seed=0: real(  # noqa: E731
+        n_train=16, n_test=8, validation_size=8, seed=seed)
+    monkeypatch.setattr(cifar, "synthetic_imagenet", small)
+    tr, _, _ = load_dataset("imagenet_synthetic", "", seed=0)
+    assert tr.images.shape[1:] == (224, 224, 3)
 
 
 def test_resnet20_shapes_params_and_stats(mesh1):
@@ -85,6 +101,7 @@ def test_resnet50_abstract_shapes():
     assert out.shape == (2, 1000)
 
 
+@pytest.mark.slow
 def test_resnet20_train_step_updates_stats_8dev(mesh8):
     model = resnet20(compute_dtype=jnp.float32)
     state = create_train_state(model, optax.adam(1e-3),
@@ -105,6 +122,7 @@ def test_resnet20_train_step_updates_stats_8dev(mesh8):
     assert np.isfinite(float(jax.device_get(m["loss"])))
 
 
+@pytest.mark.slow
 def test_resnet20_bn_parity_8dev_vs_1dev(mesh8, mesh1):
     """Global-batch BN inside jit: the 8-device step must produce the
     same loss and the same updated batch_stats as the 1-device step on

@@ -143,6 +143,7 @@ def test_train_batch_not_divisible_raises(mesh8):
         step(state, shard_batch(mesh8, (imgs, labels)))
 
 
+@pytest.mark.slow
 def test_grad_accum_matches_full_batch(mesh8, tiny_data):
     """accum_steps=4 must produce the same update as one full-batch
     step (dropout-free model config => exact same math up to fp

@@ -72,6 +72,7 @@ def test_params_sharded_on_tp_mesh(devices8):
     MeshConfig(data=2, seq=2, model=2),   # dp + sp + tp composite
     MeshConfig(data=1, seq=4, model=2),   # sp-dominant long-context
 ])
+@pytest.mark.slow
 def test_mlm_trains_on_mesh(devices8, mesh_cfg):
     mesh = make_mesh(mesh_cfg, devices8)
     state = _mlm_state(mesh)
@@ -88,6 +89,7 @@ def test_mlm_trains_on_mesh(devices8, mesh_cfg):
     assert losses[-1] < losses[0] * 0.5, losses[::20]
 
 
+@pytest.mark.slow
 def test_remat_trains(devices8):
     """cfg.remat=True (jax.checkpoint per block) must produce the same
     loss as the non-remat path — it changes memory, not math."""
@@ -107,6 +109,7 @@ def test_remat_trains(devices8):
     np.testing.assert_allclose(losses[False], losses[True], rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_bert_mlm_via_registry_and_loop(devices8):
     """The user-facing path: --model bert_mlm through build_model and
     the full train loop."""
@@ -137,6 +140,7 @@ def test_bert_mlm_via_registry_and_loop(devices8):
     assert np.isfinite(result.final_metrics["loss"])
 
 
+@pytest.mark.slow
 def test_mesh_equivalence_dp_vs_composite(devices8):
     """Same batch, same init: a dp-only mesh and a dp+sp+tp mesh compute
     the same loss (the TP/SP decomposition is exact, not approximate)."""
