@@ -78,17 +78,11 @@ def _causal_q_map(bq, bk):
 
 # ---------------------------------------------------------------- forward
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr, *, scale, causal, bq, bk):
-    i = pl.program_id(1)
-    j = pl.program_id(2)
-    nk = pl.num_programs(2)
-
-    @pl.when(j == 0)
-    def _():
-        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
-        l_scr[:] = jnp.zeros_like(l_scr)
-        acc_scr[:] = jnp.zeros_like(acc_scr)
+def _stream_softmax_step(q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr,
+                         i, j, scale, causal, bq, bk):
+    """One K,V block folded into the (m, l, acc) VMEM accumulators —
+    the streaming-softmax body shared by the normalized and partial
+    forward kernels. Runs under the causal block-skip predicate."""
 
     def compute():
         q = q_ref[0]                               # [bq, D]
@@ -117,6 +111,37 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         pl.when(_kv_needed(i, j, bq, bk))(compute)
     else:
         compute()
+
+
+def _p_and_ds(q, k, v, do, row_sub, row_add, i_q, i_k, scale, causal,
+              bq, bk):
+    """Backward-pass block math shared by all four bwd kernels:
+    p = exp(s - row_sub) and ds = p * (do.v^T + row_add) * scale.
+    Normalized kernels pass (lse, -delta); partial kernels (m, +dl)."""
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if causal:
+        s = _causal_mask(s, i_q, i_k, bq, bk)
+    p = jnp.exp(s - row_sub)                       # [bq, bk]
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    return p, p * (dp + row_add) * scale
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, scale, causal, bq, bk):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    _stream_softmax_step(q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr,
+                         i, j, scale, causal, bq, bk)
 
     @pl.when(j == nk - 1)
     def _():
@@ -186,16 +211,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
 
     def compute():
         q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
-            s = _causal_mask(s, i, j, bq, bk)
-        lse = lse_ref[0][:, :1]                    # [bq, 1]
-        delta = _delta(do, o_ref[0])               # [bq, 1]
-        p = jnp.exp(s - lse)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale              # [bq, bk] f32
+        _, ds = _p_and_ds(q, k, v, do, lse_ref[0][:, :1],
+                          -_delta(do, o_ref[0]), i, j, scale, causal,
+                          bq, bk)
         dq_scr[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -223,19 +241,12 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
 
     def compute():
         q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
-            s = _causal_mask(s, j, i, bq, bk)
-        lse = lse_ref[0][:, :1]                    # [bq, 1]
-        delta = _delta(do, o_ref[0])               # [bq, 1]
-        p = jnp.exp(s - lse)                       # [bq, bk]
+        p, ds = _p_and_ds(q, k, v, do, lse_ref[0][:, :1],
+                          -_delta(do, o_ref[0]), j, i, scale, causal,
+                          bq, bk)
         dv_scr[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale
         dk_scr[:] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -306,6 +317,251 @@ def _bwd(q, k, v, out, lse, do, causal, bq, bk, interpret):
         interpret=interpret,
     )(q, k, v, do, out, lse)
     return dq, dk, dv
+
+
+# ----------------------------------------------- partial-softmax variant
+# Ring attention's building block (parallel.ring_attention): one Q-block
+# vs one K,V-block PARTIAL attention returning the streaming-softmax
+# triple (m = row max, l = exp-sum, o = unnormalized weighted V) that
+# the ring merges across steps. Same blocking/VMEM scheme as the main
+# kernel; the only differences are (a) o is written UNnormalized in f32
+# and (b) m and l are emitted instead of the folded lse.
+#
+# VJP convention: m is the numerical stabilizer of the streaming
+# softmax — the merged result is invariant to it — so it is treated as
+# stop-gradient (exactly like jax.nn.softmax's max-shift). With
+# p = exp(s - m):   dl/ds_ij = p_ij,   do_i/ds_ij = p_ij * v_j
+# =>  ds_ij = p_ij * (do_i . v_j + dl_i),  dq = scale * ds @ k,
+#     dk = scale * ds^T @ q,  dv = p^T @ do.
+# These mirror _dq_kernel/_dkv_kernel with rowsum(do*o) replaced by
+# the incoming -dl cotangent (delta there IS the normalized-case dl).
+
+
+def _fwd_partial_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
+                        m_scr, l_scr, acc_scr, *, scale, causal, bq, bk):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    _stream_softmax_step(q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr,
+                         i, j, scale, causal, bq, bk)
+
+    @pl.when(j == nk - 1)
+    def _():
+        o_ref[0] = acc_scr[:]                      # UNnormalized, f32
+        m_ref[0] = jnp.broadcast_to(m_scr[:, :1], m_ref.shape[1:])
+        l_ref[0] = jnp.broadcast_to(l_scr[:, :1], l_ref.shape[1:])
+
+
+def _fwd_partial(q, k, v, causal, bq, bk, interpret):
+    BH, L, D = q.shape
+    Lk = k.shape[1]
+    scale = 1.0 / (D ** 0.5)
+    kv_map = _causal_kv_map(bq, bk) if causal else (
+        lambda b, i, j: (b, j, 0))
+    return pl.pallas_call(
+        functools.partial(_fwd_partial_kernel, scale=scale,
+                          causal=causal, bq=bq, bk=bk),
+        grid=(BH, L // bq, Lk // bk),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), kv_map),
+            pl.BlockSpec((1, bk, D), kv_map),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 8), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 8), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, L, D), jnp.float32),
+            jax.ShapeDtypeStruct((BH, L, 8), jnp.float32),
+            jax.ShapeDtypeStruct((BH, L, 8), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _dq_partial_kernel(q_ref, k_ref, v_ref, do_ref, dl_ref, m_ref,
+                       dq_ref, dq_scr, *, scale, causal, bq, bk):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def compute():
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        _, ds = _p_and_ds(q, k, v, do, m_ref[0][:, :1],
+                          dl_ref[0][:, :1], i, j, scale, causal, bq, bk)
+        dq_scr[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(_kv_needed(i, j, bq, bk))(compute)
+    else:
+        compute()
+
+    @pl.when(j == nk - 1)
+    def _():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _dkv_partial_kernel(q_ref, k_ref, v_ref, do_ref, dl_ref, m_ref,
+                        dk_ref, dv_ref, dk_scr, dv_scr, *,
+                        scale, causal, bq, bk):
+    i = pl.program_id(1)                           # k-block index
+    j = pl.program_id(2)                           # q-block index
+    nq = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def compute():
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        p, ds = _p_and_ds(q, k, v, do, m_ref[0][:, :1],
+                          dl_ref[0][:, :1], j, i, scale, causal, bq, bk)
+        dv_scr[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dk_scr[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(_q_needed(i, j, bq, bk))(compute)
+    else:
+        compute()
+
+    @pl.when(j == nq - 1)
+    def _():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd_partial(q, k, v, m, do, dl, causal, bq, bk, interpret):
+    BH, L, D = q.shape
+    Lk = k.shape[1]
+    scale = 1.0 / (D ** 0.5)
+    kv_map = _causal_kv_map(bq, bk) if causal else (
+        lambda b, i, j: (b, j, 0))
+    dq = pl.pallas_call(
+        functools.partial(_dq_partial_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk),
+        grid=(BH, L // bq, Lk // bk),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), kv_map),
+            pl.BlockSpec((1, bk, D), kv_map),
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 8), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 8), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, L, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, dl, m)
+
+    q_map = _causal_q_map(bq, bk) if causal else (
+        lambda b, i, j: (b, j, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_partial_kernel, scale=scale,
+                          causal=causal, bq=bq, bk=bk),
+        grid=(BH, Lk // bk, L // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), q_map),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, D), q_map),
+            pl.BlockSpec((1, bq, 8), q_map),
+            pl.BlockSpec((1, bq, 8), q_map),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Lk, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, Lk, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, D), jnp.float32),
+            pltpu.VMEM((bk, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, dl, m)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_partial(q, k, v, causal, bq, bk, interpret):
+    return _fwd_partial(q, k, v, causal, bq, bk, interpret)
+
+
+def _flash_partial_fwd(q, k, v, causal, bq, bk, interpret):
+    o, m, l = _fwd_partial(q, k, v, causal, bq, bk, interpret)
+    return (o, m, l), (q, k, v, m)
+
+
+def _flash_partial_bwd(causal, bq, bk, interpret, res, cots):
+    q, k, v, m = res
+    do, _dm, dl = cots  # m is the stop-grad stabilizer (see above)
+    return _bwd_partial(q, k, v, m, do.astype(jnp.float32), dl, causal,
+                        bq, bk, interpret)
+
+
+_flash_partial.defvjp(_flash_partial_fwd, _flash_partial_bwd)
+
+
+def flash_attention_partial(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                            causal: bool = False, block_q: int = 1024,
+                            block_k: int = 1024,
+                            interpret: Optional[bool] = None):
+    """Partial (unnormalized) blockwise attention for the ring path.
+
+    q: [B, Lq, H, D]; k, v: [B, Lk, H, D]. Returns the streaming-
+    softmax partials in ``parallel.ring_attention._block_attend``'s
+    layout: (m [B,H,Lq] f32, l [B,H,Lq] f32, o [B,Lq,H,D] f32 —
+    UNnormalized weighted V). Differentiable (custom VJP, Pallas both
+    ways). ``causal=True`` applies the in-block triangular mask (the
+    ring's diagonal blocks, where q and k share global offsets).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    B, L, H, D = q.shape
+    Lk = k.shape[1]
+    bq, bk = min(block_q, L), min(block_k, Lk)
+    if L % bq or Lk % bk:
+        raise ValueError(
+            f"flash_attention_partial: seq lens ({L}, {Lk}) must "
+            f"divide the clamped blocks ({bq}, {bk}); see supported()")
+
+    def pack(x):
+        n = x.shape[1]
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(B * H, n,
+                                                      x.shape[3])
+
+    o, m, l = _flash_partial(pack(q), pack(k), pack(v), causal, bq, bk,
+                             interpret)
+    o = jnp.transpose(o.reshape(B, H, L, D), (0, 2, 1, 3))
+    return m[..., 0].reshape(B, H, L), l[..., 0].reshape(B, H, L), o
 
 
 # ------------------------------------------------------------ public API
@@ -380,6 +636,19 @@ def supported(L: int, Lk: int, D: int, block_q: int = 1024,
             and D <= 256 and D % 8 == 0)
 
 
+def use_flash(L: int, Lk: int, D: int) -> bool:
+    """The ONE flash-dispatch gate, shared by the single-shard
+    dispatcher (attention) and the ring path (_partial_attend): TPU
+    backend (or TFD_FLASH_INTERPRET=1 forcing interpreter mode
+    off-TPU, for CPU-mesh tests of the exact TPU code path) and
+    kernel-supported shapes."""
+    import os
+
+    on_tpu = jax.default_backend() == "tpu"
+    force = os.environ.get("TFD_FLASH_INTERPRET", "") == "1"
+    return (on_tpu or force) and supported(L, Lk, D)
+
+
 def attention(q: jax.Array, k: jax.Array, v: jax.Array,
               mask: Optional[jax.Array] = None, *,
               causal: bool = False, mesh=None,
@@ -412,10 +681,7 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array,
     from tensorflow_distributed_tpu.parallel.ring_attention import (
         full_attention)
     B, L, H, D = q.shape
-    on_tpu = jax.default_backend() == "tpu"
-    force = os.environ.get("TFD_FLASH_INTERPRET", "") == "1"
-    if (allow_flash and mask is None and (on_tpu or force)
-            and supported(L, k.shape[1], D)):
+    if allow_flash and mask is None and use_flash(L, k.shape[1], D):
         from jax.sharding import PartitionSpec as P
         spec = P(AXIS_DATA, None, AXIS_MODEL, None)
         kernel = lambda q, k, v: flash_attention(  # noqa: E731

@@ -59,6 +59,23 @@ def _block_attend(q, k, v, bias):
     return m, l, o
 
 
+def _partial_attend(q, k, v, causal: bool = False):
+    """Block partial attention for the zigzag ring: the Pallas
+    partial-softmax kernel (ops.flash_attention.flash_attention_partial)
+    on TPU when shapes allow, the einsum oracle otherwise — the ring's
+    local compute rides the flash kernel's VMEM streaming instead of
+    materializing [B, H, Lq, Lk] f32 score blocks in HBM.
+    TFD_FLASH_INTERPRET=1 forces the kernel (interpreter) off-TPU so
+    the CPU-mesh tests exercise the exact TPU code path."""
+    from tensorflow_distributed_tpu.ops.flash_attention import (
+        flash_attention_partial, use_flash)
+    B, Lq, H, D = q.shape
+    if use_flash(Lq, k.shape[1], D):
+        return flash_attention_partial(q, k, v, causal=causal)
+    bias = causal_bias(Lq, k.shape[1]) if causal else None
+    return _block_attend(q, k, v, bias)
+
+
 def _merge(m1, l1, o1, m2, l2, o2):
     """Fold two streaming-softmax partials into one."""
     m = jnp.maximum(m1, m2)
@@ -190,16 +207,16 @@ def _zigzag_causal_shard(S: int):
         q1, q2 = to_zigzag(q_blk)
         k1, k2 = to_zigzag(k_blk)
         v1, v2 = to_zigzag(v_blk)
-        nh = q1.shape[1]
-        # In-half triangular mask for the two diagonal blocks (global
-        # offsets of q and k halves coincide, so offsets cancel).
-        tri = causal_bias(nh, nh)
+        # In-half triangular masking for the two diagonal blocks (global
+        # offsets of q and k halves coincide, so offsets cancel) —
+        # causal=True in _partial_attend, which dispatches to the Pallas
+        # partial kernel on TPU (einsum oracle elsewhere).
 
         # s = 0: both diagonals (triangular) + late-vs-early (full:
         # q2's rows start at (2S-1-d)*nh >= S*nh, past every k1 col).
-        acc1 = _block_attend(q1, k1, v1, tri)
-        acc2 = _merge(*_block_attend(q2, k2, v2, tri),
-                      *_block_attend(q2, k1, v1, None))
+        acc1 = _partial_attend(q1, k1, v1, causal=True)
+        acc2 = _merge(*_partial_attend(q2, k2, v2, causal=True),
+                      *_partial_attend(q2, k1, v1))
 
         perm = [(i, (i + 1) % S) for i in range(S)]
         k1r, k2r, v1r, v2r = k1, k2, v1, v2
@@ -210,7 +227,7 @@ def _zigzag_causal_shard(S: int):
             v2r = jax.lax.ppermute(v2r, AXIS_SEQ, perm)
             src = (d - s) % S
             # Always needed: late q vs rotated early k (full).
-            acc2 = _merge(*acc2, *_block_attend(q2, k1r, v1r, None))
+            acc2 = _merge(*acc2, *_partial_attend(q2, k1r, v1r))
             # Exactly one of {q1 x k1r (src < d), q2 x k2r (src > d)}
             # is needed — both are FULLY visible, so select operands
             # elementwise and attend once; fold into the right
@@ -219,7 +236,7 @@ def _zigzag_causal_shard(S: int):
             q_sel = jnp.where(pred, q1, q2)
             k_sel = jnp.where(pred, k1r, k2r)
             v_sel = jnp.where(pred, v1r, v2r)
-            part = _block_attend(q_sel, k_sel, v_sel, None)
+            part = _partial_attend(q_sel, k_sel, v_sel)
             new1 = _merge(*acc1, *part)
             new2 = _merge(*acc2, *part)
             acc1 = tuple(jnp.where(pred, a, b) for a, b in zip(new1, acc1))
