@@ -184,9 +184,17 @@ class TrainConfig:
             raise ValueError(
                 f"unknown pipeline_schedule {self.pipeline_schedule!r}")
         if self.pipeline_schedule == "1f1b" and self.grad_accum_steps > 1:
+            # Deliberate exclusion, not a gap: 1F1B's microbatch loop IS
+            # gradient accumulation (per-microbatch grads accumulate in
+            # the schedule's dp_acc before the single optimizer update,
+            # with O(S) activation state). To cut activation memory
+            # further, raise pipeline_microbatches — same math, smaller
+            # microbatches — instead of wrapping a second accumulation
+            # loop around the pipeline.
             raise ValueError(
-                "pipeline_schedule=1f1b already microbatches; it does "
-                "not compose with grad_accum_steps > 1")
+                "pipeline_schedule=1f1b already accumulates per-"
+                "microbatch gradients; raise pipeline_microbatches "
+                "instead of grad_accum_steps")
         if self.pipeline_microbatches < 1:
             raise ValueError(
                 f"pipeline_microbatches must be >= 1, "

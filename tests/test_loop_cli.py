@@ -40,7 +40,7 @@ def test_train_resume_roundtrip(tmp_path):
 
 
 def test_performance_table_emitted():
-    result = train(_cfg(train_steps=20, eval_every=10))
+    result = train(_cfg(train_steps=10, eval_every=5))
     table = result.logger.performance_table(1e-3)
     lines = table.splitlines()
     assert lines[0].startswith("Steps,")
@@ -74,6 +74,7 @@ def test_graft_entry_single():
     assert out.shape == (8, 10)
 
 
+@pytest.mark.slow
 def test_graft_entry_multichip():
     _load_graft_entry().dryrun_multichip(8)
 

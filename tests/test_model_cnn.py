@@ -73,10 +73,10 @@ def test_reference_init_trains_materially_worse():
     fixed-budget curve (40 steps -> 90%, performance:2). On real MNIST
     the bad init also caps the ceiling at 95.75% (performance:6); the
     synthetic glyph set is easy enough that even stddev-1.0 init
-    eventually recovers (measured: 0.996 by step 80), so the budget
-    comparison at 40 steps is the honest, deterministic form of the
-    outcome gap here. Measured (fixed seeds, CPU): reference 0.859 vs
-    improved 0.910."""
+    eventually recovers (measured: 0.996 by step 80 of batch 64), so
+    the fixed-budget comparison is the honest, deterministic form of
+    the outcome gap here. Measured (fixed seeds, CPU, batch 32 x 32
+    steps): reference 0.605 vs improved 0.828."""
     import optax
 
     from tensorflow_distributed_tpu.config import MeshConfig
@@ -104,10 +104,10 @@ def test_reference_init_trains_materially_worse():
         model = MnistCNN(init_scheme=scheme, compute_dtype=jnp.float32)
         state = create_train_state(model, tx, sample, mesh)
         state.opt_state.hyperparams["learning_rate"] = jnp.asarray(lr)
-        for i in range(40):
-            lo = (i * 64) % 4096
-            b = shard_batch(mesh, (train_ds.images[lo:lo + 64],
-                                   train_ds.labels[lo:lo + 64]))
+        for i in range(32):
+            lo = (i * 32) % 2048
+            b = shard_batch(mesh, (train_ds.images[lo:lo + 32],
+                                   train_ds.labels[lo:lo + 32]))
             state, metrics = step(state, b)
             # Block each step: unbounded async dispatch of 8-device
             # SPMD programs aborts XLA:CPU's collective rendezvous on
@@ -117,11 +117,11 @@ def test_reference_init_trains_materially_worse():
             jax.device_get(eval_step(state, val_batch)["accuracy"]))
     # "Materially below" at the fixed budget: the stddev-1.0 init +
     # lr 0.01 combination saturates activations and thrashes Adam.
-    # Everything above is seed-fixed, so the 5-point measured gap is
-    # deterministic; 0.025 leaves slack for backend math drift only.
-    assert accs["improved"] >= accs["reference"] + 0.025, accs
-    assert accs["improved"] >= 0.895, accs
-    assert accs["reference"] <= 0.89, accs
+    # Everything above is seed-fixed, so the 22-point measured gap is
+    # deterministic; the margins leave slack for backend math drift.
+    assert accs["improved"] >= accs["reference"] + 0.10, accs
+    assert accs["improved"] >= 0.80, accs
+    assert accs["reference"] <= 0.70, accs
 
 
 def test_reference_init_scheme_is_wild():

@@ -104,6 +104,7 @@ def test_moe_dropped_fraction_reported_on_overflow():
     assert float(aux["dropped_fraction"]) >= 14.0 / 16.0 - 1e-6
 
 
+@pytest.mark.slow
 def test_moe_loss_surfaces_router_metrics(devices8):
     """The train-metric path: moe_loss must report dropped_frac and
     z_loss, and the z-loss knob must change the objective."""

@@ -248,5 +248,5 @@ def test_1f1b_config_validation():
         cfg.validate()
     cfg = TrainConfig(pipeline_schedule="1f1b", grad_accum_steps=2,
                       batch_size=256)
-    with pytest.raises(ValueError, match="compose"):
+    with pytest.raises(ValueError, match="accumulates"):
         cfg.validate()

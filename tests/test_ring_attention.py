@@ -136,6 +136,7 @@ def test_ring_bad_schedule_raises(devices8):
         ring_attention(q, k, v, mesh, causal=True, schedule="spiral")
 
 
+@pytest.mark.slow
 def test_ring_zigzag_flash_partial_path(devices8, monkeypatch):
     """The zigzag schedule's local compute on the Pallas partial-softmax
     kernel (TFD_FLASH_INTERPRET forces it off-TPU): forward AND

@@ -65,11 +65,11 @@ def test_loss_decreases_and_step_counts(mesh8):
     imgs, labels = _batch(64)
     batch = shard_batch(mesh8, (imgs, labels))
     losses = []
-    for _ in range(20):
+    for _ in range(12):
         state, metrics = step(state, batch)
         losses.append(float(metrics["loss"]))
-    assert int(state.step) == 20  # global_step semantics (SURVEY.md N15)
-    assert losses[-1] < losses[0] * 0.5
+    assert int(state.step) == 12  # global_step semantics (SURVEY.md N15)
+    assert losses[-1] < losses[0] * 0.6
 
 
 def test_n_device_equals_1_device(mesh1, mesh8):
