@@ -13,9 +13,13 @@ designed TPU-first rather than ported:
   handled by the surrounding GSPMD partitioner.
 - Activations hop stage s -> s+1 once per tick via ``lax.ppermute`` —
   neighbor ICI traffic, the TPU-native analog of NCCL P2P send/recv.
-- Bubble ticks compute on garbage and are masked with ``jnp.where``
-  (predication, not control flow — the compiled program is static).
-  Bubble fraction is the standard (S-1)/(M+S-1).
+- GPipe bubble ticks compute on garbage and are masked with
+  ``jnp.where`` (predication keeps AD through the schedule trivial).
+  Bubble fraction is the standard (S-1)/(M+S-1). The 1F1B schedule
+  below instead SKIPS bubble work with real ``lax.cond`` branches —
+  its backward is hand-rolled, so no AD-through-cond is needed —
+  measured 3.3x faster per step at the bubble-heavy S=4, M=4 point
+  (8-way CPU mesh, 8-layer d128 LM: 2729 -> 831 ms).
 
 Everything is differentiable: the backward pipeline falls out of AD
 (scan reverses, ppermute transposes to the opposite rotation).
@@ -135,7 +139,23 @@ def bubble_fraction(num_microbatches: int, num_stages: int,
     gpipe: the classic (S-1)/(M+S-1) over M+S-1 forward ticks (the
     backward pipeline mirrors it under AD). 1f1b: the paired
     fwd+bwd schedule runs M + 2(S-1) tick pairs, of which 2(S-1) are
-    ramp-up/drain bubbles."""
+    ramp-up/drain bubbles.
+
+    On interleaved (virtual-stage) schedules — considered for round 3
+    and deliberately NOT implemented: the Megatron bubble/V win comes
+    from warmup/drain ticks doing fwd-ONLY (resp. bwd-only) work. A
+    UNIFORM scan tick (one forward + one backward slot per stage per
+    tick) gains nothing from folding V chunks per device: the schedule
+    stretches to ~MV chunk-ticks of 1/V-size units with ~SV empty
+    half-ticks — total bubble TIME unchanged (worked example: S=2,
+    V=2, M=8 gives 40 chunk-units wall either way). What DOES pay is
+    making bubble half-ticks free: pipeline_value_and_grad's tick now
+    wraps each half in a real ``lax.cond`` (possible because its
+    backward is hand-rolled — nothing ADs through the cond), skipping
+    ramp/drain garbage compute instead of where-masking it. Measured
+    3.3x per-step at S=4, M=4 (see module docstring); the reported
+    2(S-1)/(M+2(S-1)) fraction remains the SLOT accounting — the
+    skipped slots now cost ~0 time rather than a full stage pass."""
     M, S = num_microbatches, num_stages
     if schedule == "gpipe":
         return (S - 1) / (M + S - 1)
@@ -168,10 +188,13 @@ def pipeline_value_and_grad(stage_fn: Callable[..., jax.Array],
     Schedule: T = M + 2(S-1) tick pairs; at tick t stage s runs
     forward for microbatch t - s and backward for t - 2(S-1) + s (when
     in range). The last stage's backward of microbatch m lands on the
-    same tick as its forward. Bubbles compute on garbage that is
-    masked out of every accumulator (predication, not control flow).
-    Per tick each stage ppermutes its activation DOWN the ring and its
-    input-cotangent UP — neighbor ICI traffic both ways.
+    same tick as its forward. Bubble half-ticks are SKIPPED with real
+    ``lax.cond`` branches (safe here precisely because the backward is
+    hand-rolled — nothing ADs through the cond), so ramp/drain costs
+    ~no compute; skip branches return exact zeros, which is what the
+    plain-add accumulators rely on. Per tick each stage ppermutes its
+    activation DOWN the ring and its input-cotangent UP — neighbor ICI
+    traffic both ways.
 
     Interfaces:
       stage_fn(params, x_mb[, key]) -> y_mb       (same as pipeline_apply)
@@ -236,11 +259,6 @@ def pipeline_value_and_grad(stage_fn: Callable[..., jax.Array],
             dlast, dy = vjp_fn(jnp.asarray(scale, val.dtype))
             return val, met, dlast, dy
 
-        def masked_add(acc, g, pred):
-            return jax.tree_util.tree_map(
-                lambda a, b: a + jnp.where(pred, b.astype(a.dtype), 0),
-                acc, g)
-
         zero_dp = jax.tree_util.tree_map(
             lambda p: jnp.zeros(p.shape, jnp.float32), params)
         zero_dlast = jax.tree_util.tree_map(
@@ -261,11 +279,19 @@ def pipeline_value_and_grad(stage_fn: Callable[..., jax.Array],
         zero_met = jax.tree_util.tree_map(
             lambda a: jnp.zeros(a.shape, a.dtype), met_abs)
 
+        zero_dp_step = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, p.dtype), params)
+
         def tick(carry, t):
             (fwd_msg, bwd_msg, stash, dp_acc, dlast_acc, dx_buf,
              val_acc, met_acc, aux_acc) = carry
 
             # ---- forward half: stage s runs microbatch t - s.
+            # REAL branch (lax.cond), not where-masking: a ramp/drain
+            # tick whose forward slot is a bubble SKIPS the stage
+            # compute instead of computing on garbage and masking the
+            # result — the 2(S-1)-tick bubble costs half the naive
+            # predicated schedule's wall clock.
             mf = t - s
             mf_valid = jnp.logical_and(mf >= 0, mf < M)
             mf_c = jnp.clip(mf, 0, M - 1)
@@ -273,32 +299,62 @@ def pipeline_value_and_grad(stage_fn: Callable[..., jax.Array],
                 s == 0,
                 jax.lax.dynamic_index_in_dim(xm, mf_c, 0, keepdims=False),
                 fwd_msg)
-            y, aux_v = with_key(mf_c)(params, inp)
-            aux_acc = masked_add(aux_acc, aux_v, mf_valid)
-            slot = jnp.mod(mf_c, D)
-            prev = jax.lax.dynamic_index_in_dim(stash, slot, 0,
-                                                keepdims=False)
-            stash = jax.lax.dynamic_update_index_in_dim(
-                stash, jnp.where(mf_valid, inp, prev), slot, 0)
+
+            def fwd_run(inp, stash):
+                y, aux_v = with_key(mf_c)(params, inp)
+                stash = jax.lax.dynamic_update_index_in_dim(
+                    stash, inp, jnp.mod(mf_c, D), 0)
+                return y, aux_v, stash
+
+            def fwd_skip(inp, stash):
+                return jnp.zeros_like(inp), zero_aux, stash
+
+            y, aux_v, stash = jax.lax.cond(mf_valid, fwd_run, fwd_skip,
+                                           inp, stash)
+            # Skipped slots contribute exact zeros — plain adds suffice.
+            aux_acc = jax.tree_util.tree_map(
+                lambda a, b: a + b, aux_acc, aux_v)
 
             # ---- last-stage loss + cotangent seed for the SAME tick's
-            # backward (masked no-op on other stages).
-            hval, hmet, hdlast, hdy = head(mf_c, y)
+            # backward. Branch on (is_last AND valid): non-last stages
+            # no longer pay the head's vocab matmul every tick.
             take_head = jnp.logical_and(is_last, mf_valid)
-            val_acc = val_acc + jnp.where(take_head, hval, 0.0)
-            met_acc = masked_add(met_acc, hmet, take_head)
-            dlast_acc = masked_add(dlast_acc, hdlast, take_head)
 
-            # ---- backward half: stage s runs microbatch t-2(S-1)+s.
+            def head_run(y):
+                return head(mf_c, y)
+
+            def head_skip(y):
+                return (jnp.zeros((), jnp.float32), zero_met,
+                        zero_dlast, jnp.zeros_like(y))
+
+            hval, hmet, hdlast, hdy = jax.lax.cond(take_head, head_run,
+                                                   head_skip, y)
+            val_acc = val_acc + hval
+            met_acc = jax.tree_util.tree_map(
+                lambda a, b: a + b.astype(a.dtype), met_acc, hmet)
+            dlast_acc = jax.tree_util.tree_map(
+                lambda a, b: a + b.astype(a.dtype), dlast_acc, hdlast)
+
+            # ---- backward half: stage s runs microbatch t-2(S-1)+s,
+            # same real-branch treatment.
             mbk = t - 2 * (S - 1) + s
             b_valid = jnp.logical_and(mbk >= 0, mbk < M)
             mb_c = jnp.clip(mbk, 0, M - 1)
-            x_saved = jax.lax.dynamic_index_in_dim(
-                stash, jnp.mod(mb_c, D), 0, keepdims=False)
-            cot = jnp.where(is_last, hdy, bwd_msg)
-            _, vjp_fn = jax.vjp(with_key(mb_c), params, x_saved)
-            dp, dx = vjp_fn((cot.astype(x_saved.dtype), aux_seed))
-            dp_acc = masked_add(dp_acc, dp, b_valid)
+
+            def bwd_run(stash, hdy, bwd_msg):
+                x_saved = jax.lax.dynamic_index_in_dim(
+                    stash, jnp.mod(mb_c, D), 0, keepdims=False)
+                cot = jnp.where(is_last, hdy, bwd_msg)
+                _, vjp_fn = jax.vjp(with_key(mb_c), params, x_saved)
+                return vjp_fn((cot.astype(x_saved.dtype), aux_seed))
+
+            def bwd_skip(stash, hdy, bwd_msg):
+                return zero_dp_step, jnp.zeros_like(xm[0])
+
+            dp, dx = jax.lax.cond(b_valid, bwd_run, bwd_skip,
+                                  stash, hdy, bwd_msg)
+            dp_acc = jax.tree_util.tree_map(
+                lambda a, b: a + b.astype(a.dtype), dp_acc, dp)
             take_dx = jnp.logical_and(b_valid, s == 0)
             prev_dx = jax.lax.dynamic_index_in_dim(dx_buf, mb_c, 0,
                                                    keepdims=False)

@@ -1,6 +1,5 @@
 """Multi-step runner: K scanned steps == K sequential dispatches."""
 
-import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
