@@ -127,11 +127,13 @@ class TrainConfig:
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     # Remat (jax.checkpoint) policy for big models: none | full | dots
     remat: str = "none"
-    # Pipeline schedule for model=pipelined_lm: "gpipe" (AD through the
-    # forward schedule; per-stage residuals grow O(M)) or "1f1b"
-    # (hand-scheduled backward interleaved with forward; per-stage
-    # state O(S) — train.pipeline_step).
-    pipeline_schedule: str = "gpipe"
+    # Pipeline schedule for model=pipelined_lm: "1f1b" (default —
+    # hand-scheduled backward interleaved with forward: per-stage
+    # state O(S) AND lax.cond-skipped bubbles, measured 2.1x faster
+    # than gpipe at S=4/M=4; train.pipeline_step) or "gpipe" (AD
+    # through the forward schedule; per-stage residuals grow O(M);
+    # composes with grad_accum_steps, which 1f1b subsumes).
+    pipeline_schedule: str = "1f1b"
     # Microbatches per pipeline step (M): batch_size % M == 0 and
     # M >= mesh.pipe. More microbatches shrink the bubble,
     # (S-1)/(M+S-1) for gpipe (parallel.pipeline.bubble_fraction).
@@ -183,7 +185,9 @@ class TrainConfig:
         if self.pipeline_schedule not in ("gpipe", "1f1b"):
             raise ValueError(
                 f"unknown pipeline_schedule {self.pipeline_schedule!r}")
-        if self.pipeline_schedule == "1f1b" and self.grad_accum_steps > 1:
+        if (self.model == "pipelined_lm"
+                and self.pipeline_schedule == "1f1b"
+                and self.grad_accum_steps > 1):
             # Deliberate exclusion, not a gap: 1F1B's microbatch loop IS
             # gradient accumulation (per-microbatch grads accumulate in
             # the schedule's dp_acc before the single optimizer update,

@@ -90,6 +90,7 @@ def test_pipelined_lm_trains(devices8):
                       dataset="synthetic", batch_size=64, train_steps=60,
                       eval_every=0, log_every=0, eval_batch_size=64,
                       compute_dtype="float32", learning_rate=3e-3,
+                      pipeline_schedule="gpipe",  # this is the GPipe test
                       mesh=MeshConfig(data=2, pipe=4))
     result = train(cfg)
     assert result.final_metrics["accuracy"] >= 0.4, result.final_metrics

@@ -246,7 +246,11 @@ def test_1f1b_config_validation():
     cfg = TrainConfig(pipeline_schedule="zigzag")
     with pytest.raises(ValueError, match="pipeline_schedule"):
         cfg.validate()
-    cfg = TrainConfig(pipeline_schedule="1f1b", grad_accum_steps=2,
-                      batch_size=256)
+    cfg = TrainConfig(model="pipelined_lm", pipeline_schedule="1f1b",
+                      grad_accum_steps=2, batch_size=256)
     with pytest.raises(ValueError, match="accumulates"):
         cfg.validate()
+    # The exclusion is gated on the pipelined model: other families
+    # keep grad accumulation under the (now default) 1f1b setting.
+    TrainConfig(model="gpt_lm", grad_accum_steps=2,
+                batch_size=256).validate()
