@@ -86,6 +86,80 @@ def is_chief() -> bool:
     return jax.process_index() == 0
 
 
+def process_batch_role(mesh: Mesh):
+    """(effective_count, effective_index) for BATCH-ROW distribution.
+
+    The data layer splits each global batch into per-process disjoint
+    row slices — correct ONLY when the mesh's "data" axis spans the
+    processes. When a NON-data axis spans them (e.g. a cross-process
+    ring: data=1, seq=8 over 2 hosts), several processes sit inside
+    the same data coordinate and must supply IDENTICAL rows, or the
+    assembled global batch is garbage. With the row-major
+    (data, pipe, seq, model, expert) construction above, process p's
+    local devices are the contiguous block [p*L, (p+1)*L) of
+    jax.devices(), so its data coordinate(s) follow from L vs the
+    devices-per-data-coordinate count; this returns the values the
+    batchers should use in place of raw process_count/process_index.
+    """
+    pc = jax.process_count()
+    if pc == 1:
+        return 1, 0
+    total = mesh.devices.size
+    local = total // pc
+    inner = total // mesh.shape[AXIS_DATA]  # devices per data coord
+    if max(local, inner) % min(local, inner):
+        raise ValueError(
+            f"unsupported process layout: {local} local devices per "
+            f"process vs {inner} devices per data coordinate — a "
+            f"process would straddle a data-shard boundary")
+    eff_count = total // max(local, inner)
+    eff_index = jax.process_index() // max(1, inner // local)
+    return eff_count, eff_index
+
+
+def process_axis_range(mesh: Mesh, axis: str, dim: int):
+    """[lo, hi) slice of a ``dim``-sized global array axis sharded over
+    mesh ``axis`` that THIS process's local devices address.
+
+    Needed by the multi-host batch assembly: when a non-batch mesh axis
+    (e.g. "seq") spans processes, each process must hand
+    ``make_array_from_process_local_data`` exactly its local block of
+    that axis — passing the full axis makes JAX infer a doubled global
+    shape (each process's copy taken as a distinct shard). Relies on
+    the row-major MESH_AXES device layout of make_mesh below.
+    """
+    pc = jax.process_count()
+    size = mesh.shape[axis]
+    if pc == 1 or size == 1:
+        return 0, dim
+    # Devices per increment of this axis = product of the axis sizes
+    # AFTER it in MESH_AXES order.
+    stride = 1
+    for a in MESH_AXES[MESH_AXES.index(axis) + 1:]:
+        stride *= mesh.shape[a]
+    span = stride * size  # one full cycle of the axis
+    local = mesh.devices.size // pc
+    if local >= span:
+        return 0, dim  # process covers every coordinate
+    if stride % local and local % stride:
+        raise ValueError(
+            f"unsupported process layout for axis {axis!r}: {local} "
+            f"local devices vs stride {stride}")
+    first = jax.process_index() * local
+    coord0 = (first // stride) % size
+    count = max(1, local // stride)
+    if coord0 + count > size:
+        # The process's device block wraps across a cycle of this axis
+        # (e.g. data=1, pipe=2, seq=3 over 3 processes of 2): its
+        # coordinates are non-contiguous and cannot be one host slice.
+        raise ValueError(
+            f"unsupported process layout: process covers wrapped "
+            f"{axis!r} coordinates [{coord0}, {coord0 + count}) of "
+            f"{size}")
+    rows = dim // size
+    return coord0 * rows, (coord0 + count) * rows
+
+
 def make_mesh(cfg: Optional[MeshConfig] = None,
               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     """Build a ``(data, pipe, seq, model)`` mesh over the given devices.

@@ -28,7 +28,8 @@ import numpy as np
 from flax import linen as nn
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tensorflow_distributed_tpu.parallel.mesh import AXIS_DATA, AXIS_SEQ
+from tensorflow_distributed_tpu.parallel.mesh import (
+    AXIS_DATA, AXIS_SEQ, process_axis_range, process_batch_role)
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
@@ -69,18 +70,29 @@ def param_sharding(mesh: Mesh, tree: Any) -> Any:
         one, tree, is_leaf=lambda x: isinstance(x, nn.Partitioned))
 
 
-def process_slice(batch: Any) -> Any:
+def process_slice(batch: Any, mesh: Optional[Mesh] = None) -> Any:
     """Slice a replicated host batch down to this process's rows.
 
     ``shard_batch`` expects PROCESS-LOCAL rows under multi-host (the
     train stream's ShardedBatcher already yields them); eval paths that
     materialize the same full batch on every process go through this
     first. Single-process: identity.
+
+    ``mesh``: when given, the slice follows the mesh's data-axis
+    process layout (parallel.mesh.process_batch_role) — processes that
+    share a data coordinate (a cross-process seq/model/pipe axis) keep
+    identical full slices instead of wrongly-disjoint ones. Without a
+    mesh, falls back to the plain per-process split (correct only when
+    the data axis spans all processes).
     """
-    pc = jax.process_count()
+    if jax.process_count() == 1:
+        return batch
+    if mesh is not None:
+        pc, pi = process_batch_role(mesh)
+    else:
+        pc, pi = jax.process_count(), jax.process_index()
     if pc == 1:
         return batch
-    pi = jax.process_index()
 
     def one(x):
         x = np.asarray(x)
@@ -110,6 +122,16 @@ def shard_batch(mesh: Mesh, batch: Any, seq_axis: Optional[int] = None) -> Any:
         x = np.asarray(x)
         sharding = batch_sharding(mesh, x.ndim, seq_axis)
         if multihost:
+            if seq_axis is not None and mesh.shape[AXIS_SEQ] > 1:
+                # A cross-process seq axis: hand JAX exactly this
+                # process's seq block, or it infers a doubled global
+                # seq dim (parallel.mesh.process_axis_range).
+                lo, hi = process_axis_range(mesh, AXIS_SEQ,
+                                            x.shape[seq_axis])
+                if (lo, hi) != (0, x.shape[seq_axis]):
+                    sl = [slice(None)] * x.ndim
+                    sl[seq_axis] = slice(lo, hi)
+                    x = x[tuple(sl)]
             return jax.make_array_from_process_local_data(sharding, x)
         return jax.device_put(x, sharding)
 

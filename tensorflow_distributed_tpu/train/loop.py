@@ -60,8 +60,9 @@ def evaluate(state: TrainState, eval_fn, task: Task, mesh, batch: int
     count = 0
     for host_batch in task.eval_batches(batch):
         # eval_batches yields the same full batch on every process;
-        # shard_batch wants process-local rows under multi-host.
-        b = shard_batch(mesh, process_slice(host_batch),
+        # shard_batch wants process-local rows under multi-host (mesh-
+        # aware: co-data-coordinate processes keep identical slices).
+        b = shard_batch(mesh, process_slice(host_batch, mesh),
                         seq_axis=task.seq_axis)
         m = jax.device_get(eval_fn(state, b))
         for k, v in m.items():

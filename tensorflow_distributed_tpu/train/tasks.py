@@ -45,20 +45,23 @@ def vision_loss(apply_fn, params, extra, batch, dropout_key, train):
 
 def _make_vision_task(cfg: TrainConfig, mesh: Mesh) -> Task:
     from tensorflow_distributed_tpu.data import ShardedBatcher, load_dataset
+    from tensorflow_distributed_tpu.parallel.mesh import process_batch_role
 
     train_ds, val_ds, _ = load_dataset(cfg.dataset, cfg.data_dir, cfg.seed)
+    # Mesh-aware process role, NOT raw process_count: processes sharing
+    # a data coordinate must supply identical rows (parallel.mesh).
+    n_proc, i_proc = process_batch_role(mesh)
     if cfg.data_backend == "u8_native":
         from tensorflow_distributed_tpu.data.u8 import (
             U8Dataset, U8ShardedBatcher)
         batcher = U8ShardedBatcher(
             U8Dataset.from_float(train_ds), cfg.batch_size,
-            cfg.shuffle_seed, num_processes=jax.process_count(),
-            process_index=jax.process_index())
+            cfg.shuffle_seed, num_processes=n_proc,
+            process_index=i_proc)
     else:
         batcher = ShardedBatcher(
             train_ds, cfg.batch_size, cfg.shuffle_seed,
-            num_processes=jax.process_count(),
-            process_index=jax.process_index())
+            num_processes=n_proc, process_index=i_proc)
 
     def eval_batches(batch: int) -> Iterator[Any]:
         n = (len(val_ds) // batch) * batch
@@ -152,9 +155,11 @@ def _make_lm_task(cfg: TrainConfig, mesh: Mesh, objective: str,
     val_ds = gen(n=max(4 * cfg.eval_batch_size, 512),
                  seq_len=seq_len, vocab_size=vocab_size,
                  seed=cfg.seed + 1)
+    from tensorflow_distributed_tpu.parallel.mesh import process_batch_role
+
+    n_proc, i_proc = process_batch_role(mesh)
     batcher = LmBatcher(train_ds, cfg.batch_size, cfg.shuffle_seed,
-                        num_processes=jax.process_count(),
-                        process_index=jax.process_index())
+                        num_processes=n_proc, process_index=i_proc)
 
     def eval_batches(batch: int) -> Iterator[Any]:
         nrows = (len(val_ds) // batch) * batch

@@ -43,9 +43,22 @@ def main() -> None:
         mesh=MeshConfig(data=8), seed=0)
     result = train(cfg)
 
-    params = jax.device_get(result.state.params)
-    checksum = float(sum(abs(x).sum()
+    def checksum(state):
+        params = jax.device_get(state.params)
+        return float(sum(abs(x).sum()
                          for x in jax.tree_util.tree_leaves(params)))
+
+    # Second scenario: ring attention with the SEQUENCE axis spanning
+    # both processes (seq=8 over 2 x 4 local devices) — the zigzag
+    # causal ring's ppermutes cross the process boundary, i.e. the
+    # long-context path over "DCN" rather than intra-host ICI.
+    lm_cfg = TrainConfig(
+        model="gpt_lm", model_size="tiny", dataset="synthetic",
+        batch_size=16, train_steps=4, eval_every=0, log_every=0,
+        eval_batch_size=32, compute_dtype="float32", dropout_rate=0.0,
+        mesh=MeshConfig(data=1, seq=8), seed=0)
+    lm_result = train(lm_cfg)
+
     with open(out_path, "w") as f:
         json.dump({
             "process_index": jax.process_index(),
@@ -55,7 +68,11 @@ def main() -> None:
             "step": int(jax.device_get(result.state.step)),
             "final_metrics": {k: float(v)
                               for k, v in result.final_metrics.items()},
-            "params_checksum": checksum,
+            "params_checksum": checksum(result.state),
+            "lm_final_metrics": {
+                k: float(v)
+                for k, v in lm_result.final_metrics.items()},
+            "lm_params_checksum": checksum(lm_result.state),
         }, f)
 
 
