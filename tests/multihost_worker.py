@@ -35,6 +35,38 @@ def main() -> None:
     from tensorflow_distributed_tpu.config import MeshConfig, TrainConfig
     from tensorflow_distributed_tpu.train.loop import train
 
+    def checksum(state):
+        import jax as _jax
+        params = _jax.device_get(state.params)
+        return float(sum(abs(x).sum()
+                         for x in _jax.tree_util.tree_leaves(params)))
+
+    phase = os.environ.get("MH_PHASE", "")
+    if phase:
+        # Crash-recovery scenario (SURVEY.md §5: the reference's
+        # Supervisor re-attach): phase "crash" trains to step 5 with
+        # checkpointing and exits — simulating whole-job loss, the
+        # documented TPU fault model; phase "resume" restarts the SAME
+        # cluster with --resume and finishes to step 10.
+        cfg = TrainConfig(
+            model="mnist_cnn", dataset="synthetic", batch_size=64,
+            train_steps=5 if phase == "crash" else 10,
+            eval_every=0, log_every=0, eval_batch_size=128,
+            checkpoint_dir=os.environ["MH_CKPT_DIR"],
+            checkpoint_every=5, resume=(phase == "resume"),
+            compute_dtype="float32", dropout_rate=0.0,
+            mesh=MeshConfig(data=8), seed=0)
+        result = train(cfg)
+        with open(out_path, "w") as f:
+            json.dump({
+                "step": int(jax.device_get(result.state.step)),
+                "final_metrics": {
+                    k: float(v)
+                    for k, v in result.final_metrics.items()},
+                "params_checksum": checksum(result.state),
+            }, f)
+        return
+
     cfg = TrainConfig(
         model="mnist_cnn", dataset="synthetic", batch_size=64,
         train_steps=6, eval_every=0, log_every=0, eval_batch_size=128,
@@ -42,11 +74,6 @@ def main() -> None:
         compute_dtype="float32", dropout_rate=0.0,
         mesh=MeshConfig(data=8), seed=0)
     result = train(cfg)
-
-    def checksum(state):
-        params = jax.device_get(state.params)
-        return float(sum(abs(x).sum()
-                         for x in jax.tree_util.tree_leaves(params)))
 
     # Second scenario: ring attention with the SEQUENCE axis spanning
     # both processes (seq=8 over 2 x 4 local devices) — the zigzag

@@ -68,3 +68,79 @@ def test_replicated_places_full_copy_everywhere(mesh8):
 
 def test_is_chief_single_host():
     assert meshlib.is_chief()
+
+
+def _fake_procs(monkeypatch, count, index):
+    monkeypatch.setattr(jax, "process_count", lambda: count)
+    monkeypatch.setattr(jax, "process_index", lambda: index)
+
+
+def test_process_batch_role_layouts(devices8, monkeypatch):
+    """Pure-function enumeration of the multi-host batch-role math
+    (parallel.mesh.process_batch_role) — garbage here means silently
+    wrong global batches, so every branch gets a unit case."""
+    from tensorflow_distributed_tpu.parallel.mesh import process_batch_role
+
+    # data axis spans the processes: disjoint per-process slices.
+    m = meshlib.make_mesh(MeshConfig(data=8), devices8)
+    _fake_procs(monkeypatch, 2, 1)
+    assert process_batch_role(m) == (2, 1)
+
+    # data=2 x seq=4, 2 procs: each proc owns one whole data coord.
+    m = meshlib.make_mesh(MeshConfig(data=2, seq=4), devices8)
+    _fake_procs(monkeypatch, 2, 1)
+    assert process_batch_role(m) == (2, 1)
+
+    # seq spans the processes (data=1): both procs share data coord 0
+    # and must supply IDENTICAL rows.
+    m = meshlib.make_mesh(MeshConfig(data=1, seq=8), devices8)
+    for p in range(2):
+        _fake_procs(monkeypatch, 2, p)
+        assert process_batch_role(m) == (1, 0)
+
+    # Mixed: data=2 x seq=2 x model=2 over 4 procs — procs pair up per
+    # data coordinate.
+    m = meshlib.make_mesh(MeshConfig(data=2, seq=2, model=2), devices8)
+    for p in range(4):
+        _fake_procs(monkeypatch, 4, p)
+        assert process_batch_role(m) == (2, p // 2)
+
+    # Straddle: a process crossing a data-shard boundary is rejected.
+    m = meshlib.make_mesh(MeshConfig(data=3, seq=2), devices8[:6])
+    _fake_procs(monkeypatch, 2, 0)
+    with pytest.raises(ValueError, match="straddle"):
+        process_batch_role(m)
+
+
+def test_process_axis_range_layouts(devices8, monkeypatch):
+    from tensorflow_distributed_tpu.parallel.mesh import process_axis_range
+
+    # seq spans 2 procs: each gets its half of the sequence dim.
+    m = meshlib.make_mesh(MeshConfig(data=1, seq=8), devices8)
+    _fake_procs(monkeypatch, 2, 0)
+    assert process_axis_range(m, "seq", 128) == (0, 64)
+    _fake_procs(monkeypatch, 2, 1)
+    assert process_axis_range(m, "seq", 128) == (64, 128)
+
+    # data spans procs, seq inside each: every proc sees the full seq.
+    m = meshlib.make_mesh(MeshConfig(data=2, seq=4), devices8)
+    _fake_procs(monkeypatch, 2, 1)
+    assert process_axis_range(m, "seq", 128) == (0, 128)
+
+    # Inner model axis: seq coordinate alternates across 4 procs.
+    m = meshlib.make_mesh(MeshConfig(data=2, seq=2, model=2), devices8)
+    for p, want in [(0, (0, 64)), (1, (64, 128)),
+                    (2, (0, 64)), (3, (64, 128))]:
+        _fake_procs(monkeypatch, 4, p)
+        assert process_axis_range(m, "seq", 128) == want
+
+    # Wrapped non-contiguous coverage is rejected, not mis-sliced.
+    m = meshlib.make_mesh(MeshConfig(data=1, pipe=2, seq=3), devices8[:6])
+    _fake_procs(monkeypatch, 3, 1)
+    with pytest.raises(ValueError, match="wrapped"):
+        process_axis_range(m, "seq", 12)
+
+    # Size-1 axis or single process: identity.
+    m = meshlib.make_mesh(MeshConfig(data=8), devices8)
+    _fake_procs(monkeypatch, 2, 1)
+    assert process_axis_range(m, "seq", 128) == (0, 128)

@@ -36,14 +36,13 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.fixture(scope="module")
-def multihost_results(tmp_path_factory):
-    tmp = tmp_path_factory.mktemp("multihost")
-    ckpt_dir = tmp / "ckpt"
+def _launch_cluster(tmp, ckpt_dir, tag, extra_env=None):
+    """Run one 2-process cluster of multihost_worker.py to completion;
+    returns (results, logs)."""
     port = _free_port()
     procs, outs = [], []
     for p in range(2):
-        out = tmp / f"result_{p}.json"
+        out = tmp / f"result_{tag}_{p}.json"
         outs.append(out)
         env = {
             # Minimal, explicit env: no axon sitecustomize, no inherited
@@ -59,6 +58,7 @@ def multihost_results(tmp_path_factory):
             "MH_CKPT_DIR": str(ckpt_dir),
             "JAX_COMPILATION_CACHE_DIR":
                 os.environ.get("JAX_COMPILATION_CACHE_DIR", ""),
+            **(extra_env or {}),
         }
         procs.append(subprocess.Popen(
             [sys.executable, os.path.join(REPO, "tests",
@@ -77,7 +77,14 @@ def multihost_results(tmp_path_factory):
         logs.append(stdout)
     for rc, log in zip([p.returncode for p in procs], logs):
         assert rc == 0, f"worker failed (rc={rc}):\n{log[-3000:]}"
-    results = [json.loads(out.read_text()) for out in outs]
+    return [json.loads(out.read_text()) for out in outs], logs
+
+
+@pytest.fixture(scope="module")
+def multihost_results(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("multihost")
+    ckpt_dir = tmp / "ckpt"
+    results, logs = _launch_cluster(tmp, ckpt_dir, "main")
     return results, ckpt_dir, logs
 
 
@@ -134,6 +141,41 @@ def test_ring_attention_across_processes(multihost_results):
     single = train(cfg)
     for k, v in single.final_metrics.items():
         np.testing.assert_allclose(a["lm_final_metrics"][k], v,
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_crash_and_resume_across_processes(tmp_path_factory):
+    """Failure recovery at the whole-job fault model (SURVEY.md §5:
+    the reference's Supervisor re-attached a restarted worker from its
+    checkpoint): a 2-process cluster trains to step 5 with durable
+    checkpoints and dies; a FRESH cluster restarts with --resume and
+    finishes to step 10, landing exactly where an uninterrupted run
+    lands (same sample stream: the resume fast-forward is tested
+    single-process in test_loop_cli; this pins it across processes
+    with chief-only checkpoint writes)."""
+    tmp = tmp_path_factory.mktemp("multihost_crash")
+    ckpt_dir = tmp / "ckpt"
+    _launch_cluster(tmp, ckpt_dir, "crash",
+                    extra_env={"MH_PHASE": "crash"})
+    assert ckpt_dir.exists() and any(ckpt_dir.iterdir()), \
+        "no checkpoint written before crash"
+    resumed, _ = _launch_cluster(tmp, ckpt_dir, "resume",
+                                 extra_env={"MH_PHASE": "resume"})
+    assert all(r["step"] == 10 for r in resumed)
+    assert resumed[0]["params_checksum"] == resumed[1]["params_checksum"]
+
+    # Uninterrupted oracle: the same 10 steps in one process.
+    from tensorflow_distributed_tpu.config import MeshConfig, TrainConfig
+    from tensorflow_distributed_tpu.train.loop import train
+
+    cfg = TrainConfig(
+        model="mnist_cnn", dataset="synthetic", batch_size=64,
+        train_steps=10, eval_every=0, log_every=0, eval_batch_size=128,
+        compute_dtype="float32", dropout_rate=0.0,
+        mesh=MeshConfig(data=8), seed=0)
+    single = train(cfg)
+    for k, v in single.final_metrics.items():
+        np.testing.assert_allclose(resumed[0]["final_metrics"][k], v,
                                    rtol=1e-4, atol=1e-5)
 
 
