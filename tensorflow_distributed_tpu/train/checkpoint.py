@@ -14,7 +14,11 @@ Design:
   a crash mid-save never corrupts the latest checkpoint — the recovery
   story the Supervisor's background saver provided (:245,:252).
 - Only the chief process writes (parallel.mesh.is_chief); every process
-  restores. Params are fetched to host via ``jax.device_get`` — for the
+  restores. Leaves fully addressable on this host come back via
+  ``jax.device_get``; leaves sharded ACROSS processes (FSDP over a
+  multi-host data axis, cross-process TP) are first allgathered to a
+  replicated layout — a collective, so ``save`` must be (and is) called
+  by every process, with only the chief writing the bytes. For the
   model sizes this framework targets per-host full gathers are fine;
   sharded per-host saves are an orbax upgrade path documented here.
 - Restore places leaves back on the mesh with the *current* state's
@@ -36,6 +40,44 @@ from flax import serialization
 from tensorflow_distributed_tpu.parallel.mesh import is_chief
 
 _STEP_PREFIX = "step_"
+
+
+def _identity(a):
+    # Module-level so jax.jit's cache keys on ONE function object and
+    # hits per (shape, sharding) — a per-call lambda would recompile
+    # the allgather for every leaf at every checkpoint.
+    return a
+
+
+def _fetch_host(state: Any, values: bool = True) -> Any:
+    """Device->host copy of a state pytree, cross-process-sharding safe.
+
+    A leaf partitioned over an axis that spans processes (FSDP params
+    under a multi-host data axis, cross-process TP) is neither fully
+    addressable nor fully replicated, so plain ``jax.device_get``
+    raises. Such leaves are allgathered to a replicated layout first —
+    a COLLECTIVE: every process must reach this call (save/restore are
+    structured so they all do). Fully-replicated leaves (the default
+    layout) skip the collective and copy from local shards.
+
+    ``values=False``: participate in the collectives (mandatory on
+    every process) but skip the host copies — what non-chief processes
+    do in ``save``. Returns None.
+    """
+    if jax.process_count() == 1:
+        return jax.device_get(state) if values else None
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    def one(x):
+        if (isinstance(x, jax.Array) and not x.is_fully_addressable
+                and not x.is_fully_replicated):
+            x = jax.jit(_identity,
+                        out_shardings=NamedSharding(
+                            x.sharding.mesh, PartitionSpec()))(x)
+        return jax.device_get(x) if values else None
+
+    out = jax.tree_util.tree_map(one, state)
+    return out if values else None
 
 
 def _step_dir(ckpt_dir: str, step: int) -> str:
@@ -60,18 +102,42 @@ def latest_step(ckpt_dir: str) -> Optional[int]:
     return steps[-1] if steps else None
 
 
+def _save_barrier(step: int) -> None:
+    """All processes leave ``save`` only after the chief's rename.
+
+    Without this, a same-cluster resume (train -> train(resume=True))
+    races the write: non-chief processes could read ``latest_step``
+    before the chief finished renaming the new step dir and restore a
+    different (older) checkpoint than the chief. Single-process: no-op.
+    """
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"tfd_ckpt_save_{step}")
+
+
 def save(ckpt_dir: str, state: Any, keep: int = 3) -> str:
-    """Write state at its current step; prune to the newest ``keep``."""
+    """Write state at its current step; prune to the newest ``keep``.
+
+    Collective under multi-host (every process must call it; only the
+    chief writes bytes): cross-process-partitioned leaves are fetched
+    via an allgather, and all processes barrier on the completed write
+    before returning, so ``latest_step`` is coherent cluster-wide the
+    moment ``save`` returns anywhere."""
     step = int(jax.device_get(state.step))
     final = _step_dir(ckpt_dir, step)
+    # Collective fetch BEFORE the chief gate: cross-process-partitioned
+    # leaves need every process in the allgather. Non-chief processes
+    # run the collectives only; the chief also copies values to host.
+    host_state = _fetch_host(state, values=is_chief())
     if not is_chief():
+        _save_barrier(step)
         return final
     os.makedirs(ckpt_dir, exist_ok=True)
     tmp = final + ".tmp"
     if os.path.exists(tmp):
         shutil.rmtree(tmp)
     os.makedirs(tmp)
-    host_state = jax.device_get(state)
     with open(os.path.join(tmp, "state.msgpack"), "wb") as f:
         f.write(serialization.to_bytes(host_state))
     manifest = {
@@ -88,6 +154,7 @@ def save(ckpt_dir: str, state: Any, keep: int = 3) -> str:
     os.rename(tmp, final)
     for old in available_steps(ckpt_dir)[:-keep]:
         shutil.rmtree(_step_dir(ckpt_dir, old), ignore_errors=True)
+    _save_barrier(step)
     return final
 
 
@@ -98,12 +165,24 @@ def restore(ckpt_dir: str, state: Any, step: Optional[int] = None) -> Any:
     if step is None:
         raise FileNotFoundError(f"no checkpoints under {ckpt_dir}")
     path = os.path.join(_step_dir(ckpt_dir, step), "state.msgpack")
+    # from_bytes only needs the pytree STRUCTURE (plus leaf shapes for
+    # shape-checking) — a zeros skeleton costs no device transfers or
+    # collectives, unlike fetching the throwaway template's values.
+    skeleton = jax.tree_util.tree_map(
+        lambda leaf: np.zeros(leaf.shape, leaf.dtype)
+        if isinstance(leaf, jax.Array) else leaf, state)
     with open(path, "rb") as f:
-        host_state = serialization.from_bytes(jax.device_get(state), f.read())
+        host_state = serialization.from_bytes(skeleton, f.read())
 
     # Re-place every leaf with the template's sharding (mesh-shape
-    # agnostic restore).
+    # agnostic restore). Templates sharded across processes can't take
+    # a plain device_put of the full host value; each process supplies
+    # its addressable shards via the callback form instead.
     def place(tmpl, host):
+        if isinstance(tmpl, jax.Array) and not tmpl.is_fully_addressable:
+            arr = np.asarray(host)
+            return jax.make_array_from_callback(
+                arr.shape, tmpl.sharding, lambda idx: arr[idx])
         return jax.device_put(host, tmpl.sharding)
 
     return jax.tree_util.tree_map(place, state, host_state)

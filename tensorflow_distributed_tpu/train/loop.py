@@ -101,7 +101,8 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
         if cfg.compute_dtype == "bfloat16" else jax.numpy.float32,
         **size_kw)
     tx = make_optimizer(cfg)
-    state = create_train_state(model, tx, task.sample_input, mesh, cfg.seed)
+    state = create_train_state(model, tx, task.sample_input, mesh, cfg.seed,
+                               fsdp=cfg.param_partition == "fsdp")
 
     start_step = 0
     if cfg.resume and ckpt.latest_step(cfg.checkpoint_dir) is not None:

@@ -19,7 +19,8 @@ import optax
 from flax import struct
 from jax.sharding import Mesh
 
-from tensorflow_distributed_tpu.parallel.sharding import param_sharding, replicated
+from tensorflow_distributed_tpu.parallel.sharding import (
+    FSDP_MIN_SIZE, param_sharding, replicated)
 from tensorflow_distributed_tpu.utils import prng
 
 # Collections sown per-forward-pass (diagnostics/aux losses), never
@@ -48,14 +49,25 @@ class TrainState:
 
 
 def create_train_state(model: nn.Module, tx: optax.GradientTransformation,
-                       sample_input: jax.Array, mesh: Mesh, seed: int = 0
-                       ) -> TrainState:
+                       sample_input: jax.Array, mesh: Mesh, seed: int = 0,
+                       fsdp: bool = False,
+                       fsdp_min_size: int = FSDP_MIN_SIZE) -> TrainState:
     """Initialize params/opt-state and place them on the mesh.
 
     Every process calls this with the same seed and gets bit-identical
     params — replacing the reference's chief-initializes-then-others-wait
     protocol (``prepare_or_wait_for_session``, mnist_python_m.py:264-275).
     Partition-annotated params land sharded; everything else replicated.
+
+    ``fsdp=True`` (config ``param_partition="fsdp"``): large params —
+    and, via the slot-matching below, their Adam m/v mirrors — shard
+    one dim over the "data" axis (ZeRO-3; parallel.sharding). The
+    train step is unchanged: GSPMD sees the same jit with different
+    argument shardings and inserts the gather/scatter pair. Where the
+    reference streamed FULL weights ps->worker every step over TCP
+    (mnist_python_m.py:177, SURVEY.md N4), this streams each shard
+    once per use over ICI and never materializes full optimizer state
+    anywhere.
     """
     # Abstract init to read partition metadata without allocating.
     abstract = jax.eval_shape(
@@ -66,6 +78,14 @@ def create_train_state(model: nn.Module, tx: optax.GradientTransformation,
     # Applied to the full variables dict it also covers non-param
     # collections (batch_stats, ...), which are bare -> replicated.
     var_shardings = param_sharding(mesh, abstract)
+    if fsdp:
+        # FSDP is scoped to the params subtree: non-param collections
+        # (batch_stats, ...) stay replicated — they are read every
+        # forward pass and small, so sharding them buys nothing.
+        var_shardings = {
+            **var_shardings,
+            "params": param_sharding(mesh, abstract["params"], fsdp=True,
+                                     fsdp_min_size=fsdp_min_size)}
     shardings = var_shardings["params"]
 
     def init_vars(key):

@@ -42,6 +42,36 @@ def main() -> None:
                          for x in _jax.tree_util.tree_leaves(params)))
 
     phase = os.environ.get("MH_PHASE", "")
+    if phase == "fsdp":
+        # FSDP with the data axis spanning BOTH processes: params and
+        # Adam slots are sharded across the process boundary, so the
+        # checkpoint path must do a collective host fetch
+        # (train.checkpoint._fetch_host) and restore must re-place via
+        # per-process shard callbacks. Train 4 steps (checkpoints at 2
+        # and 4), then resume IN the same cluster to step 8 — save and
+        # restore both executed cross-process.
+        base = dict(
+            model="mnist_cnn", dataset="synthetic", batch_size=64,
+            eval_every=0, log_every=0, eval_batch_size=128,
+            checkpoint_dir=os.environ["MH_CKPT_DIR"],
+            checkpoint_every=2, param_partition="fsdp",
+            compute_dtype="float32", dropout_rate=0.0,
+            mesh=MeshConfig(data=8), seed=0)
+        train(TrainConfig(**base, train_steps=4))
+        result = train(TrainConfig(**base, train_steps=8, resume=True))
+        from tensorflow_distributed_tpu.train.checkpoint import _fetch_host
+        params = _fetch_host(result.state.params)
+        with open(out_path, "w") as f:
+            json.dump({
+                "step": int(jax.device_get(result.state.step)),
+                "final_metrics": {
+                    k: float(v)
+                    for k, v in result.final_metrics.items()},
+                "params_checksum": float(sum(
+                    abs(x).sum()
+                    for x in jax.tree_util.tree_leaves(params))),
+            }, f)
+        return
     if phase:
         # Crash-recovery scenario (SURVEY.md §5: the reference's
         # Supervisor re-attach): phase "crash" trains to step 5 with

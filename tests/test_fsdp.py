@@ -1,0 +1,150 @@
+"""FSDP (ZeRO-style) param/optimizer sharding over the data axis.
+
+The reference kept ONE full copy of the weights (on the ps CPU,
+mnist_python_m.py:177) and streamed it to every worker every step;
+plain SPMD data parallelism keeps a full copy on EVERY device. FSDP
+(param_partition="fsdp") is the third point: each data-parallel device
+holds 1/N of every large tensor and its Adam slots, and GSPMD inserts
+the all-gather/reduce-scatter pair — same math, proven here by exact
+parity with the replicated layout on the same batches.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tensorflow_distributed_tpu.config import MeshConfig, TrainConfig
+from tensorflow_distributed_tpu.models.cnn import MnistCNN
+from tensorflow_distributed_tpu.parallel.mesh import make_mesh
+from tensorflow_distributed_tpu.parallel.sharding import shard_batch
+from tensorflow_distributed_tpu.train.state import create_train_state
+from tensorflow_distributed_tpu.train.step import make_train_step
+
+
+def _model():
+    return MnistCNN(dropout_rate=0.0, compute_dtype=jnp.float32)
+
+
+def _state(mesh, fsdp):
+    x = jnp.zeros((2, 28, 28, 1), jnp.float32)
+    return create_train_state(_model(), optax.adam(1e-3), x, mesh,
+                              seed=0, fsdp=fsdp)
+
+
+def _batch(n=32, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.normal(size=(n, 28, 28, 1)).astype(np.float32),
+            rng.integers(0, 10, size=(n,)).astype(np.int32))
+
+
+def _shard_fractions(tree):
+    """leaf path -> local shard elements / global elements."""
+    out = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        if not hasattr(leaf, "addressable_shards") or leaf.ndim == 0:
+            continue
+        local = leaf.addressable_shards[0].data.size
+        out[jax.tree_util.keystr(path)] = local / leaf.size
+    return out
+
+
+def test_fsdp_shards_large_params_and_slots(mesh8):
+    state = _state(mesh8, fsdp=True)
+    pf = _shard_fractions(state.params)
+    # The big tensors live 1/8-sharded; small ones stay replicated.
+    sharded = {k for k, f in pf.items() if f == 1 / 8}
+    assert any("fc1" in k and "kernel" in k for k in sharded), pf
+    assert all(f == 1.0 for k, f in pf.items() if "bias" in k), pf
+    # Adam m/v mirror the param placement (train.state slot matching).
+    of = _shard_fractions(state.opt_state)
+    assert any(f == 1 / 8 for f in of.values()), of
+
+
+def test_fsdp_exact_parity_with_replicated(mesh8):
+    """Same seed, same batches: fsdp and replicated layouts are the
+    same training run — GSPMD's gather/scatter changes layout, not
+    math."""
+    s_rep = _state(mesh8, fsdp=False)
+    s_fsdp = _state(mesh8, fsdp=True)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)), s_rep.params, s_fsdp.params)
+
+    step = make_train_step(mesh8, donate=False)
+    for i in range(3):
+        batch = shard_batch(mesh8, _batch(seed=i))
+        s_rep, m_rep = step(s_rep, batch)
+        s_fsdp, m_fsdp = step(s_fsdp, batch)
+        np.testing.assert_allclose(float(m_rep["loss"]),
+                                   float(m_fsdp["loss"]), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6),
+        s_rep.params, s_fsdp.params)
+    assert int(s_fsdp.step) == 3
+
+
+def test_fsdp_composes_with_tensor_parallel(devices8):
+    """On a data=4 x model=2 mesh, TP-annotated dims keep their axis
+    and FSDP takes a *different* dim — both appear in the sharding."""
+    from tensorflow_distributed_tpu.models.transformer import (
+        BertMLM, tiny_config)
+    from tensorflow_distributed_tpu.train.tasks import (
+        mlm_batch_shardings, mlm_loss)
+    from tensorflow_distributed_tpu.data.lm import LmBatcher, synthetic_mlm
+
+    mesh = make_mesh(MeshConfig(data=4, model=2), devices8)
+    model = BertMLM(tiny_config(max_len=32), mesh)
+    sample = np.zeros((2, 32), np.int32)
+    # tiny-config tensors sit below the production FSDP_MIN_SIZE
+    # threshold; lower it so the composition logic is exercised.
+    state = create_train_state(model, optax.adam(3e-3), sample, mesh,
+                               seed=0, fsdp=True, fsdp_min_size=1024)
+    specs = {
+        jax.tree_util.keystr(p): leaf.sharding.spec
+        for p, leaf in jax.tree_util.tree_flatten_with_path(
+            state.params)[0]}
+    both = [s for s in specs.values()
+            if "data" in jax.tree_util.tree_leaves(tuple(s))
+            and "model" in jax.tree_util.tree_leaves(tuple(s))]
+    assert both, specs
+
+    step = make_train_step(mesh, loss=mlm_loss,
+                           batch_shardings=mlm_batch_shardings(mesh),
+                           donate=False)
+    ds = synthetic_mlm(n=64, seq_len=32, vocab_size=64, seed=0)
+    batch = shard_batch(
+        mesh, LmBatcher(ds, 16, 0).forever(0).__next__(), seq_axis=1)
+    state2, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+    assert int(state2.step) == 1
+
+
+def test_fsdp_checkpoint_roundtrip(mesh8, tmp_path):
+    from tensorflow_distributed_tpu.train import checkpoint as ckpt
+
+    state = _state(mesh8, fsdp=True)
+    step = make_train_step(mesh8, donate=False)
+    state, _ = step(state, shard_batch(mesh8, _batch()))
+    ckpt.save(str(tmp_path), state)
+
+    fresh = _state(mesh8, fsdp=True)
+    restored = ckpt.restore(str(tmp_path), fresh)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)), state.params, restored.params)
+    # Restored leaves keep the FSDP placement of the template.
+    assert _shard_fractions(restored.params) == _shard_fractions(
+        state.params)
+
+
+def test_config_rejects_fsdp_pipelined():
+    cfg = TrainConfig(model="pipelined_lm", model_size="tiny",
+                      param_partition="fsdp",
+                      mesh=MeshConfig(data=1, pipe=2))
+    with pytest.raises(ValueError, match="fsdp"):
+        cfg.validate()
+    with pytest.raises(ValueError, match="param_partition"):
+        TrainConfig(param_partition="zero9").validate()
