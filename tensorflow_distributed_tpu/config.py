@@ -99,7 +99,10 @@ class TrainConfig:
     data_backend: str = "numpy"  # numpy | u8_native
 
     # --- optimization ----------------------------------------------------
-    optimizer: str = "adam"  # reference: AdamOptimizer, mnist_python_m.py:208
+    # adam (reference: AdamOptimizer, mnist_python_m.py:208; becomes
+    # adamw when weight_decay > 0) | sgd | adafactor (factored second
+    # moments — O(rows+cols) state for the big-model families)
+    optimizer: str = "adam"
     learning_rate: float = 1e-3
     lr_schedule: str = "constant"  # constant | cosine | warmup_cosine
     warmup_steps: int = 0

@@ -19,9 +19,38 @@ import jax
 import jax.numpy as jnp
 
 
+def _filter_logits(logits: jax.Array, top_k: int, top_p: float
+                   ) -> jax.Array:
+    """Mask logits outside the top-k / nucleus (top-p) candidate set.
+
+    Both filters are static-shape TPU-friendly: top-k keeps the k-th
+    value as a threshold (no gather/scatter of dynamic extent); top-p
+    sorts once, finds the smallest prefix with cumulative probability
+    >= p, and thresholds on that boundary logit. Filtered entries go to
+    -inf so ``jax.random.categorical`` never picks them.
+    """
+    if top_k > 0 and top_k < logits.shape[-1]:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # Keep the minimal prefix whose mass reaches p (always >= 1
+        # token: the first prefix that crosses p is included).
+        keep = cum - probs < top_p
+        # Smallest kept logit bounds the nucleus from below.
+        boundary = jnp.min(
+            jnp.where(keep, sorted_logits, jnp.inf), axis=-1,
+            keepdims=True)
+        logits = jnp.where(logits < boundary, -jnp.inf, logits)
+    return logits
+
+
 @functools.lru_cache(maxsize=32)
-def _compiled(model, max_new_tokens: int, temperature: float):
-    """One jitted prefill+decode program per (model, N, temperature).
+def _compiled(model, max_new_tokens: int, temperature: float,
+              top_k: int, top_p: float):
+    """One jitted prefill+decode program per (model, N, sampler knobs).
 
     Cached so repeat generate() calls reuse the compiled executable
     (jit's cache is keyed on the function object — a closure rebuilt
@@ -42,8 +71,9 @@ def _compiled(model, max_new_tokens: int, temperature: float):
             last = logits[:, -1, :]
             if temperature == 0.0:
                 return jnp.argmax(last, axis=-1).astype(jnp.int32)
+            last = _filter_logits(last / temperature, top_k, top_p)
             return jax.random.categorical(
-                key, last / temperature, axis=-1).astype(jnp.int32)
+                key, last, axis=-1).astype(jnp.int32)
 
         def step(carry, _):
             cache, tok, pos, key = carry
@@ -66,7 +96,7 @@ def _compiled(model, max_new_tokens: int, temperature: float):
 
 
 def generate(model, params, prompt: jax.Array, max_new_tokens: int, *,
-             temperature: float = 0.0,
+             temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
              key: Optional[jax.Array] = None) -> jax.Array:
     """Continue ``prompt`` [B, P] by ``max_new_tokens`` greedy
     (temperature 0) or sampled tokens. Returns [B, max_new_tokens].
@@ -74,6 +104,12 @@ def generate(model, params, prompt: jax.Array, max_new_tokens: int, *,
     ``model`` is a causal TransformerLM (models/transformer.py). The
     mesh's seq axis must be 1 (single-token steps can't be
     seq-sharded); batch stays sharded over "data" as usual.
+
+    Sampling knobs (active only with ``temperature > 0``):
+    ``top_k > 0`` restricts to the k highest-logit tokens; ``top_p <
+    1.0`` restricts to the smallest nucleus whose probability mass
+    reaches p (Holtzman et al.); both may be combined (k first, then p
+    over the survivors).
     """
     cfg = model.cfg
     if not cfg.causal:
@@ -84,6 +120,15 @@ def generate(model, params, prompt: jax.Array, max_new_tokens: int, *,
             f"prompt {P} + {max_new_tokens} new > max_len {cfg.max_len}")
     if temperature > 0.0 and key is None:
         raise ValueError("sampling (temperature > 0) needs a PRNG key")
+    if top_k < 0:
+        raise ValueError(f"top_k must be >= 0, got {top_k}")
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     key = key if key is not None else jax.random.key(0)
-    return _compiled(model, max_new_tokens, temperature)(params, prompt,
-                                                         key)
+    if temperature == 0.0:
+        # Greedy ignores the sampler knobs — normalize them so the
+        # compile cache isn't fragmented by values the program never
+        # reads.
+        top_k, top_p = 0, 1.0
+    return _compiled(model, max_new_tokens, temperature, top_k,
+                     float(top_p))(params, prompt, key)

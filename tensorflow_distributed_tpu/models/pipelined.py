@@ -64,8 +64,8 @@ _TP_SUFFIX = [
 
 
 def _tp_names(path, ndim):
-    keys = tuple(str(getattr(k, "key", getattr(k, "idx", k)))
-                 for k in path)
+    from tensorflow_distributed_tpu.parallel.sharding import path_key
+    keys = path_key(path)
     for suffix, names in _TP_SUFFIX:
         if keys[-len(suffix):] == suffix:
             assert len(names) == ndim - 2, (keys, names, ndim)

@@ -32,6 +32,14 @@ from tensorflow_distributed_tpu.parallel.mesh import (
     AXIS_DATA, AXIS_SEQ, process_axis_range, process_batch_role)
 
 
+def path_key(path) -> tuple:
+    """Normalize a jax tree_flatten_with_path path to a tuple of
+    strings, so param paths can be compared across pytrees whose key
+    entry types differ (DictKey vs SequenceKey vs future kinds)."""
+    return tuple(str(getattr(k, "key", getattr(k, "idx", k)))
+                 for k in path)
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     """Fully-replicated sharding (params live on every chip, unlike the
     reference where they lived only on the ps CPU and streamed over TCP

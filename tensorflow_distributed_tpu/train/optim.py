@@ -36,6 +36,14 @@ def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
             core = optax.adam(sched)
     elif cfg.optimizer == "sgd":
         core = optax.sgd(sched, momentum=0.9)
+    elif cfg.optimizer == "adafactor":
+        # Factored second moments: O(rows + cols) optimizer state per
+        # matrix instead of Adam's O(rows * cols) — the classic
+        # TPU-scale choice, and multiplicative with FSDP's 1/data
+        # sharding of whatever state remains.
+        core = optax.adafactor(
+            sched,
+            weight_decay_rate=cfg.weight_decay or None)
     else:
         raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
     if cfg.grad_clip_norm:

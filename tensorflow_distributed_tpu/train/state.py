@@ -20,7 +20,7 @@ from flax import struct
 from jax.sharding import Mesh
 
 from tensorflow_distributed_tpu.parallel.sharding import (
-    FSDP_MIN_SIZE, param_sharding, replicated)
+    FSDP_MIN_SIZE, param_sharding, path_key, replicated)
 from tensorflow_distributed_tpu.utils import prng
 
 # Collections sown per-forward-pass (diagnostics/aux losses), never
@@ -101,15 +101,23 @@ def create_train_state(model: nn.Module, tx: optax.GradientTransformation,
     # keyed matching would collide for same-shape params partitioned
     # differently, e.g. TP in- vs out-projections.)
     abstract_params = nn.meta.unbox(abstract["params"])
+    param_shapes = {
+        path_key(path): leaf.shape
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+            abstract_params)[0]}
     param_path_to_sharding = {
-        tuple(str(getattr(k, "key", getattr(k, "idx", k))) for k in path): sd
+        path_key(path): sd
         for path, sd in jax.tree_util.tree_flatten_with_path(shardings)[0]}
 
     def opt_leaf_sharding(path, leaf):
-        keys = tuple(str(getattr(k, "key", getattr(k, "idx", k)))
-                     for k in path)
+        keys = path_key(path)
         for i in range(len(keys)):
             if keys[i:] in param_path_to_sharding:
+                # Slots that don't MIRROR the param (adafactor's
+                # factored v_row/v_col live at the param's path but
+                # with reduced shape) can't inherit its sharding.
+                if getattr(leaf, "shape", None) != param_shapes[keys[i:]]:
+                    return replicated(mesh)
                 return param_path_to_sharding[keys[i:]]
         return replicated(mesh)
 

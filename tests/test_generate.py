@@ -53,6 +53,42 @@ def test_generate_shapes_and_determinism():
     assert sampled.shape == (1, 8)
 
 
+def test_filter_logits_top_k_and_top_p():
+    from tensorflow_distributed_tpu.models.generate import _filter_logits
+
+    logits = jnp.log(jnp.asarray([[0.5, 0.25, 0.15, 0.07, 0.03]]))
+    # top-k=2 keeps exactly the two largest.
+    k2 = np.asarray(_filter_logits(logits, top_k=2, top_p=1.0))
+    assert np.isfinite(k2[0, :2]).all() and np.isinf(k2[0, 2:]).all()
+    # top-p=0.6: 0.5 alone misses p, 0.5+0.25 crosses it -> keep 2.
+    p6 = np.asarray(_filter_logits(logits, top_k=0, top_p=0.6))
+    assert np.isfinite(p6[0, :2]).all() and np.isinf(p6[0, 2:]).all()
+    # top-p tiny still keeps the argmax (never an empty nucleus).
+    p0 = np.asarray(_filter_logits(logits, top_k=0, top_p=1e-6))
+    assert np.isfinite(p0[0, 0]) and np.isinf(p0[0, 1:]).all()
+    # k=0 / p=1 are no-ops.
+    np.testing.assert_array_equal(
+        np.asarray(_filter_logits(logits, top_k=0, top_p=1.0)),
+        np.asarray(logits))
+
+
+def test_generate_top_k_restricts_support():
+    """With top_k=1, sampling at any temperature IS greedy decoding."""
+    model = _model()
+    prompt = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    params = model.init(jax.random.key(1), prompt)["params"]
+    greedy = generate(model, params, prompt, 8)
+    k1 = generate(model, params, prompt, 8, temperature=1.7, top_k=1,
+                  key=jax.random.key(5))
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(k1))
+    with pytest.raises(ValueError, match="top_p"):
+        generate(model, params, prompt, 4, temperature=1.0, top_p=0.0,
+                 key=jax.random.key(0))
+    with pytest.raises(ValueError, match="top_k"):
+        generate(model, params, prompt, 4, temperature=1.0, top_k=-1,
+                 key=jax.random.key(0))
+
+
 @pytest.mark.slow
 def test_trained_model_continues_pattern(devices8):
     """Train tiny GPT on stride progressions, then generate: the greedy

@@ -72,6 +72,29 @@ def test_loss_decreases_and_step_counts(mesh8):
     assert losses[-1] < losses[0] * 0.6
 
 
+def test_adafactor_optimizer_trains(mesh8):
+    """adafactor (factored second moments — the TPU-scale optimizer)
+    drives the same jitted step; its state shards like params."""
+    from tensorflow_distributed_tpu.config import TrainConfig
+    from tensorflow_distributed_tpu.train.optim import make_optimizer
+
+    tx = make_optimizer(TrainConfig(optimizer="adafactor",
+                                    learning_rate=1e-2))
+    x = jnp.zeros((2, 28, 28, 1), jnp.float32)
+    state = create_train_state(_model(), tx, x, mesh8, seed=0)
+    step = make_train_step(mesh8)
+    batch = shard_batch(mesh8, _batch(64))
+    losses = []
+    for _ in range(8):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0] * 0.8
+    # Factored state is strictly smaller than Adam's 2x param count.
+    opt_elems = sum(x.size for x in jax.tree_util.tree_leaves(
+        state.opt_state) if hasattr(x, "size"))
+    assert opt_elems < param_count(state.params)
+
+
 def test_n_device_equals_1_device(mesh1, mesh8):
     """THE parity test: same global batch stream -> same training
     trajectory on a 1-device mesh and an 8-device mesh."""
