@@ -165,6 +165,11 @@ class TrainConfig:
     checkpoint_every: int = 200
     resume: bool = False
     keep_checkpoints: int = 3
+    # Background-thread serialization/writes (the reference Supervisor's
+    # background saver, mnist_python_m.py:245): the device->host
+    # snapshot stays in-loop, the disk work overlaps training. The
+    # loop flushes the writer (ckpt.wait) before returning.
+    checkpoint_async: bool = False
 
     # --- profiling -------------------------------------------------------
     # Non-empty: the chief captures a jax.profiler trace of steps

@@ -43,6 +43,7 @@ from tensorflow_distributed_tpu.parallel.mesh import (
     AXIS_MODEL, AXIS_PIPE, AXIS_SEQ)
 from tensorflow_distributed_tpu.parallel.pipeline import (
     pipeline_apply, stack_stage_params)
+from tensorflow_distributed_tpu.parallel.sharding import path_key
 
 # Megatron-style TP ("model" axis) names for stacked block leaves, by
 # key-path suffix — the same layout conventions models/transformer.py
@@ -64,7 +65,6 @@ _TP_SUFFIX = [
 
 
 def _tp_names(path, ndim):
-    from tensorflow_distributed_tpu.parallel.sharding import path_key
     keys = path_key(path)
     for suffix, names in _TP_SUFFIX:
         if keys[-len(suffix):] == suffix:

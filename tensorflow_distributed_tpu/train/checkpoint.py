@@ -28,9 +28,11 @@ Design:
 
 from __future__ import annotations
 
+import concurrent.futures
 import json
 import os
 import shutil
+import threading
 from typing import Any, List, Optional
 
 import jax
@@ -116,23 +118,16 @@ def _save_barrier(step: int) -> None:
         multihost_utils.sync_global_devices(f"tfd_ckpt_save_{step}")
 
 
-def save(ckpt_dir: str, state: Any, keep: int = 3) -> str:
-    """Write state at its current step; prune to the newest ``keep``.
+# Single background writer: serializes at most one checkpoint at a
+# time (overlapping saves queue), so tmp dirs and pruning never race.
+_writer_lock = threading.Lock()
+_writer: Optional[concurrent.futures.ThreadPoolExecutor] = None
+_pending: List[concurrent.futures.Future] = []
 
-    Collective under multi-host (every process must call it; only the
-    chief writes bytes): cross-process-partitioned leaves are fetched
-    via an allgather, and all processes barrier on the completed write
-    before returning, so ``latest_step`` is coherent cluster-wide the
-    moment ``save`` returns anywhere."""
-    step = int(jax.device_get(state.step))
+
+def _write(ckpt_dir: str, step: int, host_state: Any, keep: int) -> str:
+    """Serialize + atomically publish one checkpoint (chief only)."""
     final = _step_dir(ckpt_dir, step)
-    # Collective fetch BEFORE the chief gate: cross-process-partitioned
-    # leaves need every process in the allgather. Non-chief processes
-    # run the collectives only; the chief also copies values to host.
-    host_state = _fetch_host(state, values=is_chief())
-    if not is_chief():
-        _save_barrier(step)
-        return final
     os.makedirs(ckpt_dir, exist_ok=True)
     tmp = final + ".tmp"
     if os.path.exists(tmp):
@@ -154,8 +149,89 @@ def save(ckpt_dir: str, state: Any, keep: int = 3) -> str:
     os.rename(tmp, final)
     for old in available_steps(ckpt_dir)[:-keep]:
         shutil.rmtree(_step_dir(ckpt_dir, old), ignore_errors=True)
+    return final
+
+
+def save(ckpt_dir: str, state: Any, keep: int = 3,
+         background: bool = False) -> str:
+    """Write state at its current step; prune to the newest ``keep``.
+
+    Collective under multi-host (every process must call it; only the
+    chief writes bytes): cross-process-partitioned leaves are fetched
+    via an allgather, and all processes barrier on the completed write
+    before returning, so ``latest_step`` is coherent cluster-wide the
+    moment ``save`` returns anywhere.
+
+    ``background=True``: the device->host snapshot still happens here
+    (it must — the state is donated/overwritten by the next step, and
+    its collectives must stay on the main thread), but serialization
+    and the atomic write move to a single writer thread — the
+    reference Supervisor's background saver (mnist_python_m.py:245),
+    TPU-shaped. No per-save barrier is taken; call ``wait()`` (the
+    train loop does, at exit) before relying on ``latest_step``
+    cluster-wide. A crash mid-write loses at most that checkpoint —
+    the previous one is intact because publication is tmp+rename."""
+    step = int(jax.device_get(state.step))
+    final = _step_dir(ckpt_dir, step)
+    # Collective fetch BEFORE the chief gate: cross-process-partitioned
+    # leaves need every process in the allgather. Non-chief processes
+    # run the collectives only; the chief also copies values to host.
+    host_state = _fetch_host(state, values=is_chief())
+    if not is_chief():
+        if not background:
+            _save_barrier(step)
+        return final
+    if background:
+        global _writer
+        with _writer_lock:
+            if _writer is None:
+                _writer = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="tfd-ckpt")
+            prior = [f for f in _pending if not f.done()]
+        # Bound the queue to ONE write in flight (outside the lock —
+        # wait() needs it): every queued entry pins a full host copy
+        # of the state, so an unbounded queue would grow by one model
+        # copy per cadence save whenever the disk is slower than the
+        # cadence. Blocking here degrades async saving to sync pacing
+        # instead of OOMing the chief. Errors stay in the futures for
+        # wait() to re-raise.
+        if prior:
+            concurrent.futures.wait(prior)
+        with _writer_lock:
+            _pending.append(
+                _writer.submit(_write, ckpt_dir, step, host_state, keep))
+        return final
+    _write(ckpt_dir, step, host_state, keep)
     _save_barrier(step)
     return final
+
+
+def wait() -> None:
+    """Block until outstanding background saves land; re-raise the
+    first writer error; barrier so ``latest_step`` is coherent
+    cluster-wide afterwards. No-op when nothing is pending."""
+    with _writer_lock:
+        pending, _pending[:] = _pending[:], []
+    try:
+        first_err = None
+        for fut in pending:
+            try:
+                fut.result()
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                first_err = first_err or e
+        if first_err is not None:
+            raise first_err  # writer exceptions surface in the caller
+    finally:
+        # Barrier in a finally, and unconditionally under multi-host:
+        # non-chief processes never have pending futures, and a chief
+        # that raised must still show up — otherwise the other
+        # processes hang in the barrier until the runtime timeout
+        # instead of seeing a clean failure. Every process must call
+        # wait() (the train loop does).
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("tfd_ckpt_flush")
 
 
 def restore(ckpt_dir: str, state: Any, step: Optional[int] = None) -> Any:

@@ -142,7 +142,8 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
             logger.log(step_now, **{f"val_{k}": v for k, v in em.items()})
         if (cfg.checkpoint_dir and cfg.checkpoint_every
                 and step_now % cfg.checkpoint_every == 0):
-            ckpt.save(cfg.checkpoint_dir, state, cfg.keep_checkpoints)
+            ckpt.save(cfg.checkpoint_dir, state, cfg.keep_checkpoints,
+                      background=cfg.checkpoint_async)
 
     # Warm-up compile outside the timed steady-state span (the
     # reference's timings conflated graph setup with steps; ours don't).
@@ -181,7 +182,15 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
     with Timer() as eval_t:
         final = evaluate(state, eval_fn, task, mesh, cfg.eval_batch_size)
     if cfg.checkpoint_dir:
-        ckpt.save(cfg.checkpoint_dir, state, cfg.keep_checkpoints)
+        # The final save rides the SAME path as cadence saves: under
+        # checkpoint_async a cadence save of this very step may still
+        # sit in the writer queue, and the single writer serializes
+        # them; a synchronous bypass here would race it on the tmp
+        # dir. wait() then flushes the queue and barriers so
+        # latest_step is coherent on return.
+        ckpt.save(cfg.checkpoint_dir, state, cfg.keep_checkpoints,
+                  background=cfg.checkpoint_async)
+        ckpt.wait()
 
     steady_steps = max(cfg.train_steps - start_step - steps_done, 0)
     sps = steady_steps / train_t.elapsed if train_t.elapsed > 0 else 0.0

@@ -50,6 +50,46 @@ def test_roundtrip_bitexact(tmp_path, mesh8):
         jax.device_get(state.opt_state), jax.device_get(restored.opt_state))
 
 
+def test_async_save_matches_sync(tmp_path, mesh8):
+    """background=True produces byte-identical checkpoints; saves
+    queued while training continues don't block or corrupt — the
+    reference Supervisor's background saver behavior."""
+    state = _state(mesh8)
+    step = make_train_step(mesh8, donate=False)
+    state, _ = step(state, shard_batch(mesh8, _batch()))
+
+    sync_dir, async_dir = str(tmp_path / "sync"), str(tmp_path / "async")
+    ckpt.save(sync_dir, state)
+    ckpt.save(async_dir, state, background=True)
+    # Keep training while the writer drains — the snapshot was taken
+    # at submit time, so the write must reflect step 1, not step 2.
+    state2, _ = step(state, shard_batch(mesh8, _batch(seed=1)))
+    ckpt.save(async_dir, state2, background=True)
+    ckpt.wait()
+    assert ckpt.available_steps(async_dir) == [1, 2]
+
+    a = (tmp_path / "sync" / "step_00000001" / "state.msgpack").read_bytes()
+    b = (tmp_path / "async" / "step_00000001" / "state.msgpack").read_bytes()
+    assert a == b
+
+    restored = ckpt.restore(async_dir, _state(mesh8), step=1)
+    jax.tree_util.tree_map(
+        lambda x, y: np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y)),
+        jax.device_get(state.params), jax.device_get(restored.params))
+
+
+def test_async_save_surfaces_writer_errors(tmp_path, mesh8):
+    state = _state(mesh8)
+    bad = str(tmp_path / "file-not-dir")
+    (tmp_path / "file-not-dir").write_text("occupied")
+    ckpt.save(bad, state, background=True)
+    import pytest as _pytest
+    with _pytest.raises(OSError):
+        ckpt.wait()
+    ckpt.wait()  # queue is drained; second wait is a clean no-op
+
+
 def test_resume_continues_identically(tmp_path, mesh8):
     """train 4 steps == train 2, checkpoint, restore, train 2 more."""
     step = make_train_step(mesh8, donate=False)

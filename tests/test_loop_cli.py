@@ -39,6 +39,20 @@ def test_train_resume_roundtrip(tmp_path):
     assert int(jax.device_get(r2.state.step)) == 14
 
 
+def test_train_resume_roundtrip_async_checkpoints(tmp_path):
+    """checkpoint_async=True: cadence saves overlap training, the loop
+    flushes the writer on exit, and resume lands on the same step."""
+    cfg = _cfg(train_steps=10, checkpoint_dir=str(tmp_path),
+               checkpoint_every=5, checkpoint_async=True)
+    train(cfg)
+    from tensorflow_distributed_tpu.train import checkpoint as ckpt
+    assert ckpt.latest_step(str(tmp_path)) == 10  # flushed before return
+    cfg2 = _cfg(train_steps=14, checkpoint_dir=str(tmp_path),
+                checkpoint_every=5, checkpoint_async=True, resume=True)
+    r2 = train(cfg2)
+    assert int(jax.device_get(r2.state.step)) == 14
+
+
 def test_performance_table_emitted():
     result = train(_cfg(train_steps=10, eval_every=5))
     table = result.logger.performance_table(1e-3)
