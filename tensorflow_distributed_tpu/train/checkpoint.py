@@ -198,6 +198,11 @@ def save(ckpt_dir: str, state: Any, keep: int = 3,
         if prior:
             concurrent.futures.wait(prior)
         with _writer_lock:
+            # Prune futures that completed CLEANLY (failed ones must
+            # stay for wait() to re-raise) so _pending doesn't grow by
+            # one entry per cadence save over a long run.
+            _pending[:] = [f for f in _pending
+                           if not f.done() or f.exception() is not None]
             _pending.append(
                 _writer.submit(_write, ckpt_dir, step, host_state, keep))
         return final

@@ -25,6 +25,7 @@ from tensorflow_distributed_tpu.parallel.sharding import (
     process_slice, shard_batch)
 from tensorflow_distributed_tpu.train import checkpoint as ckpt
 from tensorflow_distributed_tpu.train.optim import make_optimizer
+from tensorflow_distributed_tpu.train.preemption import PreemptionGuard
 from tensorflow_distributed_tpu.train.state import (
     TrainState, create_train_state, param_count)
 from tensorflow_distributed_tpu.train.step import make_eval_step, make_train_step
@@ -168,20 +169,43 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
         start_step=cfg.profile_start_step,
         num_steps=cfg.profile_num_steps)
 
-    with Timer() as train_t:
-        for i in range(start_step + steps_done, cfg.train_steps):
-            profiler.observe(i + 1, pending=metrics)
-            state, metrics = step_fn(state, next(it))
-            inflight.append(metrics)
-            if len(inflight) > 2:
-                jax.block_until_ready(inflight.popleft())
-            cadence(i + 1, state, metrics)
-        jax.block_until_ready(state.params)
+    # SIGTERM (preemption notice) -> stop at a coordinated safe step,
+    # fall through to the final durable save below, exit 0 for the
+    # scheduler to restart with --resume. Only armed when there is a
+    # checkpoint dir to save into.
+    guard = PreemptionGuard(enabled=bool(cfg.checkpoint_dir))
+    try:
+        with Timer() as train_t:
+            for i in range(start_step + steps_done, cfg.train_steps):
+                if guard.should_stop(i):
+                    logger.log_json({"event": "preempted", "step": i})
+                    break
+                profiler.observe(i + 1, pending=metrics)
+                state, metrics = step_fn(state, next(it))
+                inflight.append(metrics)
+                if len(inflight) > 2:
+                    jax.block_until_ready(inflight.popleft())
+                cadence(i + 1, state, metrics)
+            jax.block_until_ready(state.params)
+    finally:
+        # Always restore the prior SIGTERM disposition — an exception
+        # escaping the loop must not leave a handler that absorbs
+        # future SIGTERMs into an Event nobody reads.
+        guard.close()
     profiler.stop(pending=metrics)
 
+    preempted = guard.fired is not None
+    if preempted and cfg.checkpoint_dir:
+        # The eviction grace window exists for THIS save: take it
+        # before eval, which on a real validation split could outlive
+        # the grace period and void the whole feature.
+        ckpt.save(cfg.checkpoint_dir, state, cfg.keep_checkpoints,
+                  background=cfg.checkpoint_async)
+        ckpt.wait()
     with Timer() as eval_t:
-        final = evaluate(state, eval_fn, task, mesh, cfg.eval_batch_size)
-    if cfg.checkpoint_dir:
+        final = ({} if preempted else
+                 evaluate(state, eval_fn, task, mesh, cfg.eval_batch_size))
+    if cfg.checkpoint_dir and not preempted:
         # The final save rides the SAME path as cadence saves: under
         # checkpoint_async a cadence save of this very step may still
         # sit in the writer queue, and the single writer serializes
