@@ -156,6 +156,13 @@ class TrainConfig:
     # (mnist_python_m.py:309-320)
     log_every: int = 10  # reference logs loss every 10 steps
     # (mnist_single.py:113-116)
+    # Report the pre-clip global gradient norm as a per-step metric
+    # (one fused on-device reduction; the standard divergence signal).
+    log_grad_norm: bool = False
+    # Raise at the next log point whose loss is NaN/inf instead of
+    # silently training on garbage (checked host-side on the metrics
+    # fetch the logger already does — zero extra device syncs).
+    halt_on_nonfinite: bool = False
 
     # --- checkpoint ------------------------------------------------------
     # Unlike the reference, which checkpoints to a throwaway

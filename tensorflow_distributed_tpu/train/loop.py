@@ -117,11 +117,13 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
         step_fn = make_1f1b_train_step(model, mesh, cfg.seed,
                                        batch_shardings=task.batch_shardings,
                                        moe_aux_weight=cfg.moe_aux_weight,
-                                       moe_zloss_weight=cfg.moe_zloss_weight)
+                                       moe_zloss_weight=cfg.moe_zloss_weight,
+                                       grad_norm_metric=cfg.log_grad_norm)
     else:
         step_fn = make_train_step(mesh, cfg.seed, loss=task.loss,
                                   batch_shardings=task.batch_shardings,
-                                  accum_steps=cfg.grad_accum_steps)
+                                  accum_steps=cfg.grad_accum_steps,
+                                  grad_norm_metric=cfg.log_grad_norm)
     eval_fn = make_eval_step(mesh, loss=task.loss,
                              batch_shardings=task.batch_shardings)
     logger.log_json({
@@ -137,7 +139,15 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
         """Periodic log/eval/checkpoint — applied to EVERY step
         including the warm-up compile step."""
         if cfg.log_every and step_now % cfg.log_every == 0:
-            logger.log(step_now, **jax.device_get(metrics))
+            host_metrics = jax.device_get(metrics)
+            logger.log(step_now, **host_metrics)
+            if cfg.halt_on_nonfinite and not np.isfinite(
+                    float(host_metrics["loss"])):
+                raise FloatingPointError(
+                    f"non-finite loss {host_metrics['loss']} at step "
+                    f"{step_now} (halt_on_nonfinite=true); last durable "
+                    f"checkpoint: "
+                    f"{ckpt.latest_step(cfg.checkpoint_dir) if cfg.checkpoint_dir else None}")
         if cfg.eval_every and step_now % cfg.eval_every == 0:
             em = evaluate(state, eval_fn, task, mesh, cfg.eval_batch_size)
             logger.log(step_now, **{f"val_{k}": v for k, v in em.items()})
@@ -190,9 +200,12 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
     finally:
         # Always restore the prior SIGTERM disposition — an exception
         # escaping the loop must not leave a handler that absorbs
-        # future SIGTERMs into an Event nobody reads.
+        # future SIGTERMs into an Event nobody reads. The profiler
+        # likewise: an open trace window must be finalized even when
+        # the loop raises (halt_on_nonfinite fires mid-cadence — the
+        # diverging run's trace is exactly the one worth keeping).
         guard.close()
-    profiler.stop(pending=metrics)
+        profiler.stop(pending=metrics)
 
     preempted = guard.fired is not None
     if preempted and cfg.checkpoint_dir:
@@ -216,7 +229,11 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
                   background=cfg.checkpoint_async)
         ckpt.wait()
 
-    steady_steps = max(cfg.train_steps - start_step - steps_done, 0)
+    # Steps ACTUALLY executed in the timed span (a preemption break
+    # runs fewer than the configured horizon; reporting the horizon
+    # would inflate throughput).
+    steady_steps = max(
+        int(jax.device_get(state.step)) - start_step - steps_done, 0)
     sps = steady_steps / train_t.elapsed if train_t.elapsed > 0 else 0.0
     result = TrainResult(
         state=state, train_seconds=compile_t.elapsed + train_t.elapsed,

@@ -34,6 +34,7 @@ from typing import Any, Callable, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
+import optax
 from jax.sharding import Mesh
 
 from tensorflow_distributed_tpu.models.pipelined import PipelinedLM
@@ -50,7 +51,8 @@ def make_1f1b_train_step(model: PipelinedLM, mesh: Mesh, seed: int = 0,
                          batch_shardings: Any = None, donate: bool = True,
                          jit: bool = True,
                          moe_aux_weight: float = MOE_AUX_WEIGHT,
-                         moe_zloss_weight: float = 0.0
+                         moe_zloss_weight: float = 0.0,
+                         grad_norm_metric: bool = False
                          ) -> Callable[[TrainState, Any],
                                        Tuple[TrainState, Dict]]:
     """Build the jitted 1F1B step for a PipelinedLM.
@@ -124,6 +126,8 @@ def make_1f1b_train_step(model: PipelinedLM, mesh: Mesh, seed: int = 0,
         metrics = {"loss": ce_sum / total,
                    "accuracy": sums["correct"] / jnp.maximum(
                        sums["mask"], 1.0), **aux_metrics}
+        if grad_norm_metric:
+            metrics["grad_norm"] = optax.global_norm(grads)
         new_state = state.replace(step=state.step + 1, params=new_params,
                                   opt_state=new_opt)
         return new_state, metrics

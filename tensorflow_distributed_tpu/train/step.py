@@ -30,6 +30,7 @@ from typing import Any, Callable, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
+import optax
 from jax.sharding import Mesh
 
 from tensorflow_distributed_tpu.ops.losses import accuracy, softmax_cross_entropy
@@ -87,7 +88,8 @@ def make_train_step(mesh: Mesh, seed: int = 0, donate: bool = True,
                     loss: LossFn = loss_fn,
                     batch_shardings: Any = None,
                     accum_steps: int = 1,
-                    jit: bool = True
+                    jit: bool = True,
+                    grad_norm_metric: bool = False
                     ) -> Callable[[TrainState, Batch],
                                   Tuple[TrainState, Metrics]]:
     """Build the jitted train step for a mesh.
@@ -108,6 +110,12 @@ def make_train_step(mesh: Mesh, seed: int = 0, donate: bool = True,
     must divide the batch; metrics are microbatch means; stat
     collections keep the last microbatch's values, like the last slice
     of one big batch would.
+
+    ``grad_norm_metric``: report the pre-clip global gradient norm as
+    ``metrics["grad_norm"]`` — one fused reduction over leaves XLA
+    already has in registers, the standard divergence/LR-tuning
+    signal. Off by default to keep metric dicts stable for parity
+    tests.
     """
 
     if batch_shardings is None:
@@ -167,6 +175,8 @@ def make_train_step(mesh: Mesh, seed: int = 0, donate: bool = True,
                 micro)
             metrics = jax.tree_util.tree_map(
                 lambda m: jnp.mean(m, axis=0), metrics_stack)
+        if grad_norm_metric:
+            metrics = dict(metrics, grad_norm=optax.global_norm(grads))
         updates, new_opt = state.tx.update(grads, state.opt_state, state.params)
         new_params = jax.tree_util.tree_map(
             lambda p, u: (p + u.astype(p.dtype)), state.params, updates)

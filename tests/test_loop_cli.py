@@ -53,6 +53,42 @@ def test_train_resume_roundtrip_async_checkpoints(tmp_path):
     assert int(jax.device_get(r2.state.step)) == 14
 
 
+def test_grad_norm_metric_opt_in():
+    from tensorflow_distributed_tpu.parallel.mesh import make_mesh
+    from tensorflow_distributed_tpu.config import MeshConfig
+    from tensorflow_distributed_tpu.models.cnn import MnistCNN
+    from tensorflow_distributed_tpu.parallel.sharding import shard_batch
+    from tensorflow_distributed_tpu.train.state import create_train_state
+    from tensorflow_distributed_tpu.train.step import make_train_step
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    mesh = make_mesh(MeshConfig(data=8))
+    model = MnistCNN(dropout_rate=0.0, compute_dtype=jnp.float32)
+    state = create_train_state(model, optax.adam(1e-3),
+                               jnp.zeros((2, 28, 28, 1), jnp.float32), mesh)
+    batch = shard_batch(mesh, (
+        np.random.default_rng(0).normal(size=(32, 28, 28, 1)).astype(
+            np.float32),
+        np.random.default_rng(0).integers(0, 10, size=(32,)).astype(
+            np.int32)))
+    _, m_off = make_train_step(mesh, donate=False)(state, batch)
+    assert "grad_norm" not in m_off  # default dicts stay stable
+    _, m_on = make_train_step(mesh, donate=False,
+                              grad_norm_metric=True)(state, batch)
+    gn = float(m_on["grad_norm"])
+    assert np.isfinite(gn) and gn > 0
+
+
+def test_halt_on_nonfinite_raises():
+    import pytest as _pytest
+    cfg = _cfg(train_steps=20, log_every=1, halt_on_nonfinite=True,
+               learning_rate=1e38)
+    with _pytest.raises(FloatingPointError, match="non-finite"):
+        train(cfg)
+
+
 def test_performance_table_emitted():
     result = train(_cfg(train_steps=10, eval_every=5))
     table = result.logger.performance_table(1e-3)
