@@ -25,7 +25,7 @@ from typing import Optional, Sequence
 
 from tensorflow_distributed_tpu.config import parse_args
 from tensorflow_distributed_tpu.parallel.mesh import is_chief
-from tensorflow_distributed_tpu.train.loop import train
+from tensorflow_distributed_tpu.train.loop import evaluate_only, train
 from tensorflow_distributed_tpu.utils.compilecache import (
     enable_persistent_cache)
 
@@ -33,6 +33,9 @@ from tensorflow_distributed_tpu.utils.compilecache import (
 def main(argv: Optional[Sequence[str]] = None) -> int:
     enable_persistent_cache()
     cfg = parse_args(argv)
+    if cfg.mode == "eval":
+        evaluate_only(cfg)
+        return 0
     result = train(cfg)
     if is_chief():
         # Emit the reference's hand-maintained `performance` table

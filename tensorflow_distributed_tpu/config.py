@@ -189,6 +189,11 @@ class TrainConfig:
 
     # --- misc ------------------------------------------------------------
     seed: int = 0
+    # "eval": restore the latest checkpoint from checkpoint_dir and run
+    # only the validation pass (train.loop.evaluate_only) — the
+    # reference's validation loop without its mandatory training
+    # prelude. "train" (default) is the full loop.
+    mode: str = "train"  # train | eval
 
     def validate(self) -> None:
         if self.batch_size < 1:
@@ -269,6 +274,10 @@ class TrainConfig:
                 f"grad_accum_steps {self.grad_accum_steps}")
         if self.resume and not self.checkpoint_dir:
             raise ValueError("resume=True requires checkpoint_dir")
+        if self.mode not in ("train", "eval"):
+            raise ValueError(f"unknown mode {self.mode!r}")
+        if self.mode == "eval" and not self.checkpoint_dir:
+            raise ValueError("mode=eval requires checkpoint_dir")
         self.mesh.validate()
 
 

@@ -78,14 +78,8 @@ def evaluate(state: TrainState, eval_fn, task: Task, mesh, batch: int
     return {k: v / max(count, 1) for k, v in totals.items()}
 
 
-def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
-          ) -> TrainResult:
-    cfg.validate()
-    bootstrap()
-    logger = logger or MetricLogger(enabled=is_chief())
-    mesh = make_mesh(cfg.mesh)
-    task = make_task(cfg, mesh)
-
+def _build_model_and_state(cfg: TrainConfig, mesh, task):
+    """Shared model/optimizer/state construction for train and eval."""
     size_kw = {"size": cfg.model_size} if cfg.model_size else {}
     if (cfg.remat != "none"
             and cfg.model in ("bert_mlm", "gpt_lm", "moe_lm",
@@ -104,6 +98,45 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
     tx = make_optimizer(cfg)
     state = create_train_state(model, tx, task.sample_input, mesh, cfg.seed,
                                fsdp=cfg.param_partition == "fsdp")
+    return model, state
+
+
+def evaluate_only(cfg: TrainConfig,
+                  logger: Optional[MetricLogger] = None) -> Dict[str, float]:
+    """mode=eval: restore a checkpoint, run the full validation pass,
+    report. The reference could only reach its validation loop by
+    training first (mnist_python_m.py:309-320 is the tail of main());
+    here a saved run is re-validated — or validated on a different
+    mesh shape — without a single training step.
+    """
+    cfg.validate()  # enforces checkpoint_dir for mode="eval"
+    bootstrap()
+    logger = logger or MetricLogger(enabled=is_chief())
+    mesh = make_mesh(cfg.mesh)
+    task = make_task(cfg, mesh)
+    _, state = _build_model_and_state(cfg, mesh, task)
+    state = ckpt.restore(cfg.checkpoint_dir, state)
+    step = int(jax.device_get(state.step))
+    eval_fn = make_eval_step(mesh, loss=task.loss,
+                             batch_shardings=task.batch_shardings)
+    with Timer() as eval_t:
+        metrics = evaluate(state, eval_fn, task, mesh, cfg.eval_batch_size)
+    logger.log_json({
+        "event": "eval", "step": step,
+        "eval_seconds": round(eval_t.elapsed, 3),
+        **{f"val_{k}": round(v, 5) for k, v in metrics.items()},
+    })
+    return metrics
+
+
+def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
+          ) -> TrainResult:
+    cfg.validate()
+    bootstrap()
+    logger = logger or MetricLogger(enabled=is_chief())
+    mesh = make_mesh(cfg.mesh)
+    task = make_task(cfg, mesh)
+    model, state = _build_model_and_state(cfg, mesh, task)
 
     start_step = 0
     if cfg.resume and ckpt.latest_step(cfg.checkpoint_dir) is not None:
@@ -143,6 +176,11 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
             logger.log(step_now, **host_metrics)
             if cfg.halt_on_nonfinite and not np.isfinite(
                     float(host_metrics["loss"])):
+                # Flush queued async saves first so the named resume
+                # point is the TRUE latest (metrics are replicated, so
+                # every process raises here and reaches wait()'s
+                # barrier).
+                ckpt.wait()
                 raise FloatingPointError(
                     f"non-finite loss {host_metrics['loss']} at step "
                     f"{step_now} (halt_on_nonfinite=true); last durable "

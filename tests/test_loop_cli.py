@@ -2,6 +2,7 @@
 reference performed by hand (SURVEY.md §4 "accuracy-as-test")."""
 
 import jax
+import numpy as np
 import pytest
 
 from tensorflow_distributed_tpu.config import MeshConfig, TrainConfig
@@ -53,6 +54,25 @@ def test_train_resume_roundtrip_async_checkpoints(tmp_path):
     assert int(jax.device_get(r2.state.step)) == 14
 
 
+def test_eval_only_mode(tmp_path):
+    """mode=eval restores the checkpoint and reproduces the training
+    run's final validation metrics without a single training step.
+    (Cross-mesh-shape restore itself is pinned in
+    test_checkpoint.test_restore_across_mesh_shapes.)"""
+    from tensorflow_distributed_tpu.train.loop import evaluate_only
+
+    cfg = _cfg(train_steps=10, checkpoint_dir=str(tmp_path),
+               checkpoint_every=0, eval_every=10)
+    r = train(cfg)
+
+    m8 = evaluate_only(_cfg(mode="eval", checkpoint_dir=str(tmp_path)))
+    for k, v in r.final_metrics.items():
+        np.testing.assert_allclose(m8[k], v, rtol=1e-5, atol=1e-6)
+
+    with pytest.raises(ValueError, match="mode=eval"):
+        _cfg(mode="eval").validate()
+
+
 def test_grad_norm_metric_opt_in():
     from tensorflow_distributed_tpu.parallel.mesh import make_mesh
     from tensorflow_distributed_tpu.config import MeshConfig
@@ -82,10 +102,9 @@ def test_grad_norm_metric_opt_in():
 
 
 def test_halt_on_nonfinite_raises():
-    import pytest as _pytest
     cfg = _cfg(train_steps=20, log_every=1, halt_on_nonfinite=True,
                learning_rate=1e38)
-    with _pytest.raises(FloatingPointError, match="non-finite"):
+    with pytest.raises(FloatingPointError, match="non-finite"):
         train(cfg)
 
 
