@@ -78,6 +78,11 @@ class TrainConfig:
     # Transformer-family size preset ("base"/"small"/"tiny"); empty =
     # the family's default. Ignored by models without presets.
     model_size: str = ""
+    # Position encoding for the transformer families: "learned"
+    # (additive table, GPT-2/BERT) or "rope" (rotary — relative
+    # positions, composes with flash/ring attention; not supported by
+    # pipelined_lm). Ignored by the vision models.
+    pos_emb: str = "learned"  # learned | rope
     dropout_rate: float = 0.25  # reference keep_prob 0.75 fed as literal
     # (mnist_python_m.py:292, mnist_single.py:112)
 
@@ -276,6 +281,12 @@ class TrainConfig:
             raise ValueError("resume=True requires checkpoint_dir")
         if self.mode not in ("train", "eval"):
             raise ValueError(f"unknown mode {self.mode!r}")
+        if self.pos_emb not in ("learned", "rope"):
+            raise ValueError(f"unknown pos_emb {self.pos_emb!r}")
+        if self.pos_emb == "rope" and self.model == "pipelined_lm":
+            raise ValueError(
+                "pipelined_lm does not support pos_emb=rope (positions "
+                "are not threaded through the microbatch schedule)")
         if self.mode == "eval" and not self.checkpoint_dir:
             raise ValueError("mode=eval requires checkpoint_dir")
         self.mesh.validate()

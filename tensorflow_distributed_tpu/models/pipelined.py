@@ -282,6 +282,11 @@ def pipelined_lm(mesh: Mesh, size: str = "tiny", causal: bool = True,
     --pipeline-microbatches (config.TrainConfig)."""
     overrides["causal"] = causal
     overrides["tp_partitioning"] = False  # see TransformerConfig notes
+    if overrides.get("pos_emb", "learned") == "rope":
+        # The pipeline's stage_fn runs blocks without threading token
+        # positions through the microbatch schedule; learned positions
+        # enter once at the embedding shell instead.
+        raise ValueError("pipelined_lm does not support pos_emb='rope'")
     # Pallas flash attention works inside the pipe via a nested
     # shard_map (see PipelinedLM.__init__); default on like the rest
     # of the GPT family, opt out with use_flash=False.

@@ -81,6 +81,14 @@ class TransformerConfig:
     # (MeshConfig.expert). moe_lm auto-selects "expert" when the mesh
     # has one.
     moe_expert_axis: str = AXIS_MODEL
+    # Position encoding: "learned" (additive embedding, the GPT-2/BERT
+    # scheme) or "rope" (rotary, applied to q/k per layer — relative
+    # positions, the modern long-context default). RoPE composes with
+    # flash/ring attention unchanged: rotation happens BEFORE the
+    # kernel sees q/k, and it's elementwise along the sequence dim so
+    # seq-sharding partitions it like any other activation op.
+    pos_emb: str = "learned"  # learned | rope
+    rope_theta: float = 10000.0
 
 
 def bert_base_config(**overrides) -> TransformerConfig:
@@ -109,6 +117,30 @@ def _dense_init():
     return nn.initializers.normal(stddev=0.02)  # BERT-style
 
 
+def rope_rotate(x: jax.Array, positions: jax.Array,
+                theta: float = 10000.0) -> jax.Array:
+    """Rotary position embedding (Su et al., RoFormer).
+
+    x: [B, L, H, Dh] (Dh even), positions: [B, L] or [1, L] int.
+    Rotates each (x[2i], x[2i+half]) pair by positions * theta^(-i/half)
+    in f32 (angle precision matters at long context), returning x's
+    dtype. The defining property — attention scores depend only on
+    RELATIVE position — is pinned in tests/test_rope.py.
+    """
+    if x.shape[-1] % 2:
+        raise ValueError(
+            f"rope needs an even head dim, got Dh={x.shape[-1]}")
+    half = x.shape[-1] // 2
+    freqs = theta ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    angles = positions.astype(jnp.float32)[..., None] * freqs  # [B,L,half]
+    cos = jnp.cos(angles)[..., None, :]                        # [B,L,1,half]
+    sin = jnp.sin(angles)[..., None, :]
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1).astype(x.dtype)
+
+
 def _auto_expert_axis(mesh, overrides) -> None:
     """Any MoE config on a mesh with a real dedicated "expert" axis
     defaults to sharding experts over it — otherwise wi/wo would name
@@ -133,7 +165,8 @@ class SelfAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array, *, train: bool = False,
-                 decode: bool = False) -> jax.Array:
+                 decode: bool = False,
+                 positions: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.cfg
         h, dh = cfg.n_heads, cfg.d_model // cfg.n_heads
         qkv = nn.DenseGeneral(
@@ -141,6 +174,14 @@ class SelfAttention(nn.Module):
             kernel_init=_maybe_partitioned(cfg, (None, None, AXIS_MODEL, None)),
             dtype=cfg.compute_dtype, name="qkv")(x)
         q, k, v = (qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :])
+        if cfg.pos_emb == "rope":
+            if positions is None:
+                raise ValueError("pos_emb='rope' needs positions")
+            # Rotate BEFORE caching/dispatch: cached keys are stored
+            # rotated, so decode attends rotated-q against rotated-k
+            # with no per-step re-rotation of the cache.
+            q = rope_rotate(q, positions, cfg.rope_theta)
+            k = rope_rotate(k, positions, cfg.rope_theta)
         if decode:
             # KV-cache incremental decoding: stash k/v at the running
             # index, attend q (the L new tokens) against the whole
@@ -205,12 +246,14 @@ class Block(nn.Module):
     # it static by index — (self, x, train) -> static_argnums=(2,).
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False,
-                 decode: bool = False) -> jax.Array:
+                 decode: bool = False,
+                 positions: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.cfg
         # Pre-LN (trains without warmup games, unlike BERT's post-LN).
         y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
         y = SelfAttention(cfg, self.mesh, name="attn")(
-            y.astype(cfg.compute_dtype), train=train, decode=decode)
+            y.astype(cfg.compute_dtype), train=train, decode=decode,
+            positions=positions)
         y = nn.Dropout(cfg.dropout_rate, deterministic=not train)(y)
         x = x + y
         y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
@@ -245,6 +288,9 @@ class TransformerLM(nn.Module):
                  decode: bool = False,
                  positions: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.cfg
+        if cfg.pos_emb not in ("learned", "rope"):
+            raise ValueError(f"pos_emb {cfg.pos_emb!r}; "
+                             f"have ('learned', 'rope')")
         B, L = tokens.shape
         emb = nn.Embed(cfg.vocab_size + self.extra_vocab, cfg.d_model,
                        embedding_init=_dense_init(), name="tok_emb")
@@ -256,10 +302,13 @@ class TransformerLM(nn.Module):
                 # silently wrong logits. Make the caller say where.
                 raise ValueError("decode=True requires positions")
             positions = jnp.arange(L)[None, :]
-        pos = nn.Embed(cfg.max_len, cfg.d_model,
-                       embedding_init=_dense_init(), name="pos_emb")(
-            positions)
-        x = (x + pos).astype(cfg.compute_dtype)
+        if cfg.pos_emb == "learned":
+            pos = nn.Embed(cfg.max_len, cfg.d_model,
+                           embedding_init=_dense_init(), name="pos_emb")(
+                positions)
+            x = (x + pos).astype(cfg.compute_dtype)
+        else:  # rope: no additive embedding; q/k rotate per layer
+            x = x.astype(cfg.compute_dtype)
         if self.mesh is not None:
             # Pin activation layout: batch over "data", seq over "seq".
             x = jax.lax.with_sharding_constraint(
@@ -275,7 +324,8 @@ class TransformerLM(nn.Module):
             block = nn.remat(Block, static_argnums=(2, 3),
                              policy=resolve_remat_policy(cfg.remat_policy))
         for i in range(cfg.n_layers):
-            x = block(cfg, self.mesh, name=f"layer_{i}")(x, train, decode)
+            x = block(cfg, self.mesh, name=f"layer_{i}")(x, train, decode,
+                                                         positions)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         logits = nn.Dense(cfg.vocab_size,
                           kernel_init=_maybe_partitioned(cfg, (None, AXIS_MODEL)),

@@ -1,0 +1,125 @@
+"""Rotary position embeddings: the defining relative-position property,
+cache-decode parity, seq-sharded parity, and end-to-end training."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorflow_distributed_tpu.config import MeshConfig
+from tensorflow_distributed_tpu.models.transformer import (
+    CausalLM, rope_rotate, tiny_config)
+from tensorflow_distributed_tpu.parallel.mesh import make_mesh
+
+
+def test_rope_scores_depend_on_relative_position_only():
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(1, 1, 2, 16)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(1, 1, 2, 16)), jnp.float32)
+
+    def score(qpos, kpos):
+        qr = rope_rotate(q, jnp.asarray([[qpos]]))
+        kr = rope_rotate(k, jnp.asarray([[kpos]]))
+        return jnp.einsum("blhd,bmhd->bhlm", qr, kr)
+
+    np.testing.assert_allclose(score(7, 3), score(107, 103),
+                               rtol=1e-4, atol=1e-5)
+    # ...and DOES change when the relative offset changes.
+    assert not np.allclose(score(7, 3), score(7, 5), atol=1e-3)
+    # Position 0 is the identity rotation.
+    np.testing.assert_array_equal(
+        np.asarray(rope_rotate(q, jnp.asarray([[0]]))), np.asarray(q))
+
+
+def _model(**overrides):
+    return CausalLM(tiny_config(causal=True, pos_emb="rope",
+                                compute_dtype=jnp.float32, **overrides))
+
+
+def test_rope_decode_matches_full_forward():
+    """Teacher-forced cache decode reproduces the full causal forward —
+    cached keys are stored rotated, so no re-rotation per step."""
+    model = _model()
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 64, size=(2, 12)), jnp.int32)
+    params = model.init(jax.random.key(0), tokens)["params"]
+    assert "pos_emb" not in params  # no additive table under rope
+    full = model.apply({"params": params}, tokens)
+
+    logits5, state = model.apply({"params": params}, tokens[:, :5],
+                                 decode=True,
+                                 positions=jnp.arange(5)[None, :],
+                                 mutable=["cache"])
+    np.testing.assert_allclose(logits5, full[:, :5], atol=1e-4, rtol=1e-3)
+    cache = state["cache"]
+    for t in range(5, 12):
+        step_logits, state = model.apply(
+            {"params": params, "cache": cache}, tokens[:, t:t + 1],
+            decode=True, positions=jnp.full((1, 1), t), mutable=["cache"])
+        cache = state["cache"]
+        np.testing.assert_allclose(step_logits[:, 0], full[:, t],
+                                   atol=1e-4, rtol=1e-3,
+                                   err_msg=f"position {t}")
+
+
+def test_rope_seq_sharded_matches_unsharded(devices8):
+    """RoPE under ring attention: the rotation is elementwise along the
+    seq dim, so a seq=8 mesh forward equals the unsharded forward."""
+    from tensorflow_distributed_tpu.parallel.sharding import shard_batch
+
+    mesh = make_mesh(MeshConfig(data=1, seq=8), devices8)
+    model_m = CausalLM(tiny_config(causal=True, pos_emb="rope",
+                                   compute_dtype=jnp.float32), mesh)
+    tokens = np.random.default_rng(1).integers(
+        0, 64, size=(2, 64)).astype(np.int32)
+    params = model_m.init(jax.random.key(0), jnp.asarray(tokens))["params"]
+    with mesh:
+        sharded = jax.jit(
+            lambda p, t: model_m.apply({"params": p}, t))(
+                params, shard_batch(mesh, tokens, seq_axis=1))
+    oracle = _model().apply({"params": params}, jnp.asarray(tokens))
+    np.testing.assert_allclose(np.asarray(sharded), np.asarray(oracle),
+                               atol=2e-4, rtol=1e-3)
+
+
+def test_rope_trains_and_generates(devices8):
+    from tensorflow_distributed_tpu.models.generate import generate
+    from tensorflow_distributed_tpu.parallel.mesh import single_device_mesh
+
+    model = _model(max_len=32)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 64, size=(4, 16)), jnp.int32)
+    params = model.init(jax.random.key(0), tokens)["params"]
+    out = generate(model, params, jnp.asarray([[1, 2, 3]], jnp.int32), 6)
+    assert out.shape == (1, 6)
+
+    # One train step via the standard machinery stays finite.
+    import optax
+    from tensorflow_distributed_tpu.data.lm import LmBatcher, synthetic_clm
+    from tensorflow_distributed_tpu.parallel.sharding import shard_batch
+    from tensorflow_distributed_tpu.train.state import create_train_state
+    from tensorflow_distributed_tpu.train.step import make_train_step
+    from tensorflow_distributed_tpu.train.tasks import (
+        mlm_batch_shardings, mlm_loss)
+
+    mesh = make_mesh(MeshConfig(data=8), devices8)
+    model_m = CausalLM(tiny_config(causal=True, pos_emb="rope",
+                                   compute_dtype=jnp.float32), mesh)
+    state = create_train_state(model_m, optax.adam(1e-3),
+                               np.zeros((2, 16), np.int32), mesh)
+    step = make_train_step(mesh, loss=mlm_loss,
+                           batch_shardings=mlm_batch_shardings(mesh),
+                           donate=False)
+    ds = synthetic_clm(n=64, seq_len=16, vocab_size=64, seed=0)
+    batch = shard_batch(mesh, next(LmBatcher(ds, 16, 0).forever(0)),
+                        seq_axis=1)
+    _, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_pipelined_rejects_rope():
+    from tensorflow_distributed_tpu.models.pipelined import pipelined_lm
+
+    mesh = make_mesh(MeshConfig(data=8))
+    with pytest.raises(ValueError, match="rope"):
+        pipelined_lm(mesh, pos_emb="rope")
