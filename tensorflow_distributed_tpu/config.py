@@ -83,6 +83,9 @@ class TrainConfig:
     # positions, composes with flash/ring attention; not supported by
     # pipelined_lm). Ignored by the vision models.
     pos_emb: str = "learned"  # learned | rope
+    # Share the input embedding as the LM output projection (GPT-2
+    # style weight tying). Transformer families only.
+    tie_embeddings: bool = False
     dropout_rate: float = 0.25  # reference keep_prob 0.75 fed as literal
     # (mnist_python_m.py:292, mnist_single.py:112)
 
@@ -287,6 +290,11 @@ class TrainConfig:
             raise ValueError(
                 "pipelined_lm does not support pos_emb=rope (positions "
                 "are not threaded through the microbatch schedule)")
+        if self.tie_embeddings and self.model == "pipelined_lm":
+            raise ValueError(
+                "pipelined_lm does not support tie_embeddings (the "
+                "embedding shell and head are separate pipeline-stage "
+                "params)")
         if self.mode == "eval" and not self.checkpoint_dir:
             raise ValueError("mode=eval requires checkpoint_dir")
         self.mesh.validate()

@@ -287,6 +287,11 @@ def pipelined_lm(mesh: Mesh, size: str = "tiny", causal: bool = True,
         # positions through the microbatch schedule; learned positions
         # enter once at the embedding shell instead.
         raise ValueError("pipelined_lm does not support pos_emb='rope'")
+    if overrides.get("tie_embeddings", False):
+        # The embedding shell and lm_head are separate stage-owned
+        # params here; silently building an untied model would betray
+        # the flag.
+        raise ValueError("pipelined_lm does not support tie_embeddings")
     # Pallas flash attention works inside the pipe via a nested
     # shard_map (see PipelinedLM.__init__); default on like the rest
     # of the GPT family, opt out with use_flash=False.

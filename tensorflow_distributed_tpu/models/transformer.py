@@ -89,6 +89,11 @@ class TransformerConfig:
     # seq-sharding partitions it like any other activation op.
     pos_emb: str = "learned"  # learned | rope
     rope_theta: float = 10000.0
+    # Share the input embedding as the output projection (GPT-2 ties
+    # them): logits = x @ tok_emb.T via nn.Embed.attend. Saves a
+    # [d_model, vocab] matrix and its optimizer slots; the [MASK]
+    # sentinel row (extra_vocab) is sliced off the logits.
+    tie_embeddings: bool = False
 
 
 def bert_base_config(**overrides) -> TransformerConfig:
@@ -327,10 +332,22 @@ class TransformerLM(nn.Module):
             x = block(cfg, self.mesh, name=f"layer_{i}")(x, train, decode,
                                                          positions)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
-        logits = nn.Dense(cfg.vocab_size,
-                          kernel_init=_maybe_partitioned(cfg, (None, AXIS_MODEL)),
-                          dtype=cfg.compute_dtype, name="lm_head")(
-            x.astype(cfg.compute_dtype))
+        if cfg.tie_embeddings:
+            # Cast the shared table to compute dtype so the logits
+            # matmul (the model's largest) stays on the bf16 MXU path
+            # like the untied head. Tied logits are computed
+            # replicated — the table is replicated by design here
+            # (vocab-sharding is a config knob, module docstring).
+            table = emb.embedding.astype(cfg.compute_dtype)
+            logits = jnp.einsum("...d,vd->...v",
+                                x.astype(cfg.compute_dtype), table)
+            logits = logits[..., :cfg.vocab_size]  # drop sentinel rows
+        else:
+            logits = nn.Dense(
+                cfg.vocab_size,
+                kernel_init=_maybe_partitioned(cfg, (None, AXIS_MODEL)),
+                dtype=cfg.compute_dtype, name="lm_head")(
+                x.astype(cfg.compute_dtype))
         return logits.astype(jnp.float32)
 
 

@@ -87,9 +87,13 @@ def _build_model_and_state(cfg: TrainConfig, mesh, task):
         size_kw.update(remat=True, remat_policy=cfg.remat)
     if cfg.moe_experts > 0:  # validated: transformer families only
         size_kw["moe_experts"] = cfg.moe_experts
-    if (cfg.pos_emb != "learned"
-            and cfg.model in ("bert_mlm", "gpt_lm", "moe_lm")):
-        size_kw["pos_emb"] = cfg.pos_emb
+    if cfg.model in ("bert_mlm", "gpt_lm", "moe_lm"):
+        # Non-pipelined transformer knobs (pipelined_lm rejects both
+        # in config.validate and its factory).
+        if cfg.pos_emb != "learned":
+            size_kw["pos_emb"] = cfg.pos_emb
+        if cfg.tie_embeddings:
+            size_kw["tie_embeddings"] = cfg.tie_embeddings
     if cfg.model == "pipelined_lm":
         size_kw["num_microbatches"] = cfg.pipeline_microbatches
     model = build_model(

@@ -123,3 +123,5 @@ def test_pipelined_rejects_rope():
     mesh = make_mesh(MeshConfig(data=8))
     with pytest.raises(ValueError, match="rope"):
         pipelined_lm(mesh, pos_emb="rope")
+    with pytest.raises(ValueError, match="tie_embeddings"):
+        pipelined_lm(mesh, tie_embeddings=True)

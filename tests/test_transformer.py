@@ -33,6 +33,31 @@ def test_forward_shape_no_mesh():
     assert logits.dtype == jnp.float32
 
 
+def test_tied_embeddings():
+    """tie_embeddings drops lm_head, shares tok_emb as the output
+    projection, and still produces vocab-sized logits (sentinel rows
+    sliced off for the MLM family's [MASK])."""
+    tied = bert_tiny_mlm(tie_embeddings=True)
+    toks = _tokens()
+    var_t = tied.init(jax.random.key(0), toks)
+    assert "lm_head" not in var_t["params"]
+    out = tied.apply(var_t, toks)
+    assert out.shape == (*toks.shape, 64)  # vocab only, no [MASK] row
+
+    untied = bert_tiny_mlm()
+    var_u = untied.init(jax.random.key(0), toks)
+    n_tied = sum(x.size for x in jax.tree_util.tree_leaves(var_t["params"]))
+    n_untied = sum(x.size for x in
+                   jax.tree_util.tree_leaves(var_u["params"]))
+    assert n_untied - n_tied == 32 * 64 + 64  # lm_head kernel + bias
+
+    # Gradients flow into the shared table from BOTH uses.
+    def loss(p):
+        return jnp.sum(tied.apply({"params": p}, toks) ** 2)
+    g = jax.grad(loss)(var_t["params"])
+    assert float(jnp.abs(g["tok_emb"]["embedding"]).sum()) > 0
+
+
 def test_partition_metadata_present():
     model = bert_tiny_mlm()
     toks = jnp.asarray(_tokens(b=2))
