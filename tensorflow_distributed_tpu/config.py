@@ -86,6 +86,10 @@ class TrainConfig:
     # Share the input embedding as the LM output projection (GPT-2
     # style weight tying). Transformer families only.
     tie_embeddings: bool = False
+    # Grouped-query attention: K/V head count (0 = same as n_heads,
+    # standard MHA; 1 = MQA). Shrinks the decode KV cache by
+    # n_heads/n_kv_heads. Transformer families only.
+    n_kv_heads: int = 0
     dropout_rate: float = 0.25  # reference keep_prob 0.75 fed as literal
     # (mnist_python_m.py:292, mnist_single.py:112)
 
@@ -295,6 +299,9 @@ class TrainConfig:
                 "pipelined_lm does not support tie_embeddings (the "
                 "embedding shell and head are separate pipeline-stage "
                 "params)")
+        if self.n_kv_heads < 0:
+            raise ValueError(
+                f"n_kv_heads must be >= 0, got {self.n_kv_heads}")
         if self.mode == "eval" and not self.checkpoint_dir:
             raise ValueError("mode=eval requires checkpoint_dir")
         self.mesh.validate()

@@ -94,6 +94,14 @@ class TransformerConfig:
     # [d_model, vocab] matrix and its optimizer slots; the [MASK]
     # sentinel row (extra_vocab) is sliced off the logits.
     tie_embeddings: bool = False
+    # Grouped-query attention: number of K/V heads (None = n_heads,
+    # standard MHA; 1 = MQA). Q keeps n_heads; K/V project to
+    # n_kv_heads and broadcast to the query heads right before each
+    # attend, so the flash/ring kernels and the XLA oracle are
+    # untouched — what shrinks is the KV projection params and,
+    # crucially, the decode cache: [B, max_len, n_kv, Dh] instead of
+    # [B, max_len, H, Dh] (the decode-bandwidth win GQA exists for).
+    n_kv_heads: Optional[int] = None
 
 
 def bert_base_config(**overrides) -> TransformerConfig:
@@ -174,11 +182,38 @@ class SelfAttention(nn.Module):
                  positions: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.cfg
         h, dh = cfg.n_heads, cfg.d_model // cfg.n_heads
-        qkv = nn.DenseGeneral(
-            features=(3, h, dh), axis=-1, use_bias=True,
-            kernel_init=_maybe_partitioned(cfg, (None, None, AXIS_MODEL, None)),
-            dtype=cfg.compute_dtype, name="qkv")(x)
-        q, k, v = (qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :])
+        # None AND 0 both mean MHA (TrainConfig uses 0 as its sentinel).
+        nk = cfg.n_kv_heads or h
+        if h % nk:
+            raise ValueError(
+                f"n_heads {h} not divisible by n_kv_heads {nk}")
+        if nk == h:
+            # Standard MHA: one fused projection (param tree unchanged
+            # from before GQA existed — checkpoints stay loadable).
+            qkv = nn.DenseGeneral(
+                features=(3, h, dh), axis=-1, use_bias=True,
+                kernel_init=_maybe_partitioned(
+                    cfg, (None, None, AXIS_MODEL, None)),
+                dtype=cfg.compute_dtype, name="qkv")(x)
+            q, k, v = (qkv[..., 0, :, :], qkv[..., 1, :, :],
+                       qkv[..., 2, :, :])
+        else:
+            q = nn.DenseGeneral(
+                features=(h, dh), axis=-1, use_bias=True,
+                kernel_init=_maybe_partitioned(cfg, (None, AXIS_MODEL, None)),
+                dtype=cfg.compute_dtype, name="q")(x)
+            # K/V kernels stay replicated: nk is typically smaller than
+            # the TP axis, and the tensors are small by construction.
+            kv = nn.DenseGeneral(
+                features=(2, nk, dh), axis=-1, use_bias=True,
+                kernel_init=_dense_init(),
+                dtype=cfg.compute_dtype, name="kv")(x)
+            k, v = kv[..., 0, :, :], kv[..., 1, :, :]
+
+        def widen(t):
+            """[B, L, nk, Dh] -> [B, L, H, Dh] for the attend."""
+            return (t if nk == h else
+                    jnp.repeat(t, h // nk, axis=2))
         if cfg.pos_emb == "rope":
             if positions is None:
                 raise ValueError("pos_emb='rope' needs positions")
@@ -198,9 +233,9 @@ class SelfAttention(nn.Module):
             from tensorflow_distributed_tpu.parallel.ring_attention import (
                 _MASK, full_attention)
             ck = self.variable("cache", "key", jnp.zeros,
-                               (B, cfg.max_len, h, dh), k.dtype)
+                               (B, cfg.max_len, nk, dh), k.dtype)
             cv = self.variable("cache", "value", jnp.zeros,
-                               (B, cfg.max_len, h, dh), v.dtype)
+                               (B, cfg.max_len, nk, dh), v.dtype)
             ci = self.variable("cache", "index",
                                lambda: jnp.zeros((), jnp.int32))
             idx = ci.value
@@ -212,14 +247,32 @@ class SelfAttention(nn.Module):
             rows = jnp.arange(L)[:, None]              # new-token offsets
             cols = jnp.arange(cfg.max_len)[None, :]
             bias = jnp.where(cols <= idx + rows, 0.0, _MASK)[None]
-            out = full_attention(q, ck.value, cv.value, bias)
+            if nk == h:
+                out = full_attention(q, ck.value, cv.value, bias)
+            else:
+                # Grouped attend against the NARROW cache — widening
+                # it would re-materialize [B, max_len, H, Dh] every
+                # step and forfeit the decode-bandwidth win GQA
+                # exists for. Rows are never fully masked (col 0 is
+                # always visible), so plain softmax is safe.
+                g = h // nk
+                qg = q.reshape(B, L, nk, g, dh).astype(jnp.float32)
+                s = jnp.einsum("bqngd,bknd->bngqk", qg,
+                               ck.value.astype(jnp.float32))
+                s = s / jnp.sqrt(jnp.asarray(dh, jnp.float32))
+                s = s + bias[:, None, None]
+                p = jax.nn.softmax(s, axis=-1)
+                o = jnp.einsum("bngqk,bknd->bqngd", p,
+                               cv.value.astype(jnp.float32))
+                out = o.reshape(B, L, h, dh).astype(q.dtype)
         elif self.mesh is not None and self.mesh.shape[AXIS_SEQ] > 1:
-            out = ring_attention(q, k, v, self.mesh, causal=cfg.causal)
+            out = ring_attention(q, widen(k), widen(v), self.mesh,
+                                 causal=cfg.causal)
         else:
             # Pallas flash kernel on TPU (shard_mapped over dp x tp when
             # the mesh is partitioned), XLA oracle elsewhere.
-            out = attention(q, k, v, causal=cfg.causal, mesh=self.mesh,
-                            allow_flash=cfg.use_flash)
+            out = attention(q, widen(k), widen(v), causal=cfg.causal,
+                            mesh=self.mesh, allow_flash=cfg.use_flash)
         out = nn.DenseGeneral(
             features=cfg.d_model, axis=(-2, -1), use_bias=True,
             kernel_init=_maybe_partitioned(cfg, (AXIS_MODEL, None, None)),
