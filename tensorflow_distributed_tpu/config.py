@@ -90,6 +90,12 @@ class TrainConfig:
     # standard MHA; 1 = MQA). Shrinks the decode KV cache by
     # n_heads/n_kv_heads. Transformer families only.
     n_kv_heads: int = 0
+    # MLP nonlinearity for the transformer families: "gelu" (GPT-2/
+    # BERT) or "swiglu" (gated, Llama-style).
+    mlp_variant: str = "gelu"  # gelu | swiglu
+    # Block normalization: "layernorm" or "rmsnorm" (scale-only,
+    # Llama-style). Transformer families only.
+    norm: str = "layernorm"  # layernorm | rmsnorm
     dropout_rate: float = 0.25  # reference keep_prob 0.75 fed as literal
     # (mnist_python_m.py:292, mnist_single.py:112)
 
@@ -302,6 +308,16 @@ class TrainConfig:
         if self.n_kv_heads < 0:
             raise ValueError(
                 f"n_kv_heads must be >= 0, got {self.n_kv_heads}")
+        if self.mlp_variant not in ("gelu", "swiglu"):
+            raise ValueError(f"unknown mlp_variant {self.mlp_variant!r}")
+        if (self.mlp_variant != "gelu"
+                and (self.moe_experts > 0 or self.model == "moe_lm")):
+            raise ValueError(
+                "mlp_variant has no effect with MoE (the block's MLP is "
+                "replaced by MoeMlp, whose experts are gelu); drop the "
+                "flag or use a dense family")
+        if self.norm not in ("layernorm", "rmsnorm"):
+            raise ValueError(f"unknown norm {self.norm!r}")
         if self.mode == "eval" and not self.checkpoint_dir:
             raise ValueError("mode=eval requires checkpoint_dir")
         self.mesh.validate()

@@ -37,7 +37,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh
 
 from tensorflow_distributed_tpu.models.transformer import (
-    Block, TransformerConfig, _dense_init, resolve_remat_policy,
+    Block, TransformerConfig, _dense_init, _norm, resolve_remat_policy,
     tiny_config)
 from tensorflow_distributed_tpu.parallel.mesh import (
     AXIS_MODEL, AXIS_PIPE, AXIS_SEQ)
@@ -56,6 +56,8 @@ _TP_SUFFIX = [
     (("attn", "out", "kernel"), (AXIS_MODEL, None, None)),
     (("mlp", "up", "kernel"), (None, AXIS_MODEL)),
     (("mlp", "up", "bias"), (AXIS_MODEL,)),
+    (("mlp", "gate", "kernel"), (None, AXIS_MODEL)),  # swiglu
+    (("mlp", "gate", "bias"), (AXIS_MODEL,)),
     (("mlp", "down", "kernel"), (AXIS_MODEL, None)),
     # MoE expert weights: expert-parallel over the same axis
     # (models/moe.py's default expert_axis).
@@ -87,7 +89,7 @@ class _Shell(nn.Module):
         self.pos_emb = nn.Embed(cfg.max_len, cfg.d_model,
                                 embedding_init=_dense_init(),
                                 name="pos_emb")
-        self.ln_f = nn.LayerNorm(dtype=jnp.float32, name="ln_f")
+        self.ln_f = _norm(cfg, "ln_f")
         self.lm_head = nn.Dense(cfg.vocab_size,
                                 kernel_init=_dense_init(),
                                 dtype=cfg.compute_dtype, name="lm_head")

@@ -102,6 +102,13 @@ class TransformerConfig:
     # crucially, the decode cache: [B, max_len, n_kv, Dh] instead of
     # [B, max_len, H, Dh] (the decode-bandwidth win GQA exists for).
     n_kv_heads: Optional[int] = None
+    # MLP nonlinearity: "gelu" (GPT-2/BERT two-matrix MLP) or "swiglu"
+    # (gated: silu(gate(x)) * up(x) -> down; the Llama-family MLP).
+    mlp_variant: str = "gelu"  # gelu | swiglu
+    # Block normalization: "layernorm" (mean+variance, bias+scale) or
+    # "rmsnorm" (scale-only, no mean subtraction — cheaper and the
+    # modern default). Both run in f32.
+    norm: str = "layernorm"  # layernorm | rmsnorm
 
 
 def bert_base_config(**overrides) -> TransformerConfig:
@@ -280,16 +287,34 @@ class SelfAttention(nn.Module):
         return out
 
 
+def _norm(cfg, name: str):
+    """Block normalization module per cfg.norm, f32 either way."""
+    if cfg.norm == "rmsnorm":
+        return nn.RMSNorm(dtype=jnp.float32, name=name)
+    if cfg.norm == "layernorm":
+        return nn.LayerNorm(dtype=jnp.float32, name=name)
+    raise ValueError(f"norm {cfg.norm!r}; have ('layernorm', 'rmsnorm')")
+
+
 class Mlp(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         cfg = self.cfg
-        x = nn.Dense(cfg.d_ff,
-                     kernel_init=_maybe_partitioned(cfg, (None, AXIS_MODEL)),
-                     dtype=cfg.compute_dtype, name="up")(x)
-        x = nn.gelu(x)
+        def proj(name):
+            return nn.Dense(
+                cfg.d_ff,
+                kernel_init=_maybe_partitioned(cfg, (None, AXIS_MODEL)),
+                dtype=cfg.compute_dtype, name=name)
+
+        if cfg.mlp_variant == "swiglu":
+            x = nn.silu(proj("gate")(x)) * proj("up")(x)
+        elif cfg.mlp_variant == "gelu":
+            x = nn.gelu(proj("up")(x))
+        else:
+            raise ValueError(f"mlp_variant {cfg.mlp_variant!r}; "
+                             f"have ('gelu', 'swiglu')")
         x = nn.Dense(cfg.d_model,
                      kernel_init=_maybe_partitioned(cfg, (AXIS_MODEL, None)),
                      dtype=cfg.compute_dtype, name="down")(x)
@@ -308,14 +333,18 @@ class Block(nn.Module):
                  positions: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.cfg
         # Pre-LN (trains without warmup games, unlike BERT's post-LN).
-        y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        y = _norm(cfg, "ln1")(x)
         y = SelfAttention(cfg, self.mesh, name="attn")(
             y.astype(cfg.compute_dtype), train=train, decode=decode,
             positions=positions)
         y = nn.Dropout(cfg.dropout_rate, deterministic=not train)(y)
         x = x + y
-        y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        y = _norm(cfg, "ln2")(x)
         if cfg.moe_experts > 0:
+            if cfg.mlp_variant != "gelu":
+                raise ValueError(
+                    "mlp_variant has no effect with moe_experts > 0 "
+                    "(MoeMlp replaces the block MLP)")
             from tensorflow_distributed_tpu.models.moe import MoeMlp
             y = MoeMlp(d_model=cfg.d_model, d_ff=cfg.d_ff,
                        num_experts=cfg.moe_experts, top_k=cfg.moe_top_k,
@@ -384,7 +413,7 @@ class TransformerLM(nn.Module):
         for i in range(cfg.n_layers):
             x = block(cfg, self.mesh, name=f"layer_{i}")(x, train, decode,
                                                          positions)
-        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        x = _norm(cfg, "ln_f")(x)
         if cfg.tie_embeddings:
             # Cast the shared table to compute dtype so the logits
             # matmul (the model's largest) stays on the bf16 MXU path

@@ -94,11 +94,15 @@ def _build_model_and_state(cfg: TrainConfig, mesh, task):
             size_kw["pos_emb"] = cfg.pos_emb
         if cfg.tie_embeddings:
             size_kw["tie_embeddings"] = cfg.tie_embeddings
-    if cfg.n_kv_heads and cfg.model in ("bert_mlm", "gpt_lm", "moe_lm",
-                                        "pipelined_lm"):
-        # GQA lives entirely inside SelfAttention (no positions to
-        # thread), so the pipelined family supports it too.
-        size_kw["n_kv_heads"] = cfg.n_kv_heads
+    if cfg.model in ("bert_mlm", "gpt_lm", "moe_lm", "pipelined_lm"):
+        # Block-level knobs live inside SelfAttention/Mlp/Block, which
+        # the pipelined family shares — no positions to thread.
+        if cfg.n_kv_heads:
+            size_kw["n_kv_heads"] = cfg.n_kv_heads
+        if cfg.mlp_variant != "gelu":
+            size_kw["mlp_variant"] = cfg.mlp_variant
+        if cfg.norm != "layernorm":
+            size_kw["norm"] = cfg.norm
     if cfg.model == "pipelined_lm":
         size_kw["num_microbatches"] = cfg.pipeline_microbatches
     model = build_model(
