@@ -101,9 +101,11 @@ class TrainConfig:
 
     # --- data ------------------------------------------------------------
     # mnist | synthetic | cifar10 | cifar10_synthetic | imagenet_synthetic
-    # (see data.load_dataset dispatch). Ignored by the LM families
-    # (bert_mlm/gpt_lm/moe_lm/pipelined_lm), whose synthetic token data
-    # is selected by model family in train.tasks.make_task.
+    # (see data.load_dataset dispatch). The LM families
+    # (bert_mlm/gpt_lm/moe_lm/pipelined_lm) default to synthetic token
+    # data regardless of this field, EXCEPT dataset="text": byte-level
+    # causal LM over the local file named by --data-dir (vocab = the
+    # 256 byte values; no tokenizer, no egress).
     dataset: str = "mnist"
     data_dir: str = "/tmp/mnist-data"  # reference default, mnist_python_m.py:50
     # Global batch. Reference: 128 per worker x 2 workers = 256 global

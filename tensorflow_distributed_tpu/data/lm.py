@@ -27,15 +27,22 @@ from tensorflow_distributed_tpu.data.batcher import Batcher
 class LmDataset:
     tokens: np.ndarray    # [N, L] inputs with masks applied
     targets: np.ndarray   # [N, L] original ids
-    mask: np.ndarray      # [N, L] float {0,1}
+    # [N, L] float {0,1}; None = all-ones, synthesized per batch (the
+    # CLM case — storing a corpus-sized constant would waste 4 bytes
+    # per token of host RAM).
+    mask: "np.ndarray | None"
     vocab_size: int
 
     def __len__(self) -> int:
         return self.tokens.shape[0]
 
     def batch(self, idx: np.ndarray) -> Dict[str, np.ndarray]:
-        return {"tokens": self.tokens[idx], "targets": self.targets[idx],
-                "mask": self.mask[idx]}
+        # Storage may be narrow (uint8 byte corpora); models take int32.
+        tokens = self.tokens[idx].astype(np.int32, copy=False)
+        targets = self.targets[idx].astype(np.int32, copy=False)
+        mask = (np.ones(targets.shape, np.float32) if self.mask is None
+                else self.mask[idx])
+        return {"tokens": tokens, "targets": targets, "mask": mask}
 
 
 def synthetic_mlm(n: int = 2048, seq_len: int = 128, vocab_size: int = 64,
@@ -82,6 +89,43 @@ def synthetic_clm(n: int = 2048, seq_len: int = 128, vocab_size: int = 64,
     return LmDataset(tokens=seq[:, :-1], targets=seq[:, 1:],
                      mask=np.ones((n, seq_len), np.float32),
                      vocab_size=vocab_size)
+
+
+def text_clm(path: str, seq_len: int = 128, seed: int = 0,
+             val_fraction: float = 0.1) -> tuple:
+    """Byte-level causal-LM datasets from a LOCAL text/binary file —
+    a real corpus path with zero egress and zero tokenizer downloads:
+    the vocabulary is the 256 byte values (char-level GPT, the nanoGPT
+    recipe). Returns (train, val) LmDatasets in the same
+    {tokens, targets, mask} layout as the synthetic generators.
+
+    The file is split into non-overlapping (seq_len + 1)-byte windows;
+    the last seq_len bytes of each window are the targets of the first
+    seq_len. Windows are deterministically shuffled per ``seed``, and
+    the LAST ``val_fraction`` of the shuffle is held out — a random
+    split, so train and val share the same distribution even for files
+    whose style drifts start to end.
+    """
+    data = np.fromfile(path, dtype=np.uint8)
+    win = seq_len + 1
+    n = len(data) // win
+    if n < 2:
+        raise ValueError(
+            f"{path!r}: {len(data)} bytes < 2 windows of {win} "
+            f"(need seq_len+1 bytes per sequence)")
+    # Stay uint8 on the host (1 byte/token; batch() casts per batch)
+    # and skip the all-ones mask entirely — a 2 GB corpus costs ~2 GB
+    # here, not ~16.
+    seq = data[:n * win].reshape(n, win)
+    order = np.random.default_rng(seed).permutation(n)
+    seq = seq[order]
+    n_val = max(1, int(n * val_fraction))
+
+    def make(rows):
+        return LmDataset(tokens=rows[:, :-1], targets=rows[:, 1:],
+                         mask=None, vocab_size=256)
+
+    return make(seq[:-n_val]), make(seq[-n_val:])
 
 
 class LmBatcher(Batcher):

@@ -103,6 +103,9 @@ def _build_model_and_state(cfg: TrainConfig, mesh, task):
             size_kw["mlp_variant"] = cfg.mlp_variant
         if cfg.norm != "layernorm":
             size_kw["norm"] = cfg.norm
+        if cfg.dataset == "text":
+            # Byte-level corpus: the vocabulary IS the 256 byte values.
+            size_kw["vocab_size"] = 256
     if cfg.model == "pipelined_lm":
         size_kw["num_microbatches"] = cfg.pipeline_microbatches
     model = build_model(

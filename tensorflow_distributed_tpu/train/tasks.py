@@ -148,13 +148,45 @@ def _make_lm_task(cfg: TrainConfig, mesh: Mesh, objective: str,
     from tensorflow_distributed_tpu.data.lm import (
         LmBatcher, synthetic_clm, synthetic_mlm)
 
-    gen = (synthetic_mlm if objective.endswith("mlm") else synthetic_clm)
-    n = max(16 * cfg.batch_size, 4096)
-    train_ds = gen(n=n, seq_len=seq_len, vocab_size=vocab_size,
-                   seed=cfg.seed)
-    val_ds = gen(n=max(4 * cfg.eval_batch_size, 512),
-                 seq_len=seq_len, vocab_size=vocab_size,
-                 seed=cfg.seed + 1)
+    if cfg.dataset == "text":
+        # Byte-level causal LM over a LOCAL file (data.lm.text_clm):
+        # the real-corpus path, no egress, vocab = the 256 byte values
+        # (the model is built with vocab_size=256 by train.loop).
+        if not objective.endswith("clm"):
+            raise ValueError(
+                "dataset='text' is causal-LM only (gpt_lm / moe_lm / "
+                "pipelined_lm); bert_mlm has no byte-masking stream")
+        from tensorflow_distributed_tpu.data.lm import text_clm
+        train_ds, val_ds = text_clm(cfg.data_dir, seq_len=seq_len,
+                                    seed=cfg.seed)
+        # Fail at task creation, not after training: the final eval
+        # needs >= one data-axis-wide batch of val rows, and the
+        # batcher needs a full train batch.
+        data_size = dict(mesh.shape).get(AXIS_DATA, 1)
+        if len(train_ds) < cfg.batch_size or len(val_ds) < data_size:
+            raise ValueError(
+                f"corpus {cfg.data_dir!r} too small: {len(train_ds)} "
+                f"train / {len(val_ds)} val windows of seq_len "
+                f"{seq_len}; need >= batch_size {cfg.batch_size} train "
+                f"and >= mesh data axis {data_size} val")
+    elif cfg.dataset not in ("mnist", "synthetic", "cifar10",
+                             "cifar10_synthetic", "imagenet_synthetic"):
+        # LM families ignore the vision dataset names (synthetic token
+        # streams stand in), but an unknown value is far more likely a
+        # typo for "text" than a request for synthetic data.
+        raise ValueError(
+            f"unknown dataset {cfg.dataset!r} for an LM family; use "
+            f"'text' (byte-level corpus from --data-dir) or leave the "
+            f"default for the synthetic token stream")
+    else:
+        gen = (synthetic_mlm if objective.endswith("mlm")
+               else synthetic_clm)
+        n = max(16 * cfg.batch_size, 4096)
+        train_ds = gen(n=n, seq_len=seq_len, vocab_size=vocab_size,
+                       seed=cfg.seed)
+        val_ds = gen(n=max(4 * cfg.eval_batch_size, 512),
+                     seq_len=seq_len, vocab_size=vocab_size,
+                     seed=cfg.seed + 1)
     from tensorflow_distributed_tpu.parallel.mesh import process_batch_role
 
     n_proc, i_proc = process_batch_role(mesh)

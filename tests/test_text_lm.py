@@ -1,0 +1,92 @@
+"""Byte-level text corpus path: dataset='text' trains a char-level GPT
+on a local file — the real-corpus story with zero egress."""
+
+import jax
+import numpy as np
+import pytest
+
+from tensorflow_distributed_tpu.config import MeshConfig, TrainConfig
+from tensorflow_distributed_tpu.data.lm import text_clm
+
+
+def _write_corpus(path, n=400):
+    """Deterministic, learnable byte patterns: repeated key:value lines
+    whose value is a rotation of the key."""
+    lines = [f"{'abcdefghij'[i % 10]}{i % 10}:" + "abcdefghij"[i % 10:]
+             + "abcdefghij"[:i % 10] + "\n" for i in range(n)]
+    path.write_text("".join(lines))
+    return path
+
+
+def test_text_clm_shapes_and_split(tmp_path):
+    p = _write_corpus(tmp_path / "corpus.txt")
+    train, val = text_clm(str(p), seq_len=32, seed=0)
+    assert train.vocab_size == 256
+    assert train.tokens.shape[1] == 32
+    # Targets are the byte stream shifted one.
+    np.testing.assert_array_equal(train.tokens[:, 1:], train.targets[:, :-1])
+    assert train.tokens.min() >= 0 and train.tokens.max() < 256
+    assert len(val) >= 1 and len(train) > len(val)
+    # Deterministic per seed.
+    t2, _ = text_clm(str(p), seq_len=32, seed=0)
+    np.testing.assert_array_equal(train.tokens, t2.tokens)
+
+
+def test_text_clm_too_small_raises(tmp_path):
+    p = tmp_path / "tiny.txt"
+    p.write_text("abc")
+    with pytest.raises(ValueError, match="windows"):
+        text_clm(str(p), seq_len=32)
+
+
+def test_small_corpus_fails_at_task_creation(tmp_path):
+    """A corpus with too few windows must fail BEFORE training, not in
+    the final eval after the budget is spent."""
+    from tensorflow_distributed_tpu.parallel.mesh import make_mesh
+    from tensorflow_distributed_tpu.train.tasks import make_task
+
+    p = _write_corpus(tmp_path / "small.txt", n=40)  # ~600 bytes
+    cfg = TrainConfig(model="gpt_lm", model_size="tiny", dataset="text",
+                      data_dir=str(p), batch_size=32,
+                      mesh=MeshConfig(data=8))
+    with pytest.raises(ValueError, match="too small"):
+        make_task(cfg, make_mesh(cfg.mesh))
+
+
+def test_unknown_lm_dataset_rejected(tmp_path):
+    from tensorflow_distributed_tpu.parallel.mesh import make_mesh
+    from tensorflow_distributed_tpu.train.tasks import make_task
+
+    cfg = TrainConfig(model="gpt_lm", model_size="tiny", dataset="txt",
+                      mesh=MeshConfig(data=8))
+    with pytest.raises(ValueError, match="unknown dataset"):
+        make_task(cfg, make_mesh(cfg.mesh))
+
+
+def test_text_requires_causal_family(tmp_path):
+    from tensorflow_distributed_tpu.parallel.mesh import make_mesh
+    from tensorflow_distributed_tpu.train.tasks import make_task
+
+    p = _write_corpus(tmp_path / "corpus.txt")
+    cfg = TrainConfig(model="bert_mlm", model_size="tiny", dataset="text",
+                      data_dir=str(p), mesh=MeshConfig(data=8))
+    with pytest.raises(ValueError, match="causal"):
+        make_task(cfg, make_mesh(cfg.mesh))
+
+
+def test_byte_gpt_trains_on_text(tmp_path):
+    """End to end through train(): a char-level GPT on the corpus file
+    learns the line structure (loss drops well below the ~5.5-nat
+    uniform-byte floor)."""
+    from tensorflow_distributed_tpu.train.loop import train
+
+    p = _write_corpus(tmp_path / "corpus.txt", n=2000)
+    cfg = TrainConfig(
+        model="gpt_lm", model_size="tiny", dataset="text",
+        data_dir=str(p), batch_size=32, train_steps=120,
+        eval_every=120, log_every=0, eval_batch_size=64,
+        compute_dtype="float32", dropout_rate=0.0,
+        mesh=MeshConfig(data=8), seed=0)
+    result = train(cfg)
+    assert int(jax.device_get(result.state.step)) == 120
+    assert result.final_metrics["loss"] < 2.2  # uniform bytes ~ 5.55
