@@ -99,6 +99,7 @@ def test_gqa_rejects_indivisible_heads():
     assert "qkv" in p["layer_0"]["attn"]
 
 
+@pytest.mark.slow
 def test_gqa_through_the_pipeline(devices8):
     """GQA lives in SelfAttention, which the pipelined Block shares —
     a 1F1B step with grouped KV heads runs and stays finite."""

@@ -79,6 +79,7 @@ def test_llama_style_stack_trains_decodes_generates():
     assert out.shape == (1, 5)
 
 
+@pytest.mark.slow
 def test_llama_knobs_through_pipeline(devices8):
     """SwiGLU + RMSNorm ride the shared Block into the 1F1B pipeline."""
     import optax

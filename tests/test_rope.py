@@ -82,6 +82,7 @@ def test_rope_seq_sharded_matches_unsharded(devices8):
                                atol=2e-4, rtol=1e-3)
 
 
+@pytest.mark.slow
 def test_rope_trains_and_generates(devices8):
     from tensorflow_distributed_tpu.models.generate import generate
     from tensorflow_distributed_tpu.parallel.mesh import single_device_mesh

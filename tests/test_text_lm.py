@@ -74,6 +74,7 @@ def test_text_requires_causal_family(tmp_path):
         make_task(cfg, make_mesh(cfg.mesh))
 
 
+@pytest.mark.slow
 def test_byte_gpt_trains_on_text(tmp_path):
     """End to end through train(): a char-level GPT on the corpus file
     learns the line structure (loss drops well below the ~5.5-nat
