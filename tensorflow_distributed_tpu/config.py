@@ -128,6 +128,10 @@ class TrainConfig:
     warmup_steps: int = 0
     weight_decay: float = 0.0
     grad_clip_norm: Optional[float] = None
+    # Standard (1-eps) one-hot + eps/V uniform target mixture, applied
+    # to every family's cross-entropy (including through the 1F1B
+    # pipeline's loss head). 0 = off.
+    label_smoothing: float = 0.0
     # > 1: split each global batch into this many microbatches and
     # accumulate the mean gradient before the (single) optimizer update
     # — 1/A the activation memory, same math (train.step).
@@ -275,6 +279,10 @@ class TrainConfig:
                 f"pipeline_microbatches {self.pipeline_microbatches} "
                 f"< mesh.pipe {self.mesh.pipe}: every stage needs at "
                 f"least one microbatch in flight")
+        if not 0.0 <= self.label_smoothing < 1.0:
+            raise ValueError(
+                f"label_smoothing must be in [0, 1), "
+                f"got {self.label_smoothing}")
         if self.grad_accum_steps < 1:
             raise ValueError(
                 f"grad_accum_steps must be >= 1, got {self.grad_accum_steps}")

@@ -16,16 +16,32 @@ import jax
 import jax.numpy as jnp
 
 
-def softmax_cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+def _smoothed_gold(logits: jax.Array, gold: jax.Array,
+                   label_smoothing: float) -> jax.Array:
+    """Replace the one-hot target term with the smoothed mixture
+    (1-eps)*onehot + eps*uniform: CE becomes logz - [(1-eps)*gold +
+    (eps/V)*sum(logits)] — same gather, one extra reduction, no
+    materialized [.., V] target tensor."""
+    if not label_smoothing:
+        return gold
+    v = logits.shape[-1]
+    return ((1.0 - label_smoothing) * gold
+            + (label_smoothing / v) * jnp.sum(logits, axis=-1))
+
+
+def softmax_cross_entropy(logits: jax.Array, labels: jax.Array,
+                          label_smoothing: float = 0.0) -> jax.Array:
     """Mean softmax cross-entropy; ``labels`` are int class ids.
 
     The reference fed one-hot labels; integer labels with a take-along
     gather are the same math with one less materialized [B,10] tensor.
+    ``label_smoothing``: standard (1-eps)/eps-uniform target mixture.
     """
     logits = logits.astype(jnp.float32)
     logz = jax.nn.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, labels[:, None].astype(jnp.int32),
                                axis=-1)[:, 0]
+    gold = _smoothed_gold(logits, gold, label_smoothing)
     return jnp.mean(logz - gold)
 
 
@@ -37,7 +53,7 @@ def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
 
 
 def masked_ce_sums(logits: jax.Array, targets: jax.Array,
-                   mask: jax.Array):
+                   mask: jax.Array, label_smoothing: float = 0.0):
     """UNNORMALIZED masked-CE pieces: (ce_sum, correct_sum, mask_sum).
 
     The building block shared by the mean-style losses below and the
@@ -50,6 +66,7 @@ def masked_ce_sums(logits: jax.Array, targets: jax.Array,
     logz = jax.nn.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(
         logits, targets[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    gold = _smoothed_gold(logits, gold, label_smoothing)
     mask = mask.astype(jnp.float32)
     ce_sum = jnp.sum((logz - gold) * mask)
     pred = jnp.argmax(logits, axis=-1)
@@ -58,13 +75,14 @@ def masked_ce_sums(logits: jax.Array, targets: jax.Array,
 
 
 def masked_softmax_cross_entropy(logits: jax.Array, targets: jax.Array,
-                                 mask: jax.Array) -> jax.Array:
+                                 mask: jax.Array,
+                                 label_smoothing: float = 0.0) -> jax.Array:
     """Mean cross-entropy over masked positions only (the MLM objective;
     no reference counterpart — the reference has no sequence models).
 
     logits: [B, L, V]; targets: [B, L] ints; mask: [B, L] {0,1}.
     """
-    ce_sum, _, n = masked_ce_sums(logits, targets, mask)
+    ce_sum, _, n = masked_ce_sums(logits, targets, mask, label_smoothing)
     return ce_sum / jnp.maximum(n, 1.0)
 
 

@@ -136,7 +136,7 @@ def evaluate_only(cfg: TrainConfig,
     _, state = _build_model_and_state(cfg, mesh, task)
     state = ckpt.restore(cfg.checkpoint_dir, state)
     step = int(jax.device_get(state.step))
-    eval_fn = make_eval_step(mesh, loss=task.loss,
+    eval_fn = make_eval_step(mesh, loss=task.eval_loss or task.loss,
                              batch_shardings=task.batch_shardings)
     with Timer() as eval_t:
         metrics = evaluate(state, eval_fn, task, mesh, cfg.eval_batch_size)
@@ -170,13 +170,14 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
                                        batch_shardings=task.batch_shardings,
                                        moe_aux_weight=cfg.moe_aux_weight,
                                        moe_zloss_weight=cfg.moe_zloss_weight,
-                                       grad_norm_metric=cfg.log_grad_norm)
+                                       grad_norm_metric=cfg.log_grad_norm,
+                                       label_smoothing=cfg.label_smoothing)
     else:
         step_fn = make_train_step(mesh, cfg.seed, loss=task.loss,
                                   batch_shardings=task.batch_shardings,
                                   accum_steps=cfg.grad_accum_steps,
                                   grad_norm_metric=cfg.log_grad_norm)
-    eval_fn = make_eval_step(mesh, loss=task.loss,
+    eval_fn = make_eval_step(mesh, loss=task.eval_loss or task.loss,
                              batch_shardings=task.batch_shardings)
     logger.log_json({
         "event": "start", "model": cfg.model, "task": task.name,

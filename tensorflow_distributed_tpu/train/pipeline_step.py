@@ -52,7 +52,8 @@ def make_1f1b_train_step(model: PipelinedLM, mesh: Mesh, seed: int = 0,
                          jit: bool = True,
                          moe_aux_weight: float = MOE_AUX_WEIGHT,
                          moe_zloss_weight: float = 0.0,
-                         grad_norm_metric: bool = False
+                         grad_norm_metric: bool = False,
+                         label_smoothing: float = 0.0
                          ) -> Callable[[TrainState, Any],
                                        Tuple[TrainState, Dict]]:
     """Build the jitted 1F1B step for a PipelinedLM.
@@ -85,7 +86,8 @@ def make_1f1b_train_step(model: PipelinedLM, mesh: Mesh, seed: int = 0,
         def last_fn(sp, y_mb, aux_mb):
             logits = model.head(sp, y_mb)
             tgt, msk = aux_mb
-            ce_sum, correct, n = masked_ce_sums(logits, tgt, msk)
+            ce_sum, correct, n = masked_ce_sums(logits, tgt, msk,
+                                                label_smoothing)
             return ce_sum, {"correct": correct, "mask": n}
 
         kw = dict(rng=dkey if use_dropout else None,

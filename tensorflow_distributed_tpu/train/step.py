@@ -68,14 +68,15 @@ def apply_model(apply_fn: Callable, params: Any, extra: Any, inputs: Any,
 
 
 def loss_fn(apply_fn: Callable, params: Any, extra: Any, batch: Batch,
-            dropout_key: jax.Array, train: bool
+            dropout_key: jax.Array, train: bool,
+            label_smoothing: float = 0.0
             ) -> Tuple[jax.Array, Tuple[Metrics, Any]]:
     """Default classification loss — the reference's task
     (mnist_python_m.py:205-207)."""
     images, labels = batch
     logits, new_extra = apply_model(apply_fn, params, extra, images,
                                     dropout_key, train)
-    loss = softmax_cross_entropy(logits, labels)
+    loss = softmax_cross_entropy(logits, labels, label_smoothing)
     metrics = {"loss": loss, "accuracy": accuracy(logits, labels)}
     return loss, (metrics, new_extra)
 

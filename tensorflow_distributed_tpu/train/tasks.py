@@ -35,12 +35,26 @@ class Task:
     eval_batches: Callable[[int], Iterator[Any]]  # batch_size -> batches
     eval_size: int                    # rows in the eval split
     steps_per_epoch: int
+    # Loss for the EVAL pass; None = same as ``loss``. Train-only
+    # regularizers (label smoothing) stay out of reported validation
+    # numbers so they're comparable across smoothing settings.
+    eval_loss: Optional[step_lib.LossFn] = None
 
 
 # --- vision (the reference's task) --------------------------------------
 
-def vision_loss(apply_fn, params, extra, batch, dropout_key, train):
-    return step_lib.loss_fn(apply_fn, params, extra, batch, dropout_key, train)
+def make_vision_loss(label_smoothing: float = 0.0):
+    """The reference's classification objective (step_lib.loss_fn) with
+    a smoothing knob — ONE body, owned by train.step."""
+    def vision_loss(apply_fn, params, extra, batch, dropout_key, train):
+        return step_lib.loss_fn(apply_fn, params, extra, batch,
+                                dropout_key, train,
+                                label_smoothing=label_smoothing)
+
+    return vision_loss
+
+
+vision_loss = step_lib.loss_fn  # unsmoothed default (eval path)
 
 
 def _make_vision_task(cfg: TrainConfig, mesh: Mesh) -> Task:
@@ -69,7 +83,8 @@ def _make_vision_task(cfg: TrainConfig, mesh: Mesh) -> Task:
             yield (val_ds.images[lo:lo + batch], val_ds.labels[lo:lo + batch])
 
     return Task(
-        name="vision", loss=vision_loss,
+        name="vision", loss=make_vision_loss(cfg.label_smoothing),
+        eval_loss=vision_loss,
         batch_shardings=step_lib.default_batch_shardings(mesh),
         sample_input=np.zeros((2,) + train_ds.images.shape[1:], np.float32),
         seq_axis=None, train_stream=batcher.forever,
@@ -79,24 +94,32 @@ def _make_vision_task(cfg: TrainConfig, mesh: Mesh) -> Task:
 
 # --- masked LM (BASELINE.json stretch family) ---------------------------
 
-def mlm_loss(apply_fn, params, extra, batch, dropout_key, train):
-    """Masked-LM objective over a {tokens, targets, mask} batch."""
-    logits, new_extra = step_lib.apply_model(
-        apply_fn, params, extra, batch["tokens"], dropout_key, train)
-    loss = masked_softmax_cross_entropy(logits, batch["targets"],
-                                        batch["mask"])
-    metrics = {
-        "loss": loss,
-        "accuracy": masked_accuracy(logits, batch["targets"], batch["mask"]),
-    }
-    return loss, (metrics, new_extra)
+def make_mlm_loss(label_smoothing: float = 0.0):
+    def mlm_loss(apply_fn, params, extra, batch, dropout_key, train):
+        """Masked-LM objective over a {tokens, targets, mask} batch."""
+        logits, new_extra = step_lib.apply_model(
+            apply_fn, params, extra, batch["tokens"], dropout_key, train)
+        loss = masked_softmax_cross_entropy(logits, batch["targets"],
+                                            batch["mask"], label_smoothing)
+        metrics = {
+            "loss": loss,
+            "accuracy": masked_accuracy(logits, batch["targets"],
+                                        batch["mask"]),
+        }
+        return loss, (metrics, new_extra)
+
+    return mlm_loss
+
+
+mlm_loss = make_mlm_loss()  # default instance (tests, eval)
 
 
 MOE_AUX_WEIGHT = 0.01  # Switch-Transformer-style coefficient
 
 
 def make_moe_loss(aux_weight: float = MOE_AUX_WEIGHT,
-                  zloss_weight: float = 0.0):
+                  zloss_weight: float = 0.0,
+                  label_smoothing: float = 0.0):
     """CLM objective + router losses from the "moe_aux" collection the
     MoeMlp layers sow (models/moe.py): load-balance (weighted by
     ``aux_weight``), router z-loss (``zloss_weight``), and the
@@ -112,7 +135,7 @@ def make_moe_loss(aux_weight: float = MOE_AUX_WEIGHT,
         logits, mut = apply_fn(variables, batch["tokens"], train=train,
                                rngs=rngs, mutable=["moe_aux"])
         loss = masked_softmax_cross_entropy(logits, batch["targets"],
-                                            batch["mask"])
+                                            batch["mask"], label_smoothing)
         aux = collect_aux(mut.get("moe_aux", {}))
         lb = aux.get("load_balance", 0.0)
         z = aux.get("z_loss", 0.0)
@@ -198,10 +221,16 @@ def _make_lm_task(cfg: TrainConfig, mesh: Mesh, objective: str,
         for lo in range(0, nrows, batch):
             yield val_ds.batch(np.arange(lo, lo + batch))
 
+    moe = objective.startswith("moe_")
     return Task(
         name=objective,
-        loss=(make_moe_loss(cfg.moe_aux_weight, cfg.moe_zloss_weight)
-              if objective.startswith("moe_") else mlm_loss),
+        loss=(make_moe_loss(cfg.moe_aux_weight, cfg.moe_zloss_weight,
+                            cfg.label_smoothing)
+              if moe else make_mlm_loss(cfg.label_smoothing)),
+        # Eval drops the train-only smoothing but keeps the router
+        # terms (they're part of the MoE objective being reported).
+        eval_loss=(make_moe_loss(cfg.moe_aux_weight, cfg.moe_zloss_weight)
+                   if moe else mlm_loss),
         batch_shardings=mlm_batch_shardings(mesh),
         sample_input=np.zeros((2, seq_len), np.int32), seq_axis=1,
         train_stream=batcher.forever, eval_batches=eval_batches,

@@ -1,0 +1,111 @@
+"""Label smoothing: math against the explicit smoothed-one-hot oracle,
+zero-eps equivalence, and the knob reaching every loss path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorflow_distributed_tpu.ops.losses import (
+    masked_ce_sums, masked_softmax_cross_entropy, softmax_cross_entropy)
+
+
+def _oracle(logits, labels, eps):
+    """CE against the materialized (1-eps)*onehot + eps/V mixture."""
+    logits = np.asarray(logits, np.float64)
+    v = logits.shape[-1]
+    onehot = np.eye(v)[np.asarray(labels)]
+    target = (1 - eps) * onehot + eps / v
+    logp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+    return float(-(target * logp).sum(-1).mean())
+
+
+def test_smoothed_ce_matches_oracle():
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(16, 10)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, 10, size=(16,)), jnp.int32)
+    for eps in (0.0, 0.1, 0.3):
+        got = float(softmax_cross_entropy(logits, labels, eps))
+        np.testing.assert_allclose(got, _oracle(logits, labels, eps),
+                                   rtol=1e-5)
+    # eps=0 is bit-identical to the unsmoothed path.
+    np.testing.assert_array_equal(
+        np.asarray(softmax_cross_entropy(logits, labels)),
+        np.asarray(softmax_cross_entropy(logits, labels, 0.0)))
+
+
+def test_masked_smoothed_ce_matches_oracle():
+    rng = np.random.default_rng(1)
+    logits = jnp.asarray(rng.normal(size=(4, 8, 11)), jnp.float32)
+    targets = jnp.asarray(rng.integers(0, 11, size=(4, 8)), jnp.int32)
+    mask = jnp.asarray(rng.random((4, 8)) < 0.5, jnp.float32)
+    eps = 0.2
+    got = float(masked_softmax_cross_entropy(logits, targets, mask, eps))
+    flat_l = np.asarray(logits).reshape(-1, 11)
+    flat_t = np.asarray(targets).reshape(-1)
+    flat_m = np.asarray(mask).reshape(-1).astype(bool)
+    want = _oracle(flat_l[flat_m], flat_t[flat_m], eps)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    # Smoothing never changes the accuracy pieces.
+    _, c0, n0 = masked_ce_sums(logits, targets, mask)
+    _, c1, n1 = masked_ce_sums(logits, targets, mask, eps)
+    assert float(c0) == float(c1) and float(n0) == float(n1)
+
+
+def test_eval_loss_stays_unsmoothed(devices8):
+    """Validation numbers must be comparable across smoothing settings:
+    the task's eval_loss is the raw objective."""
+    from tensorflow_distributed_tpu.config import MeshConfig, TrainConfig
+    from tensorflow_distributed_tpu.parallel.mesh import make_mesh
+    from tensorflow_distributed_tpu.train import step as step_lib
+    from tensorflow_distributed_tpu.train.tasks import make_task
+
+    mesh = make_mesh(MeshConfig(data=8))
+    v = make_task(TrainConfig(dataset="synthetic", label_smoothing=0.3,
+                              mesh=MeshConfig(data=8)), mesh)
+    assert v.eval_loss is step_lib.loss_fn  # the unsmoothed default
+    lm = make_task(TrainConfig(model="gpt_lm", model_size="tiny",
+                               dataset="synthetic", label_smoothing=0.3,
+                               mesh=MeshConfig(data=8)), mesh)
+    assert lm.eval_loss is not None and lm.eval_loss is not lm.loss
+
+
+@pytest.mark.slow
+def test_smoothing_reaches_train_and_pipeline(devices8):
+    """The config knob changes the reported loss in both the standard
+    step and the 1F1B pipeline, identically (shared last_fn math)."""
+    import optax
+    from tensorflow_distributed_tpu.config import MeshConfig
+    from tensorflow_distributed_tpu.data.lm import LmBatcher, synthetic_clm
+    from tensorflow_distributed_tpu.models.pipelined import pipelined_lm
+    from tensorflow_distributed_tpu.parallel.mesh import make_mesh
+    from tensorflow_distributed_tpu.parallel.sharding import shard_batch
+    from tensorflow_distributed_tpu.train.pipeline_step import (
+        make_1f1b_train_step)
+    from tensorflow_distributed_tpu.train.state import create_train_state
+    from tensorflow_distributed_tpu.train.step import make_train_step
+    from tensorflow_distributed_tpu.train.tasks import (
+        make_mlm_loss, mlm_batch_shardings)
+
+    mesh = make_mesh(MeshConfig(data=2, pipe=4), devices8)
+    model = pipelined_lm(mesh, num_microbatches=4, max_len=16,
+                         use_flash=False)
+    state = create_train_state(model, optax.adam(1e-3),
+                               np.zeros((2, 16), np.int32), mesh)
+    ds = synthetic_clm(n=32, seq_len=16, vocab_size=64, seed=0)
+    batch = shard_batch(mesh, next(LmBatcher(ds, 8, 0).forever(0)),
+                        seq_axis=1)
+
+    eps = 0.25
+    _, m_plain = make_train_step(
+        mesh, loss=make_mlm_loss(), donate=False,
+        batch_shardings=mlm_batch_shardings(mesh))(state, batch)
+    _, m_smooth = make_train_step(
+        mesh, loss=make_mlm_loss(eps), donate=False,
+        batch_shardings=mlm_batch_shardings(mesh))(state, batch)
+    assert float(m_smooth["loss"]) > float(m_plain["loss"])
+
+    _, p_smooth = make_1f1b_train_step(model, mesh, donate=False,
+                                       label_smoothing=eps)(state, batch)
+    np.testing.assert_allclose(float(p_smooth["loss"]),
+                               float(m_smooth["loss"]), rtol=1e-5)
