@@ -132,6 +132,11 @@ class TrainConfig:
     # to every family's cross-entropy (including through the 1F1B
     # pipeline's loss head). 0 = off.
     label_smoothing: float = 0.0
+    # Polyak/EMA weight averaging: eval (and mode=eval) runs on the
+    # exponential moving average of the params, updated every step
+    # with this decay. 0 = off. Costs one extra param-sized buffer
+    # (sharded like the params — 1/data per device under FSDP).
+    ema_decay: float = 0.0
     # > 1: split each global batch into this many microbatches and
     # accumulate the mean gradient before the (single) optimizer update
     # — 1/A the activation memory, same math (train.step).
@@ -283,6 +288,9 @@ class TrainConfig:
             raise ValueError(
                 f"label_smoothing must be in [0, 1), "
                 f"got {self.label_smoothing}")
+        if not 0.0 <= self.ema_decay < 1.0:
+            raise ValueError(
+                f"ema_decay must be in [0, 1), got {self.ema_decay}")
         if self.grad_accum_steps < 1:
             raise ValueError(
                 f"grad_accum_steps must be >= 1, got {self.grad_accum_steps}")

@@ -246,14 +246,24 @@ def restore(ckpt_dir: str, state: Any, step: Optional[int] = None) -> Any:
     if step is None:
         raise FileNotFoundError(f"no checkpoints under {ckpt_dir}")
     path = os.path.join(_step_dir(ckpt_dir, step), "state.msgpack")
-    # from_bytes only needs the pytree STRUCTURE (plus leaf shapes for
-    # shape-checking) — a zeros skeleton costs no device transfers or
-    # collectives, unlike fetching the throwaway template's values.
+    # from_state_dict only needs the pytree STRUCTURE (plus leaf shapes
+    # for shape-checking) — a zeros skeleton costs no device transfers
+    # or collectives, unlike fetching the throwaway template's values.
     skeleton = jax.tree_util.tree_map(
         lambda leaf: np.zeros(leaf.shape, leaf.dtype)
         if isinstance(leaf, jax.Array) else leaf, state)
     with open(path, "rb") as f:
-        host_state = serialization.from_bytes(skeleton, f.read())
+        raw = serialization.msgpack_restore(f.read())
+    # EMA toggled between the saved run and this config must not brick
+    # the restore: newly-enabled EMA seeds from the restored params
+    # (the natural warm start); newly-disabled EMA drops the average.
+    if (isinstance(raw, dict) and "ema" in raw and hasattr(state, "ema")):
+        want, have = state.ema is not None, raw["ema"] is not None
+        if want and not have:
+            raw["ema"] = raw["params"]
+        elif have and not want:
+            raw["ema"] = None
+    host_state = serialization.from_state_dict(skeleton, raw)
 
     # Re-place every leaf with the template's sharding (mesh-shape
     # agnostic restore). Templates sharded across processes can't take

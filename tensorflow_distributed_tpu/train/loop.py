@@ -116,7 +116,8 @@ def _build_model_and_state(cfg: TrainConfig, mesh, task):
         **size_kw)
     tx = make_optimizer(cfg)
     state = create_train_state(model, tx, task.sample_input, mesh, cfg.seed,
-                               fsdp=cfg.param_partition == "fsdp")
+                               fsdp=cfg.param_partition == "fsdp",
+                               ema=cfg.ema_decay > 0)
     return model, state
 
 
@@ -171,12 +172,14 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
                                        moe_aux_weight=cfg.moe_aux_weight,
                                        moe_zloss_weight=cfg.moe_zloss_weight,
                                        grad_norm_metric=cfg.log_grad_norm,
-                                       label_smoothing=cfg.label_smoothing)
+                                       label_smoothing=cfg.label_smoothing,
+                                       ema_decay=cfg.ema_decay)
     else:
         step_fn = make_train_step(mesh, cfg.seed, loss=task.loss,
                                   batch_shardings=task.batch_shardings,
                                   accum_steps=cfg.grad_accum_steps,
-                                  grad_norm_metric=cfg.log_grad_norm)
+                                  grad_norm_metric=cfg.log_grad_norm,
+                                  ema_decay=cfg.ema_decay)
     eval_fn = make_eval_step(mesh, loss=task.eval_loss or task.loss,
                              batch_shardings=task.batch_shardings)
     logger.log_json({

@@ -41,7 +41,7 @@ from tensorflow_distributed_tpu.models.pipelined import PipelinedLM
 from tensorflow_distributed_tpu.ops.losses import masked_ce_sums
 from tensorflow_distributed_tpu.parallel.pipeline import (
     pipeline_value_and_grad)
-from tensorflow_distributed_tpu.train.state import TrainState
+from tensorflow_distributed_tpu.train.state import TrainState, ema_update
 from tensorflow_distributed_tpu.train.tasks import (
     MOE_AUX_WEIGHT, mlm_batch_shardings)
 from tensorflow_distributed_tpu.utils import prng
@@ -53,7 +53,8 @@ def make_1f1b_train_step(model: PipelinedLM, mesh: Mesh, seed: int = 0,
                          moe_aux_weight: float = MOE_AUX_WEIGHT,
                          moe_zloss_weight: float = 0.0,
                          grad_norm_metric: bool = False,
-                         label_smoothing: float = 0.0
+                         label_smoothing: float = 0.0,
+                         ema_decay: float = 0.0
                          ) -> Callable[[TrainState, Any],
                                        Tuple[TrainState, Dict]]:
     """Build the jitted 1F1B step for a PipelinedLM.
@@ -130,8 +131,12 @@ def make_1f1b_train_step(model: PipelinedLM, mesh: Mesh, seed: int = 0,
                        sums["mask"], 1.0), **aux_metrics}
         if grad_norm_metric:
             metrics["grad_norm"] = optax.global_norm(grads)
+        new_ema = state.ema
+        if ema_decay and state.ema is not None:
+            new_ema = ema_update(state.ema, new_params, ema_decay,
+                                 state.step)
         new_state = state.replace(step=state.step + 1, params=new_params,
-                                  opt_state=new_opt)
+                                  opt_state=new_opt, ema=new_ema)
         return new_state, metrics
 
     if not jit:

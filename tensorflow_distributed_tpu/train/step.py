@@ -35,7 +35,7 @@ from jax.sharding import Mesh
 
 from tensorflow_distributed_tpu.ops.losses import accuracy, softmax_cross_entropy
 from tensorflow_distributed_tpu.parallel.sharding import batch_sharding, replicated
-from tensorflow_distributed_tpu.train.state import TrainState
+from tensorflow_distributed_tpu.train.state import TrainState, ema_update
 from tensorflow_distributed_tpu.utils import prng
 
 Batch = Any  # task-defined pytree; classification default: (images, labels)
@@ -90,7 +90,8 @@ def make_train_step(mesh: Mesh, seed: int = 0, donate: bool = True,
                     batch_shardings: Any = None,
                     accum_steps: int = 1,
                     jit: bool = True,
-                    grad_norm_metric: bool = False
+                    grad_norm_metric: bool = False,
+                    ema_decay: float = 0.0
                     ) -> Callable[[TrainState, Batch],
                                   Tuple[TrainState, Metrics]]:
     """Build the jitted train step for a mesh.
@@ -181,8 +182,13 @@ def make_train_step(mesh: Mesh, seed: int = 0, donate: bool = True,
         updates, new_opt = state.tx.update(grads, state.opt_state, state.params)
         new_params = jax.tree_util.tree_map(
             lambda p, u: (p + u.astype(p.dtype)), state.params, updates)
+        new_ema = state.ema
+        if ema_decay and state.ema is not None:
+            new_ema = ema_update(state.ema, new_params, ema_decay,
+                                 state.step)
         new_state = state.replace(step=state.step + 1, params=new_params,
-                                  opt_state=new_opt, extra=new_extra)
+                                  opt_state=new_opt, extra=new_extra,
+                                  ema=new_ema)
         return new_state, metrics
 
     if not jit:
@@ -207,7 +213,10 @@ def make_eval_step(mesh: Mesh, loss: LossFn = loss_fn,
         batch_shardings = default_batch_shardings(mesh)
 
     def step(state: TrainState, batch: Batch) -> Metrics:
-        _, (metrics, _) = loss(state.apply_fn, state.params, state.extra,
+        # Polyak preference: evaluate the EMA weights when tracked
+        # (None-ness is pytree structure — a trace-time branch).
+        params = state.params if state.ema is None else state.ema
+        _, (metrics, _) = loss(state.apply_fn, params, state.extra,
                                batch, jax.random.key(0), False)
         return metrics
 
