@@ -154,6 +154,13 @@ class TrainConfig:
     moe_aux_weight: float = 0.01
     # ST-MoE router z-loss coefficient (0 = off).
     moe_zloss_weight: float = 0.0
+    # Experts each token routes to (1 = Switch-style, 2 = GShard-style).
+    moe_top_k: int = 2
+    # Per-expert buffer slack over the perfectly-balanced load; each
+    # expert holds ceil(capacity_factor * top_k * tokens / experts)
+    # slots (models/moe.py) and assignments past that are dropped (the
+    # dropped fraction is a train metric).
+    moe_capacity_factor: float = 1.25
 
     # --- mesh / parallelism ---------------------------------------------
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
@@ -304,6 +311,19 @@ class TrainConfig:
                 f"got model={self.model!r}")
         if self.moe_aux_weight < 0 or self.moe_zloss_weight < 0:
             raise ValueError("moe_aux_weight/moe_zloss_weight must be >= 0")
+        if self.moe_top_k < 1:
+            raise ValueError(f"moe_top_k must be >= 1, got {self.moe_top_k}")
+        if 0 < self.moe_experts < self.moe_top_k:
+            # The router would argmax over an exhausted mask and route
+            # the same token to expert 0 repeatedly — silent
+            # degradation, not an error, so reject it here.
+            raise ValueError(
+                f"moe_top_k {self.moe_top_k} > moe_experts "
+                f"{self.moe_experts}")
+        if self.moe_capacity_factor <= 0:
+            raise ValueError(
+                f"moe_capacity_factor must be > 0, "
+                f"got {self.moe_capacity_factor}")
         if self.batch_size % self.grad_accum_steps:
             raise ValueError(
                 f"batch_size {self.batch_size} not divisible by "

@@ -95,6 +95,13 @@ class MoeMlp(nn.Module):
     def __call__(self, x: jax.Array) -> jax.Array:
         G, S, M = x.shape
         E, K = self.num_experts, self.top_k
+        if K > E:
+            # The routing loop would argmax an exhausted mask and pick
+            # expert 0 with full gate weight on the extra iterations —
+            # silent degradation; refuse instead (config.validate
+            # catches the CLI path; this guards direct construction
+            # and family-default expert counts).
+            raise ValueError(f"top_k {K} > num_experts {E}")
         C = max(1, math.ceil(self.capacity_factor * K * S / E))
 
         gate_w = self.param("gate", self._winit((None, None)), (M, E),

@@ -87,6 +87,9 @@ def _build_model_and_state(cfg: TrainConfig, mesh, task):
         size_kw.update(remat=True, remat_policy=cfg.remat)
     if cfg.moe_experts > 0:  # validated: transformer families only
         size_kw["moe_experts"] = cfg.moe_experts
+    if cfg.model == "moe_lm" or cfg.moe_experts > 0:
+        size_kw["moe_top_k"] = cfg.moe_top_k
+        size_kw["moe_capacity_factor"] = cfg.moe_capacity_factor
     if cfg.model in ("bert_mlm", "gpt_lm", "moe_lm"):
         # Non-pipelined transformer knobs (pipelined_lm rejects both
         # in config.validate and its factory).

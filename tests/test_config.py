@@ -39,6 +39,33 @@ def test_validation_rejects_bad_values():
         TrainConfig(resume=True).validate()  # resume without checkpoint_dir
     with pytest.raises(ValueError):
         MeshConfig(model=0).validate()
+    with pytest.raises(ValueError):
+        TrainConfig(moe_top_k=0).validate()
+    with pytest.raises(ValueError):
+        TrainConfig(model="gpt_lm", moe_experts=2,
+                    moe_top_k=4).validate()
+    with pytest.raises(ValueError):
+        TrainConfig(moe_capacity_factor=0.0).validate()
+    with pytest.raises(ValueError):
+        TrainConfig(label_smoothing=1.0).validate()
+    with pytest.raises(ValueError):
+        TrainConfig(ema_decay=-0.1).validate()
+
+
+def test_moe_routing_knobs_reach_the_model():
+    """--moe-top-k / --moe-capacity-factor flow into the built model."""
+    from tensorflow_distributed_tpu.parallel.mesh import make_mesh
+    from tensorflow_distributed_tpu.train.loop import _build_model_and_state
+    from tensorflow_distributed_tpu.train.tasks import make_task
+
+    cfg = TrainConfig(model="moe_lm", model_size="tiny", moe_top_k=1,
+                      moe_capacity_factor=2.0, dataset="synthetic",
+                      mesh=MeshConfig(data=8))
+    cfg.validate()
+    mesh = make_mesh(cfg.mesh)
+    model, _ = _build_model_and_state(cfg, mesh, make_task(cfg, mesh))
+    assert model.cfg.moe_top_k == 1
+    assert model.cfg.moe_capacity_factor == 2.0
 
 
 def test_reference_dead_flags_are_gone():
