@@ -77,24 +77,20 @@ def main(argv=None) -> None:
             rng.integers(0, model.cfg.vocab_size,
                          size=(args.batch, args.prompt_len)), np.int32)
 
-        # Warm-up compile (prefill + the scanned decode).
-        toks = generate(model, params, prompt, args.new_tokens)
-        _ = np.asarray(toks)  # host readback barrier
+        def timed(n_tokens):
+            """Warm-up compile, then the averaged timed loop with a
+            host readback barrier — one methodology for both phases."""
+            toks = generate(model, params, prompt, n_tokens)
+            _ = np.asarray(toks)
+            t0 = time.perf_counter()
+            for _ in range(args.iters):
+                toks = generate(model, params, prompt, n_tokens)
+            _ = np.asarray(toks)
+            return (time.perf_counter() - t0) / args.iters
 
-        t0 = time.perf_counter()
-        for _ in range(args.iters):
-            toks = generate(model, params, prompt, args.new_tokens)
-        _ = np.asarray(toks)
-        wall = (time.perf_counter() - t0) / args.iters
-
-        # Split phases: time prefill alone via 1 new token.
-        one = generate(model, params, prompt, 1)
-        _ = np.asarray(one)
-        t0 = time.perf_counter()
-        for _ in range(args.iters):
-            one = generate(model, params, prompt, 1)
-        _ = np.asarray(one)
-        prefill = (time.perf_counter() - t0) / args.iters
+        wall = timed(args.new_tokens)
+        # Split phases: a 1-token run is (prefill + one pick).
+        prefill = timed(1)
 
         decode = max(wall - prefill, 1e-9)
         n_decode = args.batch * (args.new_tokens - 1)

@@ -79,6 +79,32 @@ def test_llama_style_stack_trains_decodes_generates():
     assert out.shape == (1, 5)
 
 
+def test_modern_knobs_on_bidirectional_family():
+    """pos_emb/GQA/SwiGLU/RMSNorm are family-wide: the encoder (MLM)
+    model composes them too — forward, loss, and grads stay finite."""
+    from tensorflow_distributed_tpu.models.transformer import BertMLM
+    from tensorflow_distributed_tpu.ops.losses import (
+        masked_softmax_cross_entropy)
+
+    model = BertMLM(tiny_config(pos_emb="rope", n_kv_heads=2,
+                                mlp_variant="swiglu", norm="rmsnorm",
+                                compute_dtype=jnp.float32))
+    toks = _tokens(l=16)
+    variables = model.init(jax.random.key(0), toks)
+    assert "pos_emb" not in variables["params"]
+
+    def loss(p):
+        logits = model.apply({"params": p}, toks)
+        assert logits.shape == (*toks.shape, 64)
+        return masked_softmax_cross_entropy(
+            logits, toks, jnp.ones(toks.shape, jnp.float32))
+
+    val, grads = jax.value_and_grad(loss)(variables["params"])
+    assert np.isfinite(float(val))
+    assert all(np.isfinite(np.asarray(g)).all()
+               for g in jax.tree_util.tree_leaves(grads))
+
+
 @pytest.mark.slow
 def test_llama_knobs_through_pipeline(devices8):
     """SwiGLU + RMSNorm ride the shared Block into the 1F1B pipeline."""
