@@ -26,6 +26,72 @@ import os
 import sys
 
 
+def run_xaxes_scenarios(fetch):
+    """Cross-process PIPELINE and EXPERT axis scenarios — THE shared
+    definition run by both the 2-process workers and the parent test's
+    single-process oracle, so the two can never drift apart. With
+    data=1/pipe=8 the 1F1B schedule's per-tick activation/cotangent
+    ppermutes cross the process boundary (the DCN analog of NCCL P2P);
+    with expert=8 the MoE dispatch/combine all_to_alls do.
+
+    ``fetch(params) -> host pytree``: checkpoint._fetch_host in the
+    cluster (collective; params span processes), jax.device_get in the
+    single-process oracle. Returns {pipe_loss, pipe_checksum,
+    expert_loss, expert_checksum}.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tensorflow_distributed_tpu.config import MeshConfig
+    from tensorflow_distributed_tpu.data.lm import synthetic_clm
+    from tensorflow_distributed_tpu.models.pipelined import pipelined_lm
+    from tensorflow_distributed_tpu.models.transformer import moe_lm
+    from tensorflow_distributed_tpu.parallel.mesh import make_mesh
+    from tensorflow_distributed_tpu.parallel.sharding import shard_batch
+    from tensorflow_distributed_tpu.train.pipeline_step import (
+        make_1f1b_train_step)
+    from tensorflow_distributed_tpu.train.state import create_train_state
+    from tensorflow_distributed_tpu.train.step import make_train_step
+    from tensorflow_distributed_tpu.train.tasks import (
+        mlm_batch_shardings, moe_loss)
+
+    ds = synthetic_clm(n=64, seq_len=16, vocab_size=64, seed=0)
+
+    def checksum(params):
+        return float(sum(abs(x).sum()
+                         for x in jax.tree_util.tree_leaves(fetch(params))))
+
+    def run(mesh, model, step):
+        state = create_train_state(model, optax.adam(1e-3),
+                                   np.zeros((2, 16), np.int32), mesh)
+        for i in range(3):
+            state, m = step(state, shard_batch(
+                mesh, ds.batch(np.arange(16 * i, 16 * (i + 1))),
+                seq_axis=1))
+        return float(jax.device_get(m["loss"])), checksum(state.params)
+
+    mesh_p = make_mesh(MeshConfig(data=1, pipe=8))
+    model_p = pipelined_lm(mesh_p, num_microbatches=8, n_layers=8,
+                           max_len=16, use_flash=False,
+                           compute_dtype=jnp.float32, dropout_rate=0.0)
+    pipe_loss, pipe_sum = run(
+        mesh_p, model_p, make_1f1b_train_step(model_p, mesh_p,
+                                              donate=False))
+
+    mesh_e = make_mesh(MeshConfig(data=1, expert=8))
+    model_e = moe_lm(mesh_e, size="tiny", moe_experts=8, max_len=16,
+                     compute_dtype=jnp.float32, dropout_rate=0.0)
+    expert_loss, expert_sum = run(
+        mesh_e, model_e, make_train_step(
+            mesh_e, loss=moe_loss, donate=False,
+            batch_shardings=mlm_batch_shardings(mesh_e)))
+
+    return {"pipe_loss": pipe_loss, "pipe_checksum": pipe_sum,
+            "expert_loss": expert_loss, "expert_checksum": expert_sum}
+
+
 def main() -> None:
     out_path = sys.argv[1]
     import jax
@@ -42,6 +108,14 @@ def main() -> None:
                          for x in _jax.tree_util.tree_leaves(params)))
 
     phase = os.environ.get("MH_PHASE", "")
+    if phase == "xaxes":
+        from tensorflow_distributed_tpu.parallel.mesh import bootstrap
+        from tensorflow_distributed_tpu.train.checkpoint import _fetch_host
+
+        bootstrap()
+        with open(out_path, "w") as f:
+            json.dump(run_xaxes_scenarios(_fetch_host), f)
+        return
     if phase == "fsdp":
         # FSDP with the data axis spanning BOTH processes: params and
         # Adam slots are sharded across the process boundary, so the
