@@ -164,14 +164,17 @@ class TrainConfig:
 
     # --- mesh / parallelism ---------------------------------------------
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
-    # "fsdp": ZeRO-style sharding of params + optimizer slots over the
-    # data axis (parallel.sharding.param_sharding) — memory per device
-    # drops ~1/data for the large tensors; GSPMD inserts the
-    # all-gather/reduce-scatter pair. Composes with tensor/expert
-    # sharding annotations (FSDP only takes still-unsharded dims).
-    # "replicated" (default) matches the reference's every-worker-has-
-    # all-weights layout, minus its per-step ps pull/push.
-    param_partition: str = "replicated"  # replicated | fsdp
+    # "fsdp": ZeRO-3-style sharding of params + optimizer slots over
+    # the data axis (parallel.sharding.param_sharding) — memory per
+    # device drops ~1/data for the large tensors; GSPMD inserts the
+    # all-gather/reduce-scatter pair. "zero1": params stay replicated
+    # (no per-use gathers), only the optimizer slots shard — the usual
+    # best deal when params fit but Adam doubles don't. Both compose
+    # with tensor/expert annotations (only still-unsharded dims are
+    # taken). "replicated" (default) matches the reference's
+    # every-worker-has-all-weights layout, minus its per-step ps
+    # pull/push.
+    param_partition: str = "replicated"  # replicated | zero1 | fsdp
     # Remat (jax.checkpoint) policy for big models: none | full | dots
     remat: str = "none"
     # Pipeline schedule for model=pipelined_lm: "1f1b" (default —
@@ -263,19 +266,21 @@ class TrainConfig:
                 "pipeline_schedule=1f1b already accumulates per-"
                 "microbatch gradients; raise pipeline_microbatches "
                 "instead of grad_accum_steps")
-        if self.param_partition not in ("replicated", "fsdp"):
+        if self.param_partition not in ("replicated", "zero1", "fsdp"):
             raise ValueError(
                 f"unknown param_partition {self.param_partition!r}")
-        if self.param_partition == "fsdp" and self.model == "pipelined_lm":
+        if (self.param_partition != "replicated"
+                and self.model == "pipelined_lm"):
             # Pipelined stage params already carry the "pipe" axis and
             # are consumed stage-sliced inside a manual shard_map — a
             # second data-axis shard would have to be gathered inside
             # the schedule by hand, not by GSPMD. Use more pipeline
             # stages (or TP) for memory instead.
             raise ValueError(
-                "param_partition=fsdp does not compose with "
-                "model=pipelined_lm (stage params are shard_map-"
-                "managed); use mesh.pipe/mesh.model for memory")
+                f"param_partition={self.param_partition} does not "
+                f"compose with model=pipelined_lm (stage params are "
+                f"shard_map-managed); use mesh.pipe/mesh.model for "
+                f"memory")
         if self.pipeline_microbatches < 1:
             raise ValueError(
                 f"pipeline_microbatches must be >= 1, "

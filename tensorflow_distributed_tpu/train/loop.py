@@ -120,6 +120,7 @@ def _build_model_and_state(cfg: TrainConfig, mesh, task):
     tx = make_optimizer(cfg)
     state = create_train_state(model, tx, task.sample_input, mesh, cfg.seed,
                                fsdp=cfg.param_partition == "fsdp",
+                               opt_fsdp=cfg.param_partition == "zero1",
                                ema=cfg.ema_decay > 0)
     return model, state
 
@@ -178,11 +179,13 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
                                        label_smoothing=cfg.label_smoothing,
                                        ema_decay=cfg.ema_decay)
     else:
-        step_fn = make_train_step(mesh, cfg.seed, loss=task.loss,
-                                  batch_shardings=task.batch_shardings,
-                                  accum_steps=cfg.grad_accum_steps,
-                                  grad_norm_metric=cfg.log_grad_norm,
-                                  ema_decay=cfg.ema_decay)
+        step_fn = make_train_step(
+            mesh, cfg.seed, loss=task.loss,
+            batch_shardings=task.batch_shardings,
+            accum_steps=cfg.grad_accum_steps,
+            grad_norm_metric=cfg.log_grad_norm,
+            ema_decay=cfg.ema_decay,
+            replicate_params_out=cfg.param_partition == "zero1")
     eval_fn = make_eval_step(mesh, loss=task.eval_loss or task.loss,
                              batch_shardings=task.batch_shardings)
     logger.log_json({

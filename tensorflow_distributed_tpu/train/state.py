@@ -59,6 +59,7 @@ def create_train_state(model: nn.Module, tx: optax.GradientTransformation,
                        sample_input: jax.Array, mesh: Mesh, seed: int = 0,
                        fsdp: bool = False,
                        fsdp_min_size: int = FSDP_MIN_SIZE,
+                       opt_fsdp: bool = False,
                        ema: bool = False) -> TrainState:
     """Initialize params/opt-state and place them on the mesh.
 
@@ -76,6 +77,14 @@ def create_train_state(model: nn.Module, tx: optax.GradientTransformation,
     (mnist_python_m.py:177, SURVEY.md N4), this streams each shard
     once per use over ICI and never materializes full optimizer state
     anywhere.
+
+    ``opt_fsdp=True`` (config ``param_partition="zero1"``): ZeRO
+    stage 1 — params stay replicated (no per-use gathers in the
+    forward/backward) but the optimizer slots that mirror them shard
+    over "data". Each device updates its slice of the moments and the
+    param delta; GSPMD's one allgather on ``p + u`` re-replicates the
+    params. Memory: optimizer state drops ~1/data, the usual best
+    deal when params fit but Adam doubles don't.
     """
     # Abstract init to read partition metadata without allocating.
     abstract = jax.eval_shape(
@@ -116,17 +125,28 @@ def create_train_state(model: nn.Module, tx: optax.GradientTransformation,
     param_path_to_sharding = {
         path_key(path): sd
         for path, sd in jax.tree_util.tree_flatten_with_path(shardings)[0]}
+    if opt_fsdp and not fsdp:
+        # ZeRO-1: slots shard the way the params WOULD under FSDP,
+        # while the params themselves stay replicated.
+        slot_tree = param_sharding(mesh, abstract["params"], fsdp=True,
+                                   fsdp_min_size=fsdp_min_size)
+        slot_path_to_sharding = {
+            path_key(path): sd
+            for path, sd in jax.tree_util.tree_flatten_with_path(
+                slot_tree)[0]}
+    else:
+        slot_path_to_sharding = param_path_to_sharding
 
     def opt_leaf_sharding(path, leaf):
         keys = path_key(path)
         for i in range(len(keys)):
-            if keys[i:] in param_path_to_sharding:
+            if keys[i:] in slot_path_to_sharding:
                 # Slots that don't MIRROR the param (adafactor's
                 # factored v_row/v_col live at the param's path but
                 # with reduced shape) can't inherit its sharding.
                 if getattr(leaf, "shape", None) != param_shapes[keys[i:]]:
                     return replicated(mesh)
-                return param_path_to_sharding[keys[i:]]
+                return slot_path_to_sharding[keys[i:]]
         return replicated(mesh)
 
     abstract_opt = jax.eval_shape(tx.init, abstract_params)

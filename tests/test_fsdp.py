@@ -86,6 +86,39 @@ def test_fsdp_exact_parity_with_replicated(mesh8):
     assert int(s_fsdp.step) == 3
 
 
+def test_zero1_shards_slots_only_with_exact_parity(mesh8):
+    """ZeRO-1 (param_partition=\"zero1\"): params replicated, Adam m/v
+    sharded over data — same training run as fully-replicated."""
+    x = jnp.zeros((2, 28, 28, 1), jnp.float32)
+    s_z1 = create_train_state(_model(), optax.adam(1e-3), x, mesh8,
+                              seed=0, opt_fsdp=True)
+    pf = _shard_fractions(s_z1.params)
+    assert all(f == 1.0 for f in pf.values()), pf  # params replicated
+    of = _shard_fractions(s_z1.opt_state)
+    assert any(f == 1 / 8 for f in of.values()), of  # slots sharded
+
+    s_rep = _state(mesh8, fsdp=False)
+    step = make_train_step(mesh8, donate=False)
+    step_z1 = make_train_step(mesh8, donate=False,
+                              replicate_params_out=True)
+    for i in range(3):
+        batch = shard_batch(mesh8, _batch(seed=i))
+        s_rep, m_rep = step(s_rep, batch)
+        s_z1, m_z1 = step_z1(s_z1, batch)
+        np.testing.assert_allclose(float(m_rep["loss"]),
+                                   float(m_z1["loss"]), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=5e-6),
+        s_rep.params, s_z1.params)
+    # The defining layout invariant HOLDS THROUGH TRAINING: params are
+    # still replicated after 3 steps (GSPMD would otherwise propagate
+    # the slot sharding into them), slots still sharded.
+    assert all(f == 1.0 for f in _shard_fractions(s_z1.params).values())
+    assert any(f == 1 / 8
+               for f in _shard_fractions(s_z1.opt_state).values())
+
+
 def test_fsdp_composes_with_tensor_parallel(devices8):
     """On a data=4 x model=2 mesh, TP-annotated dims keep their axis
     and FSDP takes a *different* dim — both appear in the sharding."""
