@@ -83,6 +83,10 @@ class TrainConfig:
     # positions, composes with flash/ring attention; not supported by
     # pipelined_lm). Ignored by the vision models.
     pos_emb: str = "learned"  # learned | rope
+    # RoPE base frequency; raising it (e.g. 500000, the Llama-3 value)
+    # slows the rotation so longer contexts stay resolvable — the knob
+    # context-window extension actually turns.
+    rope_theta: float = 10000.0
     # Share the input embedding as the LM output projection (GPT-2
     # style weight tying). Transformer families only.
     tie_embeddings: bool = False
@@ -339,6 +343,13 @@ class TrainConfig:
             raise ValueError(f"unknown mode {self.mode!r}")
         if self.pos_emb not in ("learned", "rope"):
             raise ValueError(f"unknown pos_emb {self.pos_emb!r}")
+        if self.rope_theta <= 0:
+            raise ValueError(
+                f"rope_theta must be > 0, got {self.rope_theta}")
+        if self.rope_theta != 10000.0 and self.pos_emb != "rope":
+            raise ValueError(
+                "rope_theta has no effect without pos_emb=rope; "
+                "drop the flag or add --pos-emb rope")
         if self.pos_emb == "rope" and self.model == "pipelined_lm":
             raise ValueError(
                 "pipelined_lm does not support pos_emb=rope (positions "

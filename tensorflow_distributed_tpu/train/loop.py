@@ -95,6 +95,7 @@ def _build_model_and_state(cfg: TrainConfig, mesh, task):
         # in config.validate and its factory).
         if cfg.pos_emb != "learned":
             size_kw["pos_emb"] = cfg.pos_emb
+            size_kw["rope_theta"] = cfg.rope_theta
         if cfg.tie_embeddings:
             size_kw["tie_embeddings"] = cfg.tie_embeddings
     if cfg.model in ("bert_mlm", "gpt_lm", "moe_lm", "pipelined_lm"):

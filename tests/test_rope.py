@@ -118,6 +118,46 @@ def test_rope_trains_and_generates(devices8):
     assert np.isfinite(float(metrics["loss"]))
 
 
+def test_rope_theta_flows_and_changes_rotation():
+    """--rope-theta reaches the model; a higher base rotates slower
+    (positions stay resolvable at longer context)."""
+    from tensorflow_distributed_tpu.config import MeshConfig, TrainConfig
+    from tensorflow_distributed_tpu.train.loop import _build_model_and_state
+    from tensorflow_distributed_tpu.train.tasks import make_task
+
+    cfg = TrainConfig(model="gpt_lm", model_size="tiny", pos_emb="rope",
+                      rope_theta=500000.0, dataset="synthetic",
+                      mesh=MeshConfig(data=8))
+    cfg.validate()
+    mesh = make_mesh(cfg.mesh)
+    model, _ = _build_model_and_state(cfg, mesh, make_task(cfg, mesh))
+    assert model.cfg.rope_theta == 500000.0
+
+    # Higher theta -> strictly lower per-frequency rotation rate for
+    # every i >= 1 (i=0 is theta**0 = 1 for any base).
+    half = 8
+    i = np.arange(half)
+    f_slow = 500000.0 ** (-i / half)
+    f_fast = 10000.0 ** (-i / half)
+    assert f_slow[0] == f_fast[0] == 1.0
+    assert (f_slow[1:] < f_fast[1:]).all()
+
+    # Displacement comparison is only monotone while no angle wraps
+    # past pi (angles are mod 2*pi!). At pos=8 the largest fast i>=1
+    # angle is 8 * 10000**(-1/8) ~ 2.5 < pi, so smaller angles mean a
+    # vector strictly closer to unrotated; the equal i=0 contributions
+    # cancel.
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(1, 1, 2, 16)),
+                    jnp.float32)
+    pos = jnp.asarray([[8]])
+    d_slow = float(jnp.abs(rope_rotate(x, pos, theta=500000.0) - x).sum())
+    d_fast = float(jnp.abs(rope_rotate(x, pos, theta=10000.0) - x).sum())
+    assert d_slow < d_fast
+
+    with pytest.raises(ValueError, match="rope_theta"):
+        TrainConfig(model="gpt_lm", rope_theta=500000.0).validate()
+
+
 def test_pipelined_rejects_rope():
     from tensorflow_distributed_tpu.models.pipelined import pipelined_lm
 
