@@ -53,6 +53,13 @@ from tensorflow_distributed_tpu.parallel.sharding import path_key
 _TP_SUFFIX = [
     (("attn", "qkv", "kernel"), (None, None, AXIS_MODEL, None)),
     (("attn", "qkv", "bias"), (None, AXIS_MODEL, None)),
+    # GQA splits qkv into separate q and kv projections
+    # (models/transformer.py SelfAttention): q shards its head dim like
+    # qkv; the NARROW kv kernels stay replicated by design there too
+    # (n_kv_heads is typically smaller than the TP axis) — so no kv
+    # entry here, matching the non-pipelined layout exactly.
+    (("attn", "q", "kernel"), (None, AXIS_MODEL, None)),
+    (("attn", "q", "bias"), (AXIS_MODEL, None)),
     (("attn", "out", "kernel"), (AXIS_MODEL, None, None)),
     (("mlp", "up", "kernel"), (None, AXIS_MODEL)),
     (("mlp", "up", "bias"), (AXIS_MODEL,)),

@@ -79,7 +79,11 @@ def _fsdp_axis_choice(spec: list, shape: tuple, axis_size: int) -> list:
     carrying a mesh axis (tensor/expert-parallel annotations) are left
     alone, so FSDP composes with TP/EP instead of fighting it.
     """
-    if AXIS_DATA in spec:  # already data-annotated: nothing to add
+    # Spec entries may be tuples of axis names (legal PartitionSpec
+    # form) — flatten before testing, or a tuple containing "data"
+    # would get the axis added twice and NamedSharding would raise.
+    if any(AXIS_DATA in (e if isinstance(e, tuple) else (e,))
+           for e in spec):  # already data-annotated: nothing to add
         return spec
     best = -1
     for d, n in enumerate(shape):

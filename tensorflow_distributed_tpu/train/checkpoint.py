@@ -257,7 +257,11 @@ def restore(ckpt_dir: str, state: Any, step: Optional[int] = None) -> Any:
     # EMA toggled between the saved run and this config must not brick
     # the restore: newly-enabled EMA seeds from the restored params
     # (the natural warm start); newly-disabled EMA drops the average.
-    if (isinstance(raw, dict) and "ema" in raw and hasattr(state, "ema")):
+    # Checkpoints written before TrainState grew the ema field have no
+    # "ema" key at all — from_state_dict would raise on the missing
+    # field even with EMA disabled, so absence means "EMA off".
+    if isinstance(raw, dict) and hasattr(state, "ema"):
+        raw.setdefault("ema", None)
         want, have = state.ema is not None, raw["ema"] is not None
         if want and not have:
             raw["ema"] = raw["params"]

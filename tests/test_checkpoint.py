@@ -142,3 +142,26 @@ def test_keep_prunes_old(tmp_path, mesh8):
 def test_restore_missing_raises(tmp_path, mesh8):
     with pytest.raises(FileNotFoundError):
         ckpt.restore(str(tmp_path / "nope"), _state(mesh8))
+
+
+def test_restore_pre_ema_checkpoint(tmp_path, mesh8):
+    """A checkpoint written before TrainState grew the ema field (no
+    "ema" key in the serialized dict) must still restore — absence
+    means "EMA off", not a from_state_dict missing-field error."""
+    from flax import serialization
+
+    state = _state(mesh8)
+    path = ckpt.save(str(tmp_path), state)
+    fname = os.path.join(path, "state.msgpack")
+    with open(fname, "rb") as f:
+        raw = serialization.msgpack_restore(f.read())
+    raw.pop("ema", None)  # simulate the pre-EMA on-disk layout
+    with open(fname, "wb") as f:
+        f.write(serialization.msgpack_serialize(raw))
+
+    restored = ckpt.restore(str(tmp_path), _state(mesh8))
+    assert restored.ema is None
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)),
+        jax.device_get(state.params), jax.device_get(restored.params))
