@@ -78,10 +78,10 @@ class TrainConfig:
     # Transformer-family size preset ("base"/"small"/"tiny"); empty =
     # the family's default. Ignored by models without presets.
     model_size: str = ""
-    # Position encoding for the transformer families: "learned"
-    # (additive table, GPT-2/BERT) or "rope" (rotary — relative
-    # positions, composes with flash/ring attention; not supported by
-    # pipelined_lm). Ignored by the vision models.
+    # Position encoding for the transformer families (pipelined_lm
+    # included): "learned" (additive table, GPT-2/BERT) or "rope"
+    # (rotary — relative positions, composes with flash/ring attention
+    # and the pipeline schedules). Ignored by the vision models.
     pos_emb: str = "learned"  # learned | rope
     # RoPE base frequency; raising it (e.g. 500000, the Llama-3 value)
     # slows the rotation so longer contexts stay resolvable — the knob
@@ -97,6 +97,12 @@ class TrainConfig:
     # MLP nonlinearity for the transformer families: "gelu" (GPT-2/
     # BERT) or "swiglu" (gated, Llama-style).
     mlp_variant: str = "gelu"  # gelu | swiglu
+    # Megatron vocab-parallel embedding: shard the token table's vocab
+    # dim (and the tied logits) over mesh.model. Worth it at real
+    # vocabs (50257 x 768 + Adam slots ~ 460 MB/replica); pointless at
+    # mesh.model == 1. Not available for pipelined_lm (its shell params
+    # carry no TP metadata).
+    shard_vocab: bool = False
     # Block normalization: "layernorm" or "rmsnorm" (scale-only,
     # Llama-style). Transformer families only.
     norm: str = "layernorm"  # layernorm | rmsnorm
@@ -112,6 +118,17 @@ class TrainConfig:
     # 256 byte values; no tokenizer, no egress).
     dataset: str = "mnist"
     data_dir: str = "/tmp/mnist-data"  # reference default, mnist_python_m.py:50
+    # Sequence length for the LM families: the data stream's window AND
+    # the model's max_len. 0 = the family default (128). This is the
+    # long-context knob: --seq-len 8192 --mesh.seq 8 trains with ring
+    # attention over the seq axis (pair with --remat dots and
+    # --pos-emb rope --rope-theta 500000 at real length). Ignored by
+    # the vision models.
+    seq_len: int = 0
+    # Vocabulary of the SYNTHETIC LM token streams (and the model built
+    # over them). 0 = the default (64). dataset="text" ignores it (the
+    # byte corpus pins vocab to 256).
+    synthetic_vocab: int = 0
     # Global batch. Reference: 128 per worker x 2 workers = 256 global
     # (mnist_python_m.py:70, replicas_to_aggregate :62-65).
     batch_size: int = 256
@@ -350,18 +367,43 @@ class TrainConfig:
             raise ValueError(
                 "rope_theta has no effect without pos_emb=rope; "
                 "drop the flag or add --pos-emb rope")
-        if self.pos_emb == "rope" and self.model == "pipelined_lm":
-            raise ValueError(
-                "pipelined_lm does not support pos_emb=rope (positions "
-                "are not threaded through the microbatch schedule)")
-        if self.tie_embeddings and self.model == "pipelined_lm":
-            raise ValueError(
-                "pipelined_lm does not support tie_embeddings (the "
-                "embedding shell and head are separate pipeline-stage "
-                "params)")
         if self.n_kv_heads < 0:
             raise ValueError(
                 f"n_kv_heads must be >= 0, got {self.n_kv_heads}")
+        lm_families = ("bert_mlm", "gpt_lm", "moe_lm", "pipelined_lm")
+        if self.shard_vocab and self.model not in lm_families:
+            raise ValueError(
+                f"shard_vocab has no effect on model={self.model!r} "
+                f"(transformer families only); drop the flag")
+        if self.shard_vocab and self.model == "pipelined_lm":
+            raise ValueError(
+                "shard_vocab is not available for pipelined_lm (the "
+                "embedding shell carries no TP metadata; use mesh.pipe "
+                "for memory)")
+        if self.seq_len < 0 or self.seq_len == 1:
+            raise ValueError(
+                f"seq_len must be 0 (family default) or >= 2, "
+                f"got {self.seq_len}")
+        if self.seq_len and self.model not in lm_families:
+            raise ValueError(
+                f"seq_len has no effect on model={self.model!r} "
+                f"(LM families only); drop the flag")
+        if self.seq_len and self.seq_len % self.mesh.seq:
+            raise ValueError(
+                f"seq_len {self.seq_len} not divisible by mesh.seq "
+                f"{self.mesh.seq} (tokens shard the sequence dim over "
+                f"the seq axis)")
+        if self.synthetic_vocab < 0:
+            raise ValueError(
+                f"synthetic_vocab must be >= 0, got {self.synthetic_vocab}")
+        if self.synthetic_vocab and self.model not in lm_families:
+            raise ValueError(
+                f"synthetic_vocab has no effect on model="
+                f"{self.model!r} (LM families only); drop the flag")
+        if self.synthetic_vocab and self.dataset == "text":
+            raise ValueError(
+                "synthetic_vocab has no effect with dataset='text' "
+                "(the byte corpus pins vocab to 256); drop the flag")
         if self.mlp_variant not in ("gelu", "swiglu"):
             raise ValueError(f"unknown mlp_variant {self.mlp_variant!r}")
         if (self.mlp_variant != "gelu"

@@ -93,21 +93,38 @@ class _Shell(nn.Module):
         self.tok_emb = nn.Embed(cfg.vocab_size + self.extra_vocab,
                                 cfg.d_model, embedding_init=_dense_init(),
                                 name="tok_emb")
-        self.pos_emb = nn.Embed(cfg.max_len, cfg.d_model,
-                                embedding_init=_dense_init(),
-                                name="pos_emb")
+        if cfg.pos_emb == "learned":
+            # rope has no additive table — q/k rotate inside each block.
+            self.pos_emb = nn.Embed(cfg.max_len, cfg.d_model,
+                                    embedding_init=_dense_init(),
+                                    name="pos_emb")
         self.ln_f = _norm(cfg, "ln_f")
-        self.lm_head = nn.Dense(cfg.vocab_size,
-                                kernel_init=_dense_init(),
-                                dtype=cfg.compute_dtype, name="lm_head")
+        if not cfg.tie_embeddings:
+            # Tied: the head IS tok_emb (both live in this one shell
+            # module, so tying is shell-local — same scheme as
+            # models/transformer.py's TransformerLM).
+            self.lm_head = nn.Dense(cfg.vocab_size,
+                                    kernel_init=_dense_init(),
+                                    dtype=cfg.compute_dtype,
+                                    name="lm_head")
 
     def embed(self, tokens: jax.Array) -> jax.Array:
         L = tokens.shape[1]
-        x = self.tok_emb(tokens) + self.pos_emb(jnp.arange(L)[None, :])
+        x = self.tok_emb(tokens)
+        if self.cfg.pos_emb == "learned":
+            x = x + self.pos_emb(jnp.arange(L)[None, :])
         return x.astype(self.cfg.compute_dtype)
 
     def head(self, x: jax.Array) -> jax.Array:
-        x = self.ln_f(x).astype(self.cfg.compute_dtype)
+        cfg = self.cfg
+        x = self.ln_f(x).astype(cfg.compute_dtype)
+        if cfg.tie_embeddings:
+            # Shared-table logits in compute dtype (bf16 MXU path),
+            # sentinel rows sliced off — matching TransformerLM's tied
+            # head exactly so cross-family parity is bitwise-testable.
+            table = self.tok_emb.embedding.astype(cfg.compute_dtype)
+            logits = jnp.einsum("...d,vd->...v", x, table)
+            return logits[..., :cfg.vocab_size].astype(jnp.float32)
         return self.lm_head(x).astype(jnp.float32)
 
     def __call__(self, tokens: jax.Array) -> jax.Array:  # init path only
@@ -168,8 +185,11 @@ class PipelinedLM:
         # names) would be stale on the rank-N+2 stacked leaves — the
         # pipelined variant enforces model=seq=1, so dropping it is
         # sound; pipe-axis boxes are added below with full-rank names.
+        pos = (jnp.arange(tokens.shape[1])[None, :]
+               if cfg.pos_emb == "rope" else None)
         stacked = jax.vmap(lambda k: nn.meta.unbox(
-            self._block.init(k, x, False)["params"]))(layer_keys)
+            self._block.init(k, x, False,
+                             positions=pos)["params"]))(layer_keys)
         staged = stack_stage_params(stacked,
                                     self.mesh.shape[AXIS_PIPE])
         boxed = jax.tree_util.tree_map_with_path(
@@ -196,6 +216,13 @@ class PipelinedLM:
 
         def stage_fn(stage_params, x_mb, key=None):
             lps = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
+            # RoPE positions are microbatch-INVARIANT: microbatches
+            # slice the batch dim, never the sequence, so every
+            # (stage, microbatch) sees the same arange(L) — derivable
+            # right here from the activation shape, no threading
+            # through the schedule needed.
+            pos = (jnp.arange(x_mb.shape[1])[None, :]
+                   if self.cfg.pos_emb == "rope" else None)
 
             def one_layer(carry, xs):
                 x, aux = carry
@@ -205,14 +232,14 @@ class PipelinedLM:
                 if with_aux:
                     y, mut = self._block.apply(
                         {"params": layer_p}, x, train, rngs=r,
-                        mutable=["moe_aux"])
+                        positions=pos, mutable=["moe_aux"])
                     layer_aux = collect_aux(mut["moe_aux"])
                     aux = {k: aux[k] + jnp.asarray(layer_aux[k],
                                                    jnp.float32)
                            for k in AUX_NAMES}
                 else:
                     y = self._block.apply({"params": layer_p}, x, train,
-                                          rngs=r)
+                                          rngs=r, positions=pos)
                 return (y, aux), None
             if self.cfg.remat:
                 # --remat for the pipelined family: rematerialize each
@@ -291,16 +318,6 @@ def pipelined_lm(mesh: Mesh, size: str = "tiny", causal: bool = True,
     --pipeline-microbatches (config.TrainConfig)."""
     overrides["causal"] = causal
     overrides["tp_partitioning"] = False  # see TransformerConfig notes
-    if overrides.get("pos_emb", "learned") == "rope":
-        # The pipeline's stage_fn runs blocks without threading token
-        # positions through the microbatch schedule; learned positions
-        # enter once at the embedding shell instead.
-        raise ValueError("pipelined_lm does not support pos_emb='rope'")
-    if overrides.get("tie_embeddings", False):
-        # The embedding shell and lm_head are separate stage-owned
-        # params here; silently building an untied model would betray
-        # the flag.
-        raise ValueError("pipelined_lm does not support tie_embeddings")
     # Pallas flash attention works inside the pipe via a nested
     # shard_map (see PipelinedLM.__init__); default on like the rest
     # of the GPT family, opt out with use_flash=False.

@@ -20,8 +20,9 @@ Layout conventions (matched to ``parallel.sharding.param_sharding``):
     out kernel   [H, Dh, d_model]      P("model", None, None)
     mlp up       [d_model, d_ff]       P(None, "model")
     mlp down     [d_ff, d_model]       P("model", None)
-    embeddings   [vocab, d_model]      replicated (small at test scale;
-                                       vocab-sharding is a config knob)
+    embeddings   [vocab, d_model]      replicated by default;
+                                       P("model", None) with shard_vocab
+                                       (Megatron vocab-parallel table)
 """
 
 from __future__ import annotations
@@ -105,6 +106,15 @@ class TransformerConfig:
     # MLP nonlinearity: "gelu" (GPT-2/BERT two-matrix MLP) or "swiglu"
     # (gated: silu(gate(x)) * up(x) -> down; the Llama-family MLP).
     mlp_variant: str = "gelu"  # gelu | swiglu
+    # Shard the token-embedding table's vocab dim over the "model"
+    # axis (Megatron's vocab-parallel embedding). At vocab 50257 the
+    # table + its Adam slots are ~460 MB f32 per replica on GPT-2-small
+    # — the knob that splits them across TP ranks. The untied lm_head
+    # already shards vocab this way; this extends it to the input table
+    # and the tied path (logits come out vocab-sharded; GSPMD inserts
+    # the gather/reduce where the loss needs them). Requires
+    # tp_partitioning (i.e. not the pipelined family).
+    shard_vocab: bool = False
     # Block normalization: "layernorm" (mean+variance, bias+scale) or
     # "rmsnorm" (scale-only, no mean subtraction — cheaper and the
     # modern default). Both run in f32.
@@ -379,8 +389,26 @@ class TransformerLM(nn.Module):
             raise ValueError(f"pos_emb {cfg.pos_emb!r}; "
                              f"have ('learned', 'rope')")
         B, L = tokens.shape
-        emb = nn.Embed(cfg.vocab_size + self.extra_vocab, cfg.d_model,
-                       embedding_init=_dense_init(), name="tok_emb")
+        emb_init = _dense_init()
+        vocab_pad = 0
+        if cfg.shard_vocab:
+            if not cfg.tp_partitioning:
+                raise ValueError(
+                    "shard_vocab needs tp_partitioning (the pipelined "
+                    "family manages its shell params without TP "
+                    "metadata — use mesh.pipe for its memory)")
+            emb_init = nn.with_partitioning(emb_init, (AXIS_MODEL, None))
+            # Megatron-style vocab padding: round the table rows up to
+            # a multiple of the TP axis so the shard is well-formed at
+            # ANY real vocab (50257 is odd; BERT adds a sentinel row).
+            # Padded rows are never looked up, and padded logits are
+            # sliced off below before the loss sees them.
+            tp = (dict(self.mesh.shape).get(AXIS_MODEL, 1)
+                  if self.mesh is not None else 1)
+            vocab_pad = (-(cfg.vocab_size + self.extra_vocab)) % tp
+        emb = nn.Embed(cfg.vocab_size + self.extra_vocab + vocab_pad,
+                       cfg.d_model,
+                       embedding_init=emb_init, name="tok_emb")
         x = emb(tokens)
         if positions is None:
             if decode:
@@ -417,19 +445,26 @@ class TransformerLM(nn.Module):
         if cfg.tie_embeddings:
             # Cast the shared table to compute dtype so the logits
             # matmul (the model's largest) stays on the bf16 MXU path
-            # like the untied head. Tied logits are computed
-            # replicated — the table is replicated by design here
-            # (vocab-sharding is a config knob, module docstring).
+            # like the untied head. With shard_vocab the table rows are
+            # split over "model", so the einsum emits vocab-sharded
+            # logits (same layout as the untied sharded head); without
+            # it the tied logits compute replicated.
             table = emb.embedding.astype(cfg.compute_dtype)
             logits = jnp.einsum("...d,vd->...v",
                                 x.astype(cfg.compute_dtype), table)
             logits = logits[..., :cfg.vocab_size]  # drop sentinel rows
         else:
+            # Same padding treatment for the untied head's output dim
+            # (the kernel's vocab dim is TP-sharded whenever
+            # tp_partitioning is on).
+            head_pad = ((-cfg.vocab_size) % tp if cfg.shard_vocab else 0)
             logits = nn.Dense(
-                cfg.vocab_size,
+                cfg.vocab_size + head_pad,
                 kernel_init=_maybe_partitioned(cfg, (None, AXIS_MODEL)),
                 dtype=cfg.compute_dtype, name="lm_head")(
                 x.astype(cfg.compute_dtype))
+            if head_pad:
+                logits = logits[..., :cfg.vocab_size]
         return logits.astype(jnp.float32)
 
 

@@ -90,17 +90,17 @@ def _build_model_and_state(cfg: TrainConfig, mesh, task):
     if cfg.model == "moe_lm" or cfg.moe_experts > 0:
         size_kw["moe_top_k"] = cfg.moe_top_k
         size_kw["moe_capacity_factor"] = cfg.moe_capacity_factor
-    if cfg.model in ("bert_mlm", "gpt_lm", "moe_lm"):
-        # Non-pipelined transformer knobs (pipelined_lm rejects both
-        # in config.validate and its factory).
+    if cfg.model in ("bert_mlm", "gpt_lm", "moe_lm", "pipelined_lm"):
+        # Transformer-family knobs, shared by the pipelined variant
+        # (rope positions are derived inside its stage_fn; tying is
+        # local to its embedding shell — models/pipelined.py).
         if cfg.pos_emb != "learned":
             size_kw["pos_emb"] = cfg.pos_emb
             size_kw["rope_theta"] = cfg.rope_theta
         if cfg.tie_embeddings:
             size_kw["tie_embeddings"] = cfg.tie_embeddings
-    if cfg.model in ("bert_mlm", "gpt_lm", "moe_lm", "pipelined_lm"):
-        # Block-level knobs live inside SelfAttention/Mlp/Block, which
-        # the pipelined family shares — no positions to thread.
+        if cfg.shard_vocab:
+            size_kw["shard_vocab"] = cfg.shard_vocab
         if cfg.n_kv_heads:
             size_kw["n_kv_heads"] = cfg.n_kv_heads
         if cfg.mlp_variant != "gelu":
@@ -110,6 +110,14 @@ def _build_model_and_state(cfg: TrainConfig, mesh, task):
         if cfg.dataset == "text":
             # Byte-level corpus: the vocabulary IS the 256 byte values.
             size_kw["vocab_size"] = 256
+        elif cfg.synthetic_vocab:
+            size_kw["vocab_size"] = cfg.synthetic_vocab
+        if cfg.seq_len:
+            # The model's position budget tracks the training window —
+            # the knob that makes long context trainable from the CLI
+            # (ring attention engages via mesh.seq; the data stream
+            # gets the same length through train.tasks).
+            size_kw["max_len"] = cfg.seq_len
     if cfg.model == "pipelined_lm":
         size_kw["num_microbatches"] = cfg.pipeline_microbatches
     model = build_model(

@@ -167,9 +167,16 @@ def _make_lm_task(cfg: TrainConfig, mesh: Mesh, objective: str,
     "clm" (next-token), with a "moe_" prefix selecting the MoE-aware
     loss (masked CE + router losses). All use the {tokens, targets,
     mask} layout — what differs is the data generator and the model's
-    attention direction (TransformerConfig.causal)."""
+    attention direction (TransformerConfig.causal).
+
+    ``cfg.seq_len`` / ``cfg.synthetic_vocab`` override the defaults —
+    the long-context path (--seq-len 8192 --mesh.seq 8) flows through
+    here into the stream AND (via train.loop) the model's max_len."""
     from tensorflow_distributed_tpu.data.lm import (
         LmBatcher, synthetic_clm, synthetic_mlm)
+
+    seq_len = cfg.seq_len or seq_len
+    vocab_size = cfg.synthetic_vocab or vocab_size
 
     if cfg.dataset == "text":
         # Byte-level causal LM over a LOCAL file (data.lm.text_clm):
@@ -232,7 +239,11 @@ def _make_lm_task(cfg: TrainConfig, mesh: Mesh, objective: str,
         eval_loss=(make_moe_loss(cfg.moe_aux_weight, cfg.moe_zloss_weight)
                    if moe else mlm_loss),
         batch_shardings=mlm_batch_shardings(mesh),
-        sample_input=np.zeros((2, seq_len), np.int32), seq_axis=1,
+        # Init executes the forward; ring attention's shard_map needs
+        # the sample batch divisible by the data axis.
+        sample_input=np.zeros(
+            (max(2, dict(mesh.shape).get(AXIS_DATA, 1)), seq_len),
+            np.int32), seq_axis=1,
         train_stream=batcher.forever, eval_batches=eval_batches,
         eval_size=len(val_ds), steps_per_epoch=batcher.steps_per_epoch)
 

@@ -158,11 +158,13 @@ def test_rope_theta_flows_and_changes_rotation():
         TrainConfig(model="gpt_lm", rope_theta=500000.0).validate()
 
 
-def test_pipelined_rejects_rope():
+def test_pipelined_accepts_rope_and_tying():
+    """Round-4 change: the pipelined family supports RoPE (positions
+    derived inside stage_fn) and tied embeddings (shell-local) — the
+    former walls are gone. Parity with the non-pipelined family is
+    pinned in tests/test_pipelined_modern.py."""
     from tensorflow_distributed_tpu.models.pipelined import pipelined_lm
 
     mesh = make_mesh(MeshConfig(data=8))
-    with pytest.raises(ValueError, match="rope"):
-        pipelined_lm(mesh, pos_emb="rope")
-    with pytest.raises(ValueError, match="tie_embeddings"):
-        pipelined_lm(mesh, tie_embeddings=True)
+    m = pipelined_lm(mesh, pos_emb="rope", tie_embeddings=True)
+    assert m.cfg.pos_emb == "rope" and m.cfg.tie_embeddings
