@@ -61,17 +61,22 @@ def matmul_params(params) -> int:
     return total
 
 
+def attn_flops_per_token_fwd(cfg) -> float:
+    """QK^T + PV FLOPs per token, one forward; halved for causal
+    because the kernel skips masked blocks."""
+    attn = 4.0 * cfg.max_len * cfg.d_model * cfg.n_layers
+    return attn / 2.0 if cfg.causal else attn
+
+
 def flops_per_token(params, cfg) -> float:
     """Model FLOPs per trained token, fwd+bwd (see module docstring)."""
     n = matmul_params(params)
-    attn_fwd = 4.0 * cfg.max_len * cfg.d_model * cfg.n_layers
-    if cfg.causal:
-        attn_fwd /= 2.0
-    return 3.0 * (2.0 * n + attn_fwd)
+    return 3.0 * (2.0 * n + attn_flops_per_token_fwd(cfg))
 
 
 def _build(size: str, seq_len: int, use_flash: bool, remat: str,
-           batch: int, mesh, seed: int = 0, pipeline_mb: int = 0):
+           batch: int, mesh, seed: int = 0, pipeline_mb: int = 0,
+           pipeline_backward: str = "recompute"):
     import jax
     import numpy as np
     import optax
@@ -104,7 +109,8 @@ def _build(size: str, seq_len: int, use_flash: bool, remat: str,
         seed)
     if pipeline_mb > 0:
         step = make_1f1b_train_step(
-            model, mesh, seed, batch_shardings=mlm_batch_shardings(mesh))
+            model, mesh, seed, batch_shardings=mlm_batch_shardings(mesh),
+            backward=pipeline_backward)
     else:
         step = make_train_step(mesh, seed, loss=mlm_loss,
                                batch_shardings=mlm_batch_shardings(mesh))
@@ -145,6 +151,10 @@ def main(argv=None) -> None:
                         choices=["none", "full", "dots"])
     parser.add_argument("--skip-ab", action="store_true",
                         help="skip the flash-vs-XLA attention A/B")
+    parser.add_argument("--pipeline-backward", default="recompute",
+                        choices=["recompute", "stash"],
+                        help="1F1B backward strategy (see parallel."
+                        "pipeline.pipeline_value_and_grad)")
     parser.add_argument("--pipeline-microbatches", type=int, default=0,
                         help="> 0: run the pipelined flagship instead "
                         "(1F1B schedule, flash inside the pipe "
@@ -154,6 +164,12 @@ def main(argv=None) -> None:
     parser.add_argument("--out", default="",
                         help="also write the JSON lines to this file")
     args = parser.parse_args(argv)
+    if args.pipeline_backward != "recompute" and not args.pipeline_microbatches:
+        # Same convention as TrainConfig.validate: reject knobs that
+        # would be silently ignored (the backward strategy only exists
+        # in the pipelined 1F1B step).
+        parser.error("--pipeline-backward requires "
+                     "--pipeline-microbatches > 0")
 
     import jax
     import numpy as np
@@ -174,7 +190,7 @@ def main(argv=None) -> None:
 
     model, state, step, batch = _build(
         args.size, args.seq_len, True, args.remat, args.batch, mesh,
-        pipeline_mb=pmb)
+        pipeline_mb=pmb, pipeline_backward=args.pipeline_backward)
     n_params = param_count(state.params)
     fpt = flops_per_token(state.params, model.cfg)
 
@@ -193,6 +209,7 @@ def main(argv=None) -> None:
             "device": kind, "devices": n_dev, "remat": args.remat}
     if pmb > 0:
         meta["pipeline_microbatches"] = pmb
+        meta["pipeline_backward"] = args.pipeline_backward
     lines = [
         {"metric": "lm_train_tokens_per_sec", "value": round(tok_s, 1),
          "unit": "tokens/sec", **meta},
@@ -202,6 +219,20 @@ def main(argv=None) -> None:
          "value": round(100 * mfu, 2) if mfu is not None else None,
          "unit": "%", **meta},
     ]
+    if pmb > 0 and args.pipeline_backward == "recompute" and peak:
+        # Model MFU charges 3x-forward per token, but 1F1B-recompute
+        # EXECUTES 4x-forward for the block stack (each backward tick
+        # re-runs the stage forward from the stashed input). Report the
+        # hardware utilization too so the schedule's remat trade isn't
+        # misread as MXU inefficiency; model MFU stays the headline
+        # (useful work per second).
+        blocks_n = matmul_params(state.params["blocks"])
+        hw_fpt = fpt + 2.0 * blocks_n + attn_flops_per_token_fwd(
+            model.cfg)
+        hw_mfu = tok_s * hw_fpt / (peak * n_dev)
+        lines.append({"metric": "lm_train_hw_mfu",
+                      "value": round(100 * hw_mfu, 2), "unit": "%",
+                      **meta})
 
     if not args.skip_ab and pmb > 0:
         import sys

@@ -176,7 +176,8 @@ def pipeline_value_and_grad(stage_fn: Callable[..., jax.Array],
                             x: jax.Array, aux: Any, mesh: Mesh,
                             num_microbatches: int, rng: Any = None,
                             cotangent_scale: Any = 1.0,
-                            stage_aux_cotangent: Any = None):
+                            stage_aux_cotangent: Any = None,
+                            backward: str = "recompute"):
     """1F1B pipeline: hand-scheduled forward AND backward in one pass.
 
     GPipe (``pipeline_apply`` + outer AD) must finish every forward
@@ -222,6 +223,31 @@ def pipeline_value_and_grad(stage_fn: Callable[..., jax.Array],
     upstream activations exactly like any other loss term. The return
     grows a 4th element: aux sums over all (stage, microbatch) pairs
     — (value_sum, metrics_sums, aux_sums, grads).
+
+    ``backward``: what each stage stashes between a microbatch's
+    forward and backward ticks.
+      "recompute" (default) — stash the stage INPUT; the backward tick
+        re-runs the stage forward under jax.vjp to rebuild residuals.
+        Minimal memory (D copies of one activation), but every
+        microbatch pays the stage forward twice: 4x forward-equivalent
+        FLOPs per token instead of AD's 3x — measured as the dominant
+        pipelined-MFU cost on chip (24.8% vs 46.5% unpipelined at
+        matched shapes, LMBENCH_r04 vs r03_pipelined sweep).
+      "stash" — run jax.vjp at the FORWARD tick and stash the vjp
+        residuals themselves: ``jax.vjp``'s pulled-back function is a
+        ``jax.tree_util.Partial`` — a pytree — so its leaves stash
+        into per-slot ring buffers like any activation, and the
+        backward tick re-attaches them to the (static) treedef
+        obtained via ``jax.eval_shape`` — no recompute, Megatron's
+        default memory/compute trade. Costs D copies of the stage's
+        FULL residual set: every layer's activations AND the stage
+        weight matrices the transpose needs (vjp residuals include
+        them, and the ring buffer stores all leaves — hoisting the
+        microbatch-invariant weight leaves out is a known possible
+        optimization, unimplemented). Measured on v5e (PARITY.md):
+        that HBM traffic makes stash SLOWER than recompute at
+        GPT-2-small shapes — it stays opt-in for configurations
+        where the trade flips (short stages, faster HBM).
     """
     S = mesh.shape[AXIS_PIPE]
     M = num_microbatches
@@ -230,6 +256,10 @@ def pipeline_value_and_grad(stage_fn: Callable[..., jax.Array],
         raise ValueError(f"batch {B} not divisible by microbatches {M}")
     if M < S:
         raise ValueError(f"need microbatches >= stages ({M} < {S})")
+    if backward not in ("recompute", "stash"):
+        raise ValueError(f"backward {backward!r}; "
+                         "have ('recompute', 'stash')")
+    stash_residuals = backward == "stash"
     mb = B // M
     D = min(2 * S, M)  # stash depth >= max in-flight (2S - 1)
 
@@ -287,6 +317,22 @@ def pipeline_value_and_grad(stage_fn: Callable[..., jax.Array],
         zero_dp_step = jax.tree_util.tree_map(
             lambda p: jnp.zeros(p.shape, p.dtype), params)
 
+        if stash_residuals:
+            # The vjp pullback is a Partial — a pytree. Abstract-trace
+            # it once for the (static) treedef + residual shapes; the
+            # treedef is microbatch-invariant (tracing is shape-based;
+            # the dropout key's VALUE lives in the stashed leaves, so
+            # the right fwd-tick masks reach the backward).
+            vjp_abs = jax.eval_shape(
+                lambda p, xx: jax.vjp(with_key(jnp.int32(0)), p, xx)[1],
+                params, xm[0])
+            res_treedef = jax.tree_util.tree_structure(vjp_abs)
+            stash0 = tuple(
+                jnp.zeros((D,) + l.shape, l.dtype)
+                for l in jax.tree_util.tree_leaves(vjp_abs))
+        else:
+            stash0 = jnp.zeros((D,) + xm[0].shape, xm.dtype)
+
         def tick(carry, t):
             (fwd_msg, bwd_msg, stash, dp_acc, dlast_acc, dx_buf,
              val_acc, met_acc, aux_acc) = carry
@@ -306,9 +352,18 @@ def pipeline_value_and_grad(stage_fn: Callable[..., jax.Array],
                 fwd_msg)
 
             def fwd_run(inp, stash):
+                slot = jnp.mod(mf_c, D)
+                if stash_residuals:
+                    (y, aux_v), vjp_fn = jax.vjp(with_key(mf_c), params,
+                                                 inp)
+                    stash = tuple(
+                        jax.lax.dynamic_update_index_in_dim(sb, l, slot, 0)
+                        for sb, l in zip(
+                            stash, jax.tree_util.tree_leaves(vjp_fn)))
+                    return y, aux_v, stash
                 y, aux_v = with_key(mf_c)(params, inp)
                 stash = jax.lax.dynamic_update_index_in_dim(
-                    stash, inp, jnp.mod(mf_c, D), 0)
+                    stash, inp, slot, 0)
                 return y, aux_v, stash
 
             def fwd_skip(inp, stash):
@@ -347,9 +402,17 @@ def pipeline_value_and_grad(stage_fn: Callable[..., jax.Array],
             mb_c = jnp.clip(mbk, 0, M - 1)
 
             def bwd_run(stash, hdy, bwd_msg):
-                x_saved = jax.lax.dynamic_index_in_dim(
-                    stash, jnp.mod(mb_c, D), 0, keepdims=False)
+                slot = jnp.mod(mb_c, D)
                 cot = jnp.where(is_last, hdy, bwd_msg)
+                if stash_residuals:
+                    vjp_fn = jax.tree_util.tree_unflatten(
+                        res_treedef,
+                        [jax.lax.dynamic_index_in_dim(sb, slot, 0,
+                                                      keepdims=False)
+                         for sb in stash])
+                    return vjp_fn((cot.astype(xm.dtype), aux_seed))
+                x_saved = jax.lax.dynamic_index_in_dim(
+                    stash, slot, 0, keepdims=False)
                 _, vjp_fn = jax.vjp(with_key(mb_c), params, x_saved)
                 return vjp_fn((cot.astype(x_saved.dtype), aux_seed))
 
@@ -375,8 +438,7 @@ def pipeline_value_and_grad(stage_fn: Callable[..., jax.Array],
                     val_acc, met_acc, aux_acc), None
 
         zero_x = jnp.zeros_like(xm[0])
-        carry0 = (zero_x, zero_x,
-                  jnp.zeros((D,) + xm[0].shape, xm.dtype),
+        carry0 = (zero_x, zero_x, stash0,
                   zero_dp, zero_dlast,
                   jnp.zeros((M,) + xm[0].shape, x.dtype),
                   jnp.zeros((), jnp.float32), zero_met, zero_aux)

@@ -186,7 +186,8 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
                                        moe_zloss_weight=cfg.moe_zloss_weight,
                                        grad_norm_metric=cfg.log_grad_norm,
                                        label_smoothing=cfg.label_smoothing,
-                                       ema_decay=cfg.ema_decay)
+                                       ema_decay=cfg.ema_decay,
+                                       backward=cfg.pipeline_backward)
     else:
         step_fn = make_train_step(
             mesh, cfg.seed, loss=task.loss,

@@ -54,7 +54,8 @@ def make_1f1b_train_step(model: PipelinedLM, mesh: Mesh, seed: int = 0,
                          moe_zloss_weight: float = 0.0,
                          grad_norm_metric: bool = False,
                          label_smoothing: float = 0.0,
-                         ema_decay: float = 0.0
+                         ema_decay: float = 0.0,
+                         backward: str = "recompute"
                          ) -> Callable[[TrainState, Any],
                                        Tuple[TrainState, Dict]]:
     """Build the jitted 1F1B step for a PipelinedLM.
@@ -66,6 +67,11 @@ def make_1f1b_train_step(model: PipelinedLM, mesh: Mesh, seed: int = 0,
     as extra vjp cotangents, so the objective matches the non-pipelined
     MoE loss: CE + moe_aux_weight * load_balance
     + moe_zloss_weight * z_loss (train.tasks.make_moe_loss).
+
+    ``backward`` forwards to pipeline_value_and_grad: "recompute"
+    (input stash + per-stage remat — minimal memory) or "stash"
+    (residual stash, no forward recompute — the higher-MFU trade; see
+    that function's docstring and PARITY.md for the chip numbers).
     """
     if batch_shardings is None:
         batch_shardings = mlm_batch_shardings(mesh)
@@ -92,7 +98,7 @@ def make_1f1b_train_step(model: PipelinedLM, mesh: Mesh, seed: int = 0,
             return ce_sum, {"correct": correct, "mask": n}
 
         kw = dict(rng=dkey if use_dropout else None,
-                  cotangent_scale=1.0 / total)
+                  cotangent_scale=1.0 / total, backward=backward)
         aux_metrics = {}
         if moe:
             # Each (layer, microbatch) sow contributes 1/denom to the

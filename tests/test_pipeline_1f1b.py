@@ -65,6 +65,31 @@ def test_1f1b_matches_gpipe(devices8):
         st_g.params, st_f.params)
 
 
+def test_1f1b_stash_backward_matches_recompute(devices8):
+    """backward="stash" (residual ring buffers, no forward recompute)
+    is a memory/compute trade, not a math change: same batch + state
+    must give the same loss and updated params as the default
+    recompute backward, including with dropout active (the stashed
+    residuals carry the forward-tick masks). On-chip outcome is in
+    LMBENCH_r04_pipelined / PARITY.md: recompute WINS on v5e (the
+    stash's HBM traffic costs more than re-running the stage forward
+    on an underutilized MXU), so stash stays opt-in."""
+    mesh = make_mesh(MeshConfig(data=2, pipe=4), devices8)
+    model, state, batch = _setup(mesh, dropout=0.2)
+    steps = {
+        mode: make_1f1b_train_step(model, mesh, donate=False,
+                                   backward=mode)
+        for mode in ("recompute", "stash")}
+    st_r, met_r = steps["recompute"](state, batch)
+    st_s, met_s = steps["stash"](state, batch)
+    assert float(met_r["loss"]) == pytest.approx(float(met_s["loss"]),
+                                                 rel=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-6, rtol=1e-4),
+        st_r.params, st_s.params)
+
+
 @pytest.mark.slow
 def test_1f1b_temp_memory_bounded(devices8):
     """The point of 1F1B: compiled temp memory stays O(S) while GPipe's
@@ -250,6 +275,18 @@ def test_bubble_fraction():
 def test_1f1b_config_validation():
     cfg = TrainConfig(pipeline_schedule="zigzag")
     with pytest.raises(ValueError, match="pipeline_schedule"):
+        cfg.validate()
+    cfg = TrainConfig(pipeline_backward="checkpointless")
+    with pytest.raises(ValueError, match="pipeline_backward"):
+        cfg.validate()
+    # Reject silently-ignored combinations (GPipe's backward is AD;
+    # non-pipelined families have no schedule at all).
+    cfg = TrainConfig(model="pipelined_lm", pipeline_schedule="gpipe",
+                      pipeline_backward="stash")
+    with pytest.raises(ValueError, match="applies only"):
+        cfg.validate()
+    cfg = TrainConfig(model="gpt_lm", pipeline_backward="stash")
+    with pytest.raises(ValueError, match="applies only"):
         cfg.validate()
     cfg = TrainConfig(model="pipelined_lm", pipeline_schedule="1f1b",
                       grad_accum_steps=2, batch_size=256)
