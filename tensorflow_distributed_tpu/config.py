@@ -182,6 +182,11 @@ class TrainConfig:
     # slots (models/moe.py) and assignments past that are dropped (the
     # dropped fraction is a train metric).
     moe_capacity_factor: float = 1.25
+    # Routing-group length for MoE layers: 0 routes the whole
+    # sequence as one group; S' > 0 routes independent contiguous
+    # chunks of S' tokens, bounding the dense dispatch tensors to
+    # O(S'^2) per chunk (models/moe.py scale envelope).
+    moe_group_len: int = 0
 
     # --- mesh / parallelism ---------------------------------------------
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
@@ -370,6 +375,16 @@ class TrainConfig:
             raise ValueError(
                 f"moe_capacity_factor must be > 0, "
                 f"got {self.moe_capacity_factor}")
+        if self.moe_group_len < 0:
+            raise ValueError(
+                f"moe_group_len must be >= 0, got {self.moe_group_len}")
+        if (self.moe_group_len and self.seq_len > self.moe_group_len
+                and self.seq_len % self.moe_group_len):
+            # seq_len <= moe_group_len is fine: MoeMlp routes such
+            # sequences as one group (the decode/short-prefill path).
+            raise ValueError(
+                f"seq_len {self.seq_len} not divisible by "
+                f"moe_group_len {self.moe_group_len}")
         if self.batch_size % self.grad_accum_steps:
             raise ValueError(
                 f"batch_size {self.batch_size} not divisible by "

@@ -36,6 +36,32 @@ the objective; see train.tasks.make_moe_loss):
 Expert axis: "model" by default — expert parallelism composes with the
 existing mesh without a fifth axis; a dedicated "expert" mesh axis
 (MeshConfig.expert) is supported via the ``expert_axis`` knob.
+
+Scale envelope (measured: MOEBENCH.json, benchmarks/moebench.py).
+The dense [G, S, E, C] dispatch/combine tensors are O(S * E * C) f32
+each with C = ceil(c*K*S/E), i.e. O(c*K*S^2) PER GROUP at any E —
+quadratic in sequence length at fixed capacity factor:
+
+    seq  1024:   20 MiB/group   (measured on chip: 65k tok/s, 37.6%
+    seq  4096:  320 MiB/group    active-param MFU, E=8 d768 L12;
+    seq  8192: 1.25 GiB/group    dispatch einsums are ~25% of step
+    seq 32768:   20 GiB/group    FLOPs at seq 1024 — C grows with S,
+                                 so this share grows too)
+
+Inside the envelope (seq <= ~4k per group on a 16G chip, any E) the
+formulation is the right TPU trade: pure batched einsums on the MXU,
+zero gather/scatter, and GSPMD-derived all_to_alls. Past it, set
+``group_len`` (``--moe-group-len``): each row's sequence splits into
+independent routing groups of that length, so capacity — and with it
+BOTH the dispatch tensors AND the dispatch-einsum FLOPs (each is
+O(C) per token) — scales with the GROUP length, not the full
+sequence: seq 32768 at group_len 1024 costs 32 x 20 MiB instead of
+one 20 GiB tensor, and the measured seq-4096 win (1.28x tokens/s,
+MOEBENCH/PARITY) is mostly those saved einsum FLOPs. Or combine with sequence parallelism so each seq shard routes
+its own slice. A sorted/ragged (megablocks-style) dispatch would need
+a Pallas grouped-matmul kernel with scalar-prefetch block indexing to
+beat this on TPU; not implemented — the group-length knob covers the
+practical range first.
 """
 
 from __future__ import annotations
@@ -86,6 +112,13 @@ class MoeMlp(nn.Module):
     compute_dtype: Any = jnp.bfloat16
     expert_axis: str = AXIS_MODEL
     partitioned: bool = True  # False inside manual shard_maps (pipeline)
+    # Routing-group length: 0 = the whole sequence is one group (GShard
+    # default). Setting S' < S splits each row's sequence into S/S'
+    # contiguous groups routed independently — capacity AND the
+    # [.., S', E, C'] dispatch tensors scale with S' (C' = c*K*S'/E),
+    # which is the in-formulation answer to the O(S^2) envelope above.
+    # Load-balance pressure becomes per-chunk (stricter, same optimum).
+    group_len: int = 0
 
     def _winit(self, names):
         init = nn.initializers.normal(stddev=0.02)
@@ -93,6 +126,17 @@ class MoeMlp(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
+        G0, S0, M0 = x.shape
+        # Sequences at or below group_len route as one group — decode
+        # (S0 == 1) and short prefills must not crash on a knob meant
+        # for long training sequences.
+        if self.group_len and S0 > self.group_len:
+            if S0 % self.group_len:
+                raise ValueError(
+                    f"seq {S0} not divisible by group_len "
+                    f"{self.group_len}")
+            x = x.reshape(G0 * (S0 // self.group_len), self.group_len,
+                          M0)
         G, S, M = x.shape
         E, K = self.num_experts, self.top_k
         if K > E:
@@ -175,4 +219,4 @@ class MoeMlp(nn.Module):
         h = jax.nn.gelu(jnp.einsum("egcm,emf->egcf", xin, wi.astype(dt)))
         out = jnp.einsum("egcf,efm->egcm", h, wo.astype(dt))
         y = jnp.einsum("gsec,egcm->gsm", combine.astype(dt), out)
-        return y.astype(x.dtype)
+        return y.astype(x.dtype).reshape(G0, S0, M0)

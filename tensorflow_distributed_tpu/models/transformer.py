@@ -77,6 +77,9 @@ class TransformerConfig:
     moe_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    # Routing-group length (0 = whole sequence); see
+    # models/moe.py's scale-envelope note.
+    moe_group_len: int = 0
     # Mesh axis the expert dim shards over: "model" (the default — EP
     # composes with TP's axis) or the dedicated "expert" axis
     # (MeshConfig.expert). moe_lm auto-selects "expert" when the mesh
@@ -359,6 +362,7 @@ class Block(nn.Module):
             y = MoeMlp(d_model=cfg.d_model, d_ff=cfg.d_ff,
                        num_experts=cfg.moe_experts, top_k=cfg.moe_top_k,
                        capacity_factor=cfg.moe_capacity_factor,
+                       group_len=cfg.moe_group_len,
                        compute_dtype=cfg.compute_dtype,
                        expert_axis=cfg.moe_expert_axis,
                        partitioned=cfg.tp_partitioning,

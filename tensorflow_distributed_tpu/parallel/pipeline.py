@@ -356,10 +356,14 @@ def pipeline_value_and_grad(stage_fn: Callable[..., jax.Array],
                 if stash_residuals:
                     (y, aux_v), vjp_fn = jax.vjp(with_key(mf_c), params,
                                                  inp)
+                    # strict: a residual-structure drift between the
+                    # eval_shape template and this trace must fail
+                    # loudly, not silently stash stale zeros.
                     stash = tuple(
                         jax.lax.dynamic_update_index_in_dim(sb, l, slot, 0)
                         for sb, l in zip(
-                            stash, jax.tree_util.tree_leaves(vjp_fn)))
+                            stash, jax.tree_util.tree_leaves(vjp_fn),
+                            strict=True))
                     return y, aux_v, stash
                 y, aux_v = with_key(mf_c)(params, inp)
                 stash = jax.lax.dynamic_update_index_in_dim(

@@ -44,6 +44,46 @@ def test_moe_matches_naive_routing():
     np.testing.assert_allclose(y, want, atol=1e-4, rtol=1e-3)
 
 
+def test_moe_group_len_matches_naive_routing():
+    """group_len splits the sequence into independent routing groups;
+    with capacity high enough that nothing drops, routing is per-token
+    so the chunked result must equal the oracle AND the unchunked
+    layer exactly. The knob's purpose is the dispatch-tensor envelope
+    (models/moe.py docstring): [.., S', E, C'] scales with the group
+    length, not the sequence."""
+    x = jnp.asarray(np.random.default_rng(2).normal(size=(2, 8, 16)),
+                    jnp.float32)
+    layer = _layer()
+    params = layer.init(jax.random.key(0), x)["params"]
+    chunked = MoeMlp(d_model=16, d_ff=32, num_experts=4, top_k=2,
+                     capacity_factor=10.0, compute_dtype=jnp.float32,
+                     partitioned=False, group_len=4)
+    y_c, _ = chunked.apply({"params": params}, x, mutable=["moe_aux"])
+    want = _reference_moe(params, x, E=4, top_k=2)
+    np.testing.assert_allclose(y_c, want, atol=1e-4, rtol=1e-3)
+    y_full, _ = layer.apply({"params": params}, x, mutable=["moe_aux"])
+    np.testing.assert_allclose(y_c, y_full, atol=1e-5, rtol=1e-5)
+
+    with pytest.raises(ValueError, match="group_len"):
+        bad = MoeMlp(d_model=16, d_ff=32, num_experts=4,
+                     partitioned=False, group_len=3)
+        bad.init(jax.random.key(0), x)
+
+    cfg = TrainConfig(model="moe_lm", moe_experts=4, seq_len=128,
+                      moe_group_len=48, batch_size=32)
+    with pytest.raises(ValueError, match="moe_group_len"):
+        cfg.validate()
+
+    # Sequences AT OR BELOW group_len route as one group — decode
+    # (S=1) and short prefills must work on a model trained with a
+    # long-sequence group_len, not crash on divisibility.
+    short = jnp.asarray(
+        np.random.default_rng(3).normal(size=(2, 1, 16)), jnp.float32)
+    y_s, _ = chunked.apply({"params": params}, short,
+                           mutable=["moe_aux"])
+    assert y_s.shape == short.shape
+
+
 def test_moe_top1():
     layer = _layer(top_k=1)
     x = jnp.asarray(np.random.default_rng(1).normal(size=(2, 8, 16)),
