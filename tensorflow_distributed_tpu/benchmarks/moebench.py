@@ -141,7 +141,9 @@ def main(argv=None) -> None:
     # GROUP length (= --group-len when set) — which is why group_len
     # is also a FLOPs knob, not just a memory knob: C (hence dispatch
     # work) scales with the group.
-    grp = args.group_len or args.seq_len
+    # min(): MoeMlp routes the whole sequence as ONE group when
+    # group_len >= seq, so capacity follows the smaller of the two.
+    grp = min(args.group_len or args.seq_len, args.seq_len)
     cf = model.cfg.moe_capacity_factor
     cap = max(1, math.ceil(cf * args.top_k * grp / args.experts))
     disp_fpt = (3.0 * 2.0 * (2.0 * args.experts * cap * args.d_model)

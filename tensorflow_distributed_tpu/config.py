@@ -118,6 +118,11 @@ class TrainConfig:
     # 256 byte values; no tokenizer, no egress).
     dataset: str = "mnist"
     data_dir: str = "/tmp/mnist-data"  # reference default, mnist_python_m.py:50
+    # Rows carved off the head of the real train split for validation
+    # (the reference hardcodes 5000, mnist_python_m.py via
+    # input_data.read_data_sets). Small local datasets (e.g. the
+    # committed idx fixture) need a smaller split. mnist/cifar10 only.
+    validation_size: int = 5000
     # Sequence length for the LM families: the data stream's window AND
     # the model's max_len. 0 = the family default (128). This is the
     # long-context knob: --seq-len 8192 --mesh.seq 8 trains with ring

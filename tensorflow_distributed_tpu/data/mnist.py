@@ -183,7 +183,8 @@ def synthetic_mnist(n_train: int = 12000, n_test: int = 2000,
                       validation_size, "synthetic")
 
 
-def load_dataset(dataset: str, data_dir: str, seed: int = 0
+def load_dataset(dataset: str, data_dir: str, seed: int = 0,
+                 validation_size: int = 5000
                  ) -> Tuple[Dataset, Dataset, Dataset]:
     """Dispatch over every vision dataset family. Real datasets
     ('mnist', 'cifar10') fall back to their synthetic twins with a
@@ -194,16 +195,18 @@ def load_dataset(dataset: str, data_dir: str, seed: int = 0
         return synthetic_mnist(seed=seed)
     if dataset == "mnist":
         try:
-            return load_mnist(data_dir)
+            return load_mnist(data_dir, validation_size)
         except FileNotFoundError as e:
             print(f"[data] {e} — falling back to synthetic digits.")
-            return synthetic_mnist(seed=seed)
+            return synthetic_mnist(seed=seed,
+                                   validation_size=validation_size)
     if dataset == "cifar10":
         try:
-            return cifar.load_cifar10(data_dir)
+            return cifar.load_cifar10(data_dir, validation_size)
         except FileNotFoundError as e:
             print(f"[data] {e} — falling back to synthetic cifar10.")
-            return cifar.synthetic_cifar10(seed=seed)
+            return cifar.synthetic_cifar10(
+                seed=seed, validation_size=validation_size)
     if dataset == "cifar10_synthetic":
         return cifar.synthetic_cifar10(seed=seed)
     if dataset == "imagenet_synthetic":

@@ -61,7 +61,8 @@ def _make_vision_task(cfg: TrainConfig, mesh: Mesh) -> Task:
     from tensorflow_distributed_tpu.data import ShardedBatcher, load_dataset
     from tensorflow_distributed_tpu.parallel.mesh import process_batch_role
 
-    train_ds, val_ds, _ = load_dataset(cfg.dataset, cfg.data_dir, cfg.seed)
+    train_ds, val_ds, _ = load_dataset(cfg.dataset, cfg.data_dir, cfg.seed,
+                                       validation_size=cfg.validation_size)
     # Mesh-aware process role, NOT raw process_count: processes sharing
     # a data coordinate must supply identical rows (parallel.mesh).
     n_proc, i_proc = process_batch_role(mesh)
