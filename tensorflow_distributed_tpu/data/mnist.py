@@ -104,6 +104,14 @@ def _to_splits(train_images, train_labels, test_images, test_labels,
     ``validation_size`` (5000) training rows become the validation split —
     which is what the reference validates on, not the test split
     (mnist_python_m.py:313, SURVEY.md Appendix B.8)."""
+    if validation_size >= len(train_images):
+        # Fail at the real cause — downstream the Batcher would raise
+        # a misleading "dataset smaller than one global batch" on the
+        # empty train split.
+        raise ValueError(
+            f"validation_size {validation_size} leaves no training "
+            f"rows ({name} train split has {len(train_images)}); "
+            "lower --validation-size")
     val = Dataset(train_images[:validation_size], train_labels[:validation_size],
                   name)
     train = Dataset(train_images[validation_size:],
@@ -198,15 +206,20 @@ def load_dataset(dataset: str, data_dir: str, seed: int = 0,
             return load_mnist(data_dir, validation_size)
         except FileNotFoundError as e:
             print(f"[data] {e} — falling back to synthetic digits.")
+            # Honor explicit small splits; cap at the synthetic
+            # twin's own default (its train set is far smaller
+            # than real MNIST, so the real-dataset default of 5000
+            # would eat half of it).
             return synthetic_mnist(seed=seed,
-                                   validation_size=validation_size)
+                                   validation_size=min(validation_size,
+                                                       1000))
     if dataset == "cifar10":
         try:
             return cifar.load_cifar10(data_dir, validation_size)
         except FileNotFoundError as e:
             print(f"[data] {e} — falling back to synthetic cifar10.")
             return cifar.synthetic_cifar10(
-                seed=seed, validation_size=validation_size)
+                seed=seed, validation_size=min(validation_size, 1000))
     if dataset == "cifar10_synthetic":
         return cifar.synthetic_cifar10(seed=seed)
     if dataset == "imagenet_synthetic":

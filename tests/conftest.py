@@ -56,3 +56,7 @@ def mesh1(devices8):
 def tiny_data():
     from tensorflow_distributed_tpu.data.mnist import synthetic_mnist
     return synthetic_mnist(n_train=2048, n_test=512, validation_size=256, seed=0)
+
+
+# Committed real-idx fixture (shared by test_data / test_loop_cli).
+FIXTURE_DIR = __file__.rsplit("/", 1)[0] + "/fixtures/mnist"

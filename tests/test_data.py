@@ -51,7 +51,7 @@ def test_synthetic_shapes_and_determinism():
     np.testing.assert_array_equal(tr.images, tr2.images)
 
 
-FIXTURE_DIR = __file__.rsplit("/", 1)[0] + "/fixtures/mnist"
+from tests.conftest import FIXTURE_DIR
 
 
 def test_load_mnist_fixture_real_idx_bytes():

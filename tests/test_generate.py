@@ -118,3 +118,35 @@ def test_trained_model_continues_pattern(devices8):
                               jnp.asarray(prompt), N))
     acc = float(np.mean(out == np.stack(wants).astype(np.int32)))
     assert acc >= 0.5, (out.tolist(), acc)
+
+
+def test_generate_sharded_prompt_matches_single_device(devices8):
+    """Decode under mesh.data > 1 (VERDICT r03 item 8): the same
+    prompt, sharded over a data=4 mesh, must greedy-decode to exactly
+    the single-device tokens — generation is jit + GSPMD like the
+    train step, so batch sharding is a layout, not math. (GENBENCH.json
+    records the on-chip decode throughput this path delivers.)"""
+    from tensorflow_distributed_tpu.config import MeshConfig
+    from tensorflow_distributed_tpu.models.transformer import gpt_lm
+    from tensorflow_distributed_tpu.parallel.mesh import (
+        make_mesh, single_device_mesh)
+    from tensorflow_distributed_tpu.train.state import create_train_state
+    import optax
+
+    prompt_np = np.random.default_rng(3).integers(0, 64, size=(4, 6))
+    outs = {}
+    for name, mesh in (("dp4", make_mesh(MeshConfig(data=4),
+                                         devices8[:4])),
+                       ("single", single_device_mesh(devices8[0]))):
+        model = gpt_lm(mesh, size="tiny", compute_dtype=jnp.float32,
+                       dropout_rate=0.0)
+        state = create_train_state(model, optax.sgd(1e-2),
+                                   np.zeros((2, 8), np.int32), mesh, 0)
+        with mesh:
+            prompt = jax.device_put(
+                jnp.asarray(prompt_np, jnp.int32),
+                jax.sharding.NamedSharding(
+                    mesh, jax.sharding.PartitionSpec("data", None)))
+            outs[name] = np.asarray(
+                generate(model, state.params, prompt, 8))
+    np.testing.assert_array_equal(outs["dp4"], outs["single"])

@@ -7,6 +7,7 @@ import pytest
 
 from tensorflow_distributed_tpu.config import MeshConfig, TrainConfig
 from tensorflow_distributed_tpu.train.loop import train
+from tests.conftest import FIXTURE_DIR
 
 
 def _cfg(**kw):
@@ -27,9 +28,6 @@ def test_train_reaches_accuracy_bar():
     assert result.final_metrics["accuracy"] >= 0.97
     assert int(jax.device_get(result.state.step)) == 60
     assert result.images_per_sec > 0
-
-
-FIXTURE_DIR = __file__.rsplit("/", 1)[0] + "/fixtures/mnist"
 
 
 def test_train_on_fixture_real_bytes_reaches_bar():
