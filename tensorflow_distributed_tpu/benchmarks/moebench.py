@@ -73,6 +73,10 @@ def main(argv=None) -> None:
                         help="MoE routing-group length (0 = whole "
                         "sequence); the dispatch-envelope knob — same "
                         "name as the train CLI's flag")
+    parser.add_argument("--moe-dispatch", default="dense",
+                        choices=["dense", "scatter"], dest="dispatch",
+                        help="token-movement formulation (models/"
+                        "moe.py); scatter skips the one-hot einsums")
     parser.add_argument("--remat", default="none",
                         choices=["none", "full", "dots"])
     parser.add_argument("--steps", type=int, default=20)
@@ -105,7 +109,8 @@ def main(argv=None) -> None:
     model = moe_lm(mesh, size="small", moe_experts=args.experts,
                    moe_top_k=args.top_k, d_model=args.d_model,
                    n_layers=args.n_layers, max_len=args.seq_len,
-                   moe_group_len=args.group_len, dropout_rate=0.0,
+                   moe_group_len=args.group_len,
+                   moe_dispatch=args.dispatch, dropout_rate=0.0,
                    **({"remat": True, "remat_policy": args.remat}
                       if args.remat != "none" else {}))
     state = create_train_state(
@@ -154,6 +159,7 @@ def main(argv=None) -> None:
     meta = {"model": "moe_lm", "params": param_count(state.params),
             "experts": args.experts, "top_k": args.top_k,
             "capacity": cap, "group_len": args.group_len,
+            "dispatch": args.dispatch,
             "remat": args.remat, "batch": args.batch,
             "seq_len": args.seq_len, "d_model": args.d_model,
             "n_layers": args.n_layers, "device": kind, "devices": n_dev}

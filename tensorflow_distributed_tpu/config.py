@@ -192,6 +192,11 @@ class TrainConfig:
     # chunks of S' tokens, bounding the dense dispatch tensors to
     # O(S'^2) per chunk (models/moe.py scale envelope).
     moe_group_len: int = 0
+    # MoE token movement: "dense" one-hot dispatch/combine einsums
+    # (GShard; the EP-proven layout) or "scatter" slot scatter/
+    # gather (no one-hot tensors, no O(E*C)-per-token dispatch
+    # FLOPs; models/moe.py).
+    moe_dispatch: str = "dense"
 
     # --- mesh / parallelism ---------------------------------------------
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
@@ -380,6 +385,9 @@ class TrainConfig:
             raise ValueError(
                 f"moe_capacity_factor must be > 0, "
                 f"got {self.moe_capacity_factor}")
+        if self.moe_dispatch not in ("dense", "scatter"):
+            raise ValueError(
+                f"unknown moe_dispatch {self.moe_dispatch!r}")
         if self.moe_group_len < 0:
             raise ValueError(
                 f"moe_group_len must be >= 0, got {self.moe_group_len}")

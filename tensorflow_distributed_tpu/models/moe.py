@@ -2,12 +2,21 @@
 
 The reference has no expert/routing code (SURVEY.md §2b checklist:
 "Expert parallel: NO") — beyond-reference capability, built the
-TPU-native way: routing is expressed as dense one-hot dispatch/combine
-einsums (the Mesh-TensorFlow/GShard formulation) and expert weights
-carry a leading expert dim partitioned over a mesh axis, so XLA's SPMD
-partitioner derives the token all_to_alls from sharding propagation —
-nobody writes a collective by hand. MXU-friendly: everything is
-batched einsums, no gather/scatter.
+TPU-native way: expert weights carry a leading expert dim partitioned
+over a mesh axis, so XLA's SPMD partitioner derives the token
+all_to_alls from sharding propagation — nobody writes a collective by
+hand. Token movement has two interchangeable formulations sharing one
+routing computation (``dispatch`` knob):
+- "dense" (default): one-hot dispatch/combine einsums (the
+  Mesh-TensorFlow/GShard formulation) — pure batched einsums on the
+  MXU, no gather/scatter HLOs, the layout EP sharding is proven on.
+- "scatter": the same assignments as a slot scatter-add into the
+  expert buffers and a gather back — emits real scatter/gather HLOs,
+  moves O(K) rows per token instead of spending O(E*C) einsum FLOPs
+  per token, and never materializes the [S, E, C] one-hot tensors.
+Identical masks, positions, capacity drops, gates, and aux sows either
+way (gradient-level parity pinned in tests/test_moe.py, including an
+EP-sharded train-step A/B).
 
 Mechanics (top-2, capacity-factor c):
 - gate logits [G, S, E] in f32; top-1 and top-2 assignments become
@@ -119,6 +128,17 @@ class MoeMlp(nn.Module):
     # which is the in-formulation answer to the O(S^2) envelope above.
     # Load-balance pressure becomes per-chunk (stricter, same optimum).
     group_len: int = 0
+    # Token movement formulation. "dense" (GShard): one-hot [S, E, C]
+    # dispatch/combine einsums — pure MXU, but O(E*C) FLOPs per token
+    # (~25% of a measured E=8 step, MOEBENCH.json) and O(S*E*C)
+    # memory. "scatter": the SAME routing (identical masks, positions,
+    # capacity drops, aux losses) expressed as a scatter-add into the
+    # [E, C, M] expert buffers and a gather back — O(K) moved rows per
+    # token, no one-hot tensors at all. Expert matmuls are identical
+    # einsums either way. Dense stays the default: its E-dim einsum
+    # operands are what GSPMD's expert-axis all_to_all derivation is
+    # proven on; scatter is the measured-faster single-replica path.
+    dispatch: str = "dense"  # dense | scatter
 
     def _winit(self, names):
         init = nn.initializers.normal(stddev=0.02)
@@ -146,6 +166,9 @@ class MoeMlp(nn.Module):
             # catches the CLI path; this guards direct construction
             # and family-default expert counts).
             raise ValueError(f"top_k {K} > num_experts {E}")
+        if self.dispatch not in ("dense", "scatter"):
+            raise ValueError(f"dispatch {self.dispatch!r}; "
+                             "have ('dense', 'scatter')")
         C = max(1, math.ceil(self.capacity_factor * K * S / E))
 
         gate_w = self.param("gate", self._winit((None, None)), (M, E),
@@ -186,32 +209,64 @@ class MoeMlp(nn.Module):
         z = jax.nn.logsumexp(logits, axis=-1)              # [G, S]
         self.sow("moe_aux", "z_loss", jnp.mean(jnp.square(z)))
 
+        wi = self.param("wi", self._winit((self.expert_axis, None, None)),
+                        (E, M, self.d_ff), jnp.float32)
+        wo = self.param("wo", self._winit((self.expert_axis, None, None)),
+                        (E, self.d_ff, M), jnp.float32)
+        dt = self.compute_dtype
+
+        # Per-(token, k) keep flag, normalized gate, and expert-buffer
+        # slot, shared by both formulations so routing/drop semantics
+        # are identical by construction.
+        denom = sum(gates) if K > 1 else None
+        gks = [g / jnp.maximum(denom, 1e-9) if denom is not None else g
+               for g in gates]
+        withins = [(ps < C).astype(jnp.float32) * jnp.sum(mask, -1)
+                   for mask, ps in zip(masks, pos)]
+        kept = sum(jnp.sum(w) for w in withins) / (G * S * K)
+        # Overflowed routing slots are silent zeros in the math (the
+        # token passes through the residual unchanged) — surface them.
+        self.sow("moe_aux", "dropped_fraction",
+                 jax.lax.stop_gradient(1.0 - kept))
+
+        if self.dispatch == "scatter":
+            # Slot d = e*C + pos for kept (token, k) pairs; dropped
+            # pairs target the dump row E*C. One scatter-add fills the
+            # expert buffers (slots are unique by construction: pos is
+            # a per-expert running count), one gather + gate-weighted
+            # sum brings expert outputs home. AD gives the transposes
+            # (gather <-> scatter) for free.
+            gidx = jnp.arange(G)[:, None]                  # [G, 1]
+            buf = jnp.zeros((G, E * C + 1, M), dt)
+            ds_ = []
+            for mask, ps, within in zip(masks, pos, withins):
+                e_id = jnp.argmax(mask, axis=-1)           # [G, S]
+                d = jnp.where(within > 0,
+                              e_id * C + ps.astype(jnp.int32), E * C)
+                buf = buf.at[gidx, d].add(
+                    x.astype(dt) * within[..., None].astype(dt))
+                ds_.append(d)
+            xin = buf[:, :E * C].reshape(G, E, C, M)       # [G, E, C, M]
+            h = jax.nn.gelu(
+                jnp.einsum("gecm,emf->gecf", xin, wi.astype(dt)))
+            out = jnp.einsum("gecf,efm->gecm", h, wo.astype(dt))
+            out_pad = jnp.concatenate(
+                [out.reshape(G, E * C, M), jnp.zeros((G, 1, M), dt)], 1)
+            y = sum(out_pad[gidx, d] * gk[..., None].astype(dt)
+                    for d, gk in zip(ds_, gks))
+            return y.astype(x.dtype).reshape(G0, S0, M0)
+
         # dispatch/combine [G, S, E, C]; tokens past capacity drop out.
         dispatch = jnp.zeros((G, S, E, C), jnp.float32)
         combine = jnp.zeros((G, S, E, C), jnp.float32)
-        denom = sum(gates) if K > 1 else None
-        for mask, g, ps in zip(masks, gates, pos):
-            within = (ps < C).astype(jnp.float32) * jnp.sum(mask, -1)
+        for mask, gk, ps, within in zip(masks, gks, pos, withins):
             loc = jax.nn.one_hot(ps.astype(jnp.int32), C,
                                  dtype=jnp.float32)        # [G, S, C]
             sel = mask[..., None] * loc[..., None, :]      # [G, S, E, C]
             sel = sel * within[..., None, None]
             dispatch = dispatch + sel
-            gk = g / jnp.maximum(denom, 1e-9) if denom is not None else g
             combine = combine + sel * gk[..., None, None]
 
-        # Overflowed routing slots are silent zeros in the math (the
-        # token passes through the residual unchanged) — surface them.
-        kept = jnp.sum(dispatch) / (G * S * K)
-        self.sow("moe_aux", "dropped_fraction",
-                 jax.lax.stop_gradient(1.0 - kept))
-
-        wi = self.param("wi", self._winit((self.expert_axis, None, None)),
-                        (E, M, self.d_ff), jnp.float32)
-        wo = self.param("wo", self._winit((self.expert_axis, None, None)),
-                        (E, self.d_ff, M), jnp.float32)
-
-        dt = self.compute_dtype
         # Token shuffle in, expert MLPs, shuffle out — the einsums whose
         # E-dim sharding makes GSPMD emit the all_to_alls.
         xin = jnp.einsum("gsec,gsm->egcm", dispatch.astype(dt),

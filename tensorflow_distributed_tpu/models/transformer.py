@@ -80,6 +80,9 @@ class TransformerConfig:
     # Routing-group length (0 = whole sequence); see
     # models/moe.py's scale-envelope note.
     moe_group_len: int = 0
+    # Token-movement formulation: "dense" (GShard one-hot
+    # einsums) or "scatter" (slot scatter/gather); models/moe.py.
+    moe_dispatch: str = "dense"
     # Mesh axis the expert dim shards over: "model" (the default — EP
     # composes with TP's axis) or the dedicated "expert" axis
     # (MeshConfig.expert). moe_lm auto-selects "expert" when the mesh
@@ -363,6 +366,7 @@ class Block(nn.Module):
                        num_experts=cfg.moe_experts, top_k=cfg.moe_top_k,
                        capacity_factor=cfg.moe_capacity_factor,
                        group_len=cfg.moe_group_len,
+                       dispatch=cfg.moe_dispatch,
                        compute_dtype=cfg.compute_dtype,
                        expert_axis=cfg.moe_expert_axis,
                        partitioned=cfg.tp_partitioning,

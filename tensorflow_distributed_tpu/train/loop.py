@@ -91,6 +91,7 @@ def _build_model_and_state(cfg: TrainConfig, mesh, task):
         size_kw["moe_top_k"] = cfg.moe_top_k
         size_kw["moe_capacity_factor"] = cfg.moe_capacity_factor
         size_kw["moe_group_len"] = cfg.moe_group_len
+        size_kw["moe_dispatch"] = cfg.moe_dispatch
     if cfg.model in ("bert_mlm", "gpt_lm", "moe_lm", "pipelined_lm"):
         # Transformer-family knobs, shared by the pipelined variant
         # (rope positions are derived inside its stage_fn; tying is
