@@ -146,21 +146,32 @@ def bubble_fraction(num_microbatches: int, num_stages: int,
     fwd+bwd schedule runs M + 2(S-1) tick pairs, of which 2(S-1) are
     ramp-up/drain bubbles.
 
-    On interleaved (virtual-stage) schedules — considered for round 3
-    and deliberately NOT implemented: the Megatron bubble/V win comes
-    from warmup/drain ticks doing fwd-ONLY (resp. bwd-only) work. A
-    UNIFORM scan tick (one forward + one backward slot per stage per
-    tick) gains nothing from folding V chunks per device: the schedule
-    stretches to ~MV chunk-ticks of 1/V-size units with ~SV empty
-    half-ticks — total bubble TIME unchanged (worked example: S=2,
-    V=2, M=8 gives 40 chunk-units wall either way). What DOES pay is
-    making bubble half-ticks free: pipeline_value_and_grad's tick now
-    wraps each half in a real ``lax.cond`` (possible because its
-    backward is hand-rolled — nothing ADs through the cond), skipping
-    ramp/drain garbage compute instead of where-masking it. Measured
-    3.3x per-step at S=4, M=4 (see module docstring); the reported
-    2(S-1)/(M+2(S-1)) fraction remains the SLOT accounting — the
-    skipped slots now cost ~0 time rather than a full stage pass."""
+    On interleaved (virtual-stage) schedules — analyzed across rounds
+    3-4 and deliberately NOT implemented. In this architecture every
+    schedule is a lockstep ``lax.scan`` whose tick runs one fwd + one
+    bwd slot per device between ppermutes, so wall time is
+    ticks x slot time regardless of which devices' slots are
+    cond-skipped. Folding V chunk-columns per device makes the chunk
+    round-robin pipe SV chunks deep with MV chunk-jobs per device:
+    utilization MV/(MV + 2(SV-1)) — STRICTLY WORSE than the plain
+    M/(M + 2(S-1)) for V > 1 (M=8, S=4: 57% plain, 53% at V=2).
+    Megatron's bubble/V win does not come from interleaving alone but
+    from its ASYMMETRIC grouped schedule: warmup ticks run fwd-ONLY
+    chunk bursts (up to S-1+2(V-1) forwards queued per device before
+    the first backward) so ramp chunks overlap useful steady-state
+    work — a schedule a uniform one-fwd-one-bwd tick cannot express.
+    Expressing it here would need per-tick static slot tables driving
+    variable work per tick; the complexity buys nothing measurable on
+    this hardware (single-chip S=1 has no bubble at all — PARITY.md)
+    and is left unimplemented with this note as the record. What DOES
+    pay, and IS implemented, is making bubble half-ticks free:
+    pipeline_value_and_grad's tick wraps each half in a real
+    ``lax.cond`` (possible because its backward is hand-rolled —
+    nothing ADs through the cond), skipping ramp/drain garbage compute
+    instead of where-masking it. Measured 3.3x per-step at S=4, M=4
+    (see module docstring); the reported 2(S-1)/(M+2(S-1)) fraction
+    remains the SLOT accounting — the skipped slots now cost ~0 time
+    rather than a full stage pass."""
     M, S = num_microbatches, num_stages
     if schedule == "gpipe":
         return (S - 1) / (M + S - 1)
