@@ -62,10 +62,21 @@ def matmul_params(params) -> int:
 
 
 def attn_flops_per_token_fwd(cfg) -> float:
-    """QK^T + PV FLOPs per token, one forward; halved for causal
-    because the kernel skips masked blocks."""
-    attn = 4.0 * cfg.max_len * cfg.d_model * cfg.n_layers
-    return attn / 2.0 if cfg.causal else attn
+    """QK^T + PV FLOPs per token, one forward: 4 * d_model * (average
+    attended length) per layer. Full bidirectional attends L; causal
+    ~L/2 (the kernel skips masked blocks); sliding-window attends
+    min(W, pos+1) — the windowed kernel skips out-of-band blocks, so
+    MFU keeps counting only useful work."""
+    L = cfg.max_len
+    per_len = 4.0 * cfg.d_model * cfg.n_layers
+    if not cfg.causal:
+        return per_len * L
+    W = getattr(cfg, "attn_window", 0) or 0
+    if W and W < L:
+        avg = (W * (W + 1) / 2.0 + (L - W) * W) / L
+    else:
+        avg = L / 2.0
+    return per_len * avg
 
 
 def flops_per_token(params, cfg) -> float:
@@ -76,7 +87,7 @@ def flops_per_token(params, cfg) -> float:
 
 def _build(size: str, seq_len: int, use_flash: bool, remat: str,
            batch: int, mesh, seed: int = 0, pipeline_mb: int = 0,
-           pipeline_backward: str = "recompute"):
+           pipeline_backward: str = "recompute", attn_window: int = 0):
     import jax
     import numpy as np
     import optax
@@ -90,6 +101,8 @@ def _build(size: str, seq_len: int, use_flash: bool, remat: str,
         mlm_batch_shardings, mlm_loss)
 
     kw = dict(max_len=seq_len, dropout_rate=0.0, use_flash=use_flash)
+    if attn_window:
+        kw["attn_window"] = attn_window
     if remat != "none":
         kw.update(remat=True, remat_policy=remat)
     if pipeline_mb > 0:
@@ -149,6 +162,11 @@ def main(argv=None) -> None:
     parser.add_argument("--steps", type=int, default=20)
     parser.add_argument("--remat", default="none",
                         choices=["none", "full", "dots"])
+    parser.add_argument("--attn-window", type=int, default=0,
+                        help="sliding-window attention width (0 = "
+                        "full causal); the flash kernel skips "
+                        "blocks outside the band, so tokens/s "
+                        "should GROW as the window shrinks")
     parser.add_argument("--skip-ab", action="store_true",
                         help="skip the flash-vs-XLA attention A/B")
     parser.add_argument("--pipeline-backward", default="recompute",
@@ -190,7 +208,8 @@ def main(argv=None) -> None:
 
     model, state, step, batch = _build(
         args.size, args.seq_len, True, args.remat, args.batch, mesh,
-        pipeline_mb=pmb, pipeline_backward=args.pipeline_backward)
+        pipeline_mb=pmb, pipeline_backward=args.pipeline_backward,
+        attn_window=args.attn_window)
     n_params = param_count(state.params)
     fpt = flops_per_token(state.params, model.cfg)
 
@@ -207,6 +226,8 @@ def main(argv=None) -> None:
     meta = {"model": f"{family}/{args.size}", "params": n_params,
             "batch": args.batch, "seq_len": args.seq_len,
             "device": kind, "devices": n_dev, "remat": args.remat}
+    if args.attn_window:
+        meta["attn_window"] = args.attn_window
     if pmb > 0:
         meta["pipeline_microbatches"] = pmb
         meta["pipeline_backward"] = args.pipeline_backward

@@ -94,6 +94,12 @@ class TrainConfig:
     # standard MHA; 1 = MQA). Shrinks the decode KV cache by
     # n_heads/n_kv_heads. Transformer families only.
     n_kv_heads: int = 0
+    # Sliding-window attention (Mistral-style): attend to the last
+    # W positions only (0 = full causal). Causal LM families; rides
+    # the flash kernel's block-skip (O(L*W) compute) and masks the
+    # decode cache to the window. Requires mesh.seq == 1 (the ring
+    # schedule is not windowed; at W << L the window replaces it).
+    attn_window: int = 0
     # MLP nonlinearity for the transformer families: "gelu" (GPT-2/
     # BERT) or "swiglu" (gated, Llama-style).
     mlp_variant: str = "gelu"  # gelu | swiglu
@@ -365,6 +371,19 @@ class TrainConfig:
         if self.moe_experts < 0:
             raise ValueError(
                 f"moe_experts must be >= 0, got {self.moe_experts}")
+        if self.attn_window < 0:
+            raise ValueError(
+                f"attn_window must be >= 0, got {self.attn_window}")
+        if self.attn_window:
+            if self.model not in ("gpt_lm", "moe_lm", "pipelined_lm"):
+                raise ValueError(
+                    "attn_window needs a causal LM family "
+                    "(gpt_lm | moe_lm | pipelined_lm)")
+            if self.mesh.seq > 1:
+                raise ValueError(
+                    "attn_window with mesh.seq > 1 is not "
+                    "implemented; at W << L the window replaces "
+                    "ring attention — use mesh.seq == 1")
         if self.moe_experts > 0 and self.model not in (
                 "bert_mlm", "gpt_lm", "moe_lm", "pipelined_lm"):
             raise ValueError(

@@ -74,6 +74,14 @@ class TransformerConfig:
     use_flash: bool = True
     # Mixture-of-Experts: 0 = dense MLP; > 0 replaces every block's MLP
     # with an expert-parallel MoeMlp (models/moe.py).
+    # Sliding-window attention (Mistral-style): each token attends
+    # to the last `attn_window` positions only (0 = full causal).
+    # Causal families only; rides the flash kernel's block-skip so
+    # compute is O(L * W) not O(L^2 / 2), and the decode path masks
+    # cache entries older than the window. Long-context note: at
+    # W << L this replaces ring attention (mesh.seq must be 1 —
+    # windowing the zigzag schedule is not implemented).
+    attn_window: int = 0
     moe_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
@@ -267,17 +275,25 @@ class SelfAttention(nn.Module):
             cv.value = jax.lax.dynamic_update_slice(cv.value, v,
                                                     (0, idx, 0, 0))
             ci.value = idx + L
+            from tensorflow_distributed_tpu.ops.flash_attention import (
+                window_keep)
             rows = jnp.arange(L)[:, None]              # new-token offsets
             cols = jnp.arange(cfg.max_len)[None, :]
-            bias = jnp.where(cols <= idx + rows, 0.0, _MASK)[None]
+            # The SAME (pos - window, pos] band as training
+            # (window_keep is the one construction): cache entries
+            # older than the window are masked out.
+            bias = jnp.where(
+                window_keep(idx + rows, cols, cfg.attn_window),
+                0.0, _MASK)[None]
             if nk == h:
                 out = full_attention(q, ck.value, cv.value, bias)
             else:
                 # Grouped attend against the NARROW cache — widening
                 # it would re-materialize [B, max_len, H, Dh] every
                 # step and forfeit the decode-bandwidth win GQA
-                # exists for. Rows are never fully masked (col 0 is
-                # always visible), so plain softmax is safe.
+                # exists for. Rows are never fully masked (the
+                # just-written diagonal entry at col idx+r is always
+                # inside the window band), so plain softmax is safe.
                 g = h // nk
                 qg = q.reshape(B, L, nk, g, dh).astype(jnp.float32)
                 s = jnp.einsum("bqngd,bknd->bngqk", qg,
@@ -289,13 +305,20 @@ class SelfAttention(nn.Module):
                                cv.value.astype(jnp.float32))
                 out = o.reshape(B, L, h, dh).astype(q.dtype)
         elif self.mesh is not None and self.mesh.shape[AXIS_SEQ] > 1:
+            if cfg.attn_window:
+                raise ValueError(
+                    "attn_window with mesh.seq > 1 is not "
+                    "implemented (the zigzag ring schedule is not "
+                    "windowed); at W << L the window IS the "
+                    "long-context strategy — use mesh.seq == 1")
             out = ring_attention(q, widen(k), widen(v), self.mesh,
                                  causal=cfg.causal)
         else:
             # Pallas flash kernel on TPU (shard_mapped over dp x tp when
             # the mesh is partitioned), XLA oracle elsewhere.
             out = attention(q, widen(k), widen(v), causal=cfg.causal,
-                            mesh=self.mesh, allow_flash=cfg.use_flash)
+                            window=cfg.attn_window, mesh=self.mesh,
+                            allow_flash=cfg.use_flash)
         out = nn.DenseGeneral(
             features=cfg.d_model, axis=(-2, -1), use_bias=True,
             kernel_init=_maybe_partitioned(cfg, (AXIS_MODEL, None, None)),

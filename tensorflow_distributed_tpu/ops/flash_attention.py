@@ -47,39 +47,82 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30  # large-finite: avoids inf-inf=nan in masked rows
 
 
-def _causal_mask(s, i_q, i_k, bq, bk):
+def window_keep(rows, cols, window=0):
+    """THE (row - window, row] causal-band predicate — the single
+    construction shared by the kernel mask below, the XLA-oracle
+    dispatcher path (attention()), and the decode-cache mask
+    (models/transformer.py). window 0 = unlimited history."""
+    keep = cols <= rows
+    if window:
+        keep = jnp.logical_and(keep, cols > rows - window)
+    return keep
+
+
+def _causal_mask(s, i_q, i_k, bq, bk, window=0):
+    """Causal mask, optionally sliding-window (window_keep)."""
     rows = i_q * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
     cols = i_k * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    return jnp.where(cols <= rows, s, NEG_INF)
+    return jnp.where(window_keep(rows, cols, window), s, NEG_INF)
 
 
 # Causal block-skip helpers. A (q-block i, k-block j) pair is needed iff
 # its mask isn't all-False: the q block's last row i*bq + bq - 1 must
-# reach the k block's first column j*bk. The index_map twins re-point
-# skipped steps at the last/first needed block so the revisit costs no
-# DMA (Pallas only copies when the block index changes).
+# reach the k block's first column j*bk — and under a sliding window
+# the k block's last column j*bk + bk - 1 must still be inside the
+# OLDEST row's window (row i*bq sees columns > i*bq - window). The
+# index_map twins re-point skipped steps at a needed block so the
+# revisit costs no DMA (Pallas only copies when the block index
+# changes); under a window the inner index clamps into the needed
+# band [lo, hi] — steps before lo prefetch block lo, steps after hi
+# hold block hi.
 
-def _kv_needed(i, j, bq, bk):
-    return j * bk <= i * bq + (bq - 1)
+def _kv_needed(i, j, bq, bk, window=0):
+    need = j * bk <= i * bq + (bq - 1)
+    if window:
+        # Newest row of the q block is i*bq + bq - 1; its window spans
+        # cols > i*bq + bq - 1 - window... but the OLDEST surviving
+        # col across the block's rows comes from the oldest row i*bq:
+        # cols > i*bq - window.
+        need = jnp.logical_and(need, j * bk + (bk - 1) > i * bq - window)
+    return need
 
 
-def _causal_kv_map(bq, bk):
-    return lambda b, i, j: (b, jnp.minimum(j, (i * bq + bq - 1) // bk), 0)
+def _causal_kv_map(bq, bk, window=0):
+    def imap(b, i, j):
+        hi = (i * bq + bq - 1) // bk
+        if window:
+            lo = jnp.maximum(i * bq - window + 1, 0) // bk
+            return (b, jnp.clip(j, lo, hi), 0)
+        return (b, jnp.minimum(j, hi), 0)
+    return imap
 
 
-def _q_needed(i, j, bq, bk):
+def _q_needed(i, j, bq, bk, window=0):
     """dkv grid: i is the k-block index, j the q-block index."""
-    return j * bq + (bq - 1) >= i * bk
+    need = j * bq + (bq - 1) >= i * bk
+    if window:
+        # Oldest col of this k block is i*bk; rows that still see it
+        # satisfy row < i*bk + window — the newest such row bounds the
+        # needed q blocks from above via the block's oldest row j*bq.
+        need = jnp.logical_and(need,
+                               j * bq < i * bk + (bk - 1) + window)
+    return need
 
 
-def _causal_q_map(bq, bk):
-    return lambda b, i, j: (b, jnp.maximum(j, (i * bk) // bq), 0)
+def _causal_q_map(bq, bk, window=0):
+    def imap(b, i, j):
+        lo = (i * bk) // bq
+        if window:
+            hi = (i * bk + bk - 2 + window) // bq
+            return (b, jnp.clip(j, lo, hi), 0)
+        return (b, jnp.maximum(j, lo), 0)
+    return imap
 
 
 # ---------------------------------------------------------------- forward
 
 def _stream_softmax_step(q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr,
-                         i, j, scale, causal, bq, bk):
+                         i, j, scale, causal, bq, bk, window=0):
     """One K,V block folded into the (m, l, acc) VMEM accumulators —
     the streaming-softmax body shared by the normalized and partial
     forward kernels. Runs under the causal block-skip predicate."""
@@ -91,7 +134,7 @@ def _stream_softmax_step(q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            s = _causal_mask(s, i, j, bq, bk)
+            s = _causal_mask(s, i, j, bq, bk, window)
 
         m_prev = m_scr[:, :1]                      # [bq, 1] f32
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -106,22 +149,23 @@ def _stream_softmax_step(q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr,
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
     if causal:
-        # Skip fully-masked K blocks (above the diagonal) — a real
-        # branch, not predicated arithmetic: the MXU work is not done.
-        pl.when(_kv_needed(i, j, bq, bk))(compute)
+        # Skip fully-masked K blocks (above the diagonal, and past
+        # the window horizon) — a real branch, not predicated
+        # arithmetic: the MXU work is not done.
+        pl.when(_kv_needed(i, j, bq, bk, window))(compute)
     else:
         compute()
 
 
 def _p_and_ds(q, k, v, do, row_sub, row_add, i_q, i_k, scale, causal,
-              bq, bk):
+              bq, bk, window=0):
     """Backward-pass block math shared by all four bwd kernels:
     p = exp(s - row_sub) and ds = p * (do.v^T + row_add) * scale.
     Normalized kernels pass (lse, -delta); partial kernels (m, +dl)."""
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
     if causal:
-        s = _causal_mask(s, i_q, i_k, bq, bk)
+        s = _causal_mask(s, i_q, i_k, bq, bk, window)
     p = jnp.exp(s - row_sub)                       # [bq, bk]
     dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
@@ -129,7 +173,8 @@ def _p_and_ds(q, k, v, do, row_sub, row_add, i_q, i_k, scale, causal,
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr, *, scale, causal, bq, bk):
+                m_scr, l_scr, acc_scr, *, scale, causal, bq, bk,
+                window=0):
     i = pl.program_id(1)
     j = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -141,7 +186,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     _stream_softmax_step(q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr,
-                         i, j, scale, causal, bq, bk)
+                         i, j, scale, causal, bq, bk, window)
 
     @pl.when(j == nk - 1)
     def _():
@@ -155,14 +200,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
-def _fwd(q, k, v, causal, bq, bk, interpret):
+def _fwd(q, k, v, causal, bq, bk, interpret, window=0):
     BH, L, D = q.shape
     Lk = k.shape[1]
     scale = 1.0 / (D ** 0.5)
     grid = (BH, L // bq, Lk // bk)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               bq=bq, bk=bk)
-    kv_map = _causal_kv_map(bq, bk) if causal else (
+                               bq=bq, bk=bk, window=window)
+    kv_map = _causal_kv_map(bq, bk, window) if causal else (
         lambda b, i, j: (b, j, 0))
     out, lse = pl.pallas_call(
         kernel,
@@ -200,7 +245,7 @@ def _delta(do, out):
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
-               dq_scr, *, scale, causal, bq, bk):
+               dq_scr, *, scale, causal, bq, bk, window=0):
     i = pl.program_id(1)
     j = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -213,13 +258,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
         q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
         _, ds = _p_and_ds(q, k, v, do, lse_ref[0][:, :1],
                           -_delta(do, o_ref[0]), i, j, scale, causal,
-                          bq, bk)
+                          bq, bk, window)
         dq_scr[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     if causal:
-        pl.when(_kv_needed(i, j, bq, bk))(compute)
+        pl.when(_kv_needed(i, j, bq, bk, window))(compute)
     else:
         compute()
 
@@ -229,7 +274,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
-                dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal, bq, bk):
+                dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
+                bq, bk, window=0):
     i = pl.program_id(1)                           # k-block index
     j = pl.program_id(2)                           # q-block index (inner)
     nq = pl.num_programs(2)
@@ -243,7 +289,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
         q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
         p, ds = _p_and_ds(q, k, v, do, lse_ref[0][:, :1],
                           -_delta(do, o_ref[0]), j, i, scale, causal,
-                          bq, bk)
+                          bq, bk, window)
         dv_scr[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -252,8 +298,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
             preferred_element_type=jnp.float32)
 
     if causal:
-        # Skip q blocks strictly above this k block's diagonal.
-        pl.when(_q_needed(i, j, bq, bk))(compute)
+        # Skip q blocks strictly above this k block's diagonal (and
+        # past the window horizon below it).
+        pl.when(_q_needed(i, j, bq, bk, window))(compute)
     else:
         compute()
 
@@ -263,16 +310,16 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd(q, k, v, out, lse, do, causal, bq, bk, interpret):
+def _bwd(q, k, v, out, lse, do, causal, bq, bk, interpret, window=0):
     BH, L, D = q.shape
     Lk = k.shape[1]
     scale = 1.0 / (D ** 0.5)
 
-    kv_map = _causal_kv_map(bq, bk) if causal else (
+    kv_map = _causal_kv_map(bq, bk, window) if causal else (
         lambda b, i, j: (b, j, 0))
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk),
+                          bq=bq, bk=bk, window=window),
         grid=(BH, L // bq, Lk // bk),
         in_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
@@ -288,11 +335,11 @@ def _bwd(q, k, v, out, lse, do, causal, bq, bk, interpret):
         interpret=interpret,
     )(q, k, v, do, out, lse)
 
-    q_map = _causal_q_map(bq, bk) if causal else (
+    q_map = _causal_q_map(bq, bk, window) if causal else (
         lambda b, i, j: (b, j, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk),
+                          bq=bq, bk=bk, window=window),
         grid=(BH, Lk // bk, L // bq),
         in_specs=[
             pl.BlockSpec((1, bq, D), q_map),
@@ -566,28 +613,29 @@ def flash_attention_partial(q: jax.Array, k: jax.Array, v: jax.Array, *,
 
 # ------------------------------------------------------------ public API
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, bq, bk, interpret):
-    out, _ = _fwd(q, k, v, causal, bq, bk, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, bq, bk, interpret, window):
+    out, _ = _fwd(q, k, v, causal, bq, bk, interpret, window)
     return out
 
 
-def _flash_fwd(q, k, v, causal, bq, bk, interpret):
-    out, lse = _fwd(q, k, v, causal, bq, bk, interpret)
+def _flash_fwd(q, k, v, causal, bq, bk, interpret, window):
+    out, lse = _fwd(q, k, v, causal, bq, bk, interpret, window)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, bq, bk, interpret, res, do):
+def _flash_bwd(causal, bq, bk, interpret, window, res, do):
     q, k, v, out, lse = res
-    return _bwd(q, k, v, out, lse, do, causal, bq, bk, interpret)
+    return _bwd(q, k, v, out, lse, do, causal, bq, bk, interpret,
+                window)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
-                    causal: bool = False, block_q: int = 1024,
-                    block_k: int = 1024,
+                    causal: bool = False, window: int = 0,
+                    block_q: int = 1024, block_k: int = 1024,
                     interpret: Optional[bool] = None) -> jax.Array:
     """Fused blockwise attention. q,k,v: [B, L, H, D] -> [B, L, H, D].
 
@@ -607,6 +655,11 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if window and not causal:
+        raise ValueError("window attention requires causal=True "
+                         "(sliding window over past positions)")
+    if window < 0:
+        raise ValueError(f"window must be >= 0, got {window}")
     B, L, H, D = q.shape
     Lk = k.shape[1]
     block_q = min(block_q, L)
@@ -623,7 +676,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         return jnp.transpose(x, (0, 2, 1, 3)).reshape(B * H, n, x.shape[3])
 
     out = _flash(pack(q), pack(k), pack(v), causal, block_q, block_k,
-                 interpret)
+                 interpret, window)
     return jnp.transpose(out.reshape(B, H, L, D), (0, 2, 1, 3))
 
 
@@ -651,7 +704,7 @@ def use_flash(L: int, Lk: int, D: int) -> bool:
 
 def attention(q: jax.Array, k: jax.Array, v: jax.Array,
               mask: Optional[jax.Array] = None, *,
-              causal: bool = False, mesh=None,
+              causal: bool = False, window: int = 0, mesh=None,
               allow_flash: bool = True) -> jax.Array:
     """Dispatcher for the single-shard attention path: the Pallas
     kernel on TPU when shapes allow, the XLA oracle otherwise.
@@ -680,12 +733,17 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array,
         AXIS_DATA, AXIS_EXPERT, AXIS_MODEL)
     from tensorflow_distributed_tpu.parallel.ring_attention import (
         full_attention)
+    if window and not causal:
+        # Same check flash_attention() makes — the XLA path must not
+        # silently drop the window for non-causal configs.
+        raise ValueError("window attention requires causal=True "
+                         "(sliding window over past positions)")
     B, L, H, D = q.shape
     if allow_flash and mask is None and use_flash(L, k.shape[1], D):
         from jax.sharding import PartitionSpec as P
         spec = P(AXIS_DATA, None, AXIS_MODEL, None)
         kernel = lambda q, k, v: flash_attention(  # noqa: E731
-            q, k, v, causal=causal)
+            q, k, v, causal=causal, window=window)
         ctx = jax.sharding.get_abstract_mesh()
         if ctx.manual_axes:
             # Inside an enclosing shard_map (the pipelined family's
@@ -704,15 +762,19 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array,
         if mesh is None or all(
                 mesh.shape[a] == 1
                 for a in (AXIS_DATA, AXIS_MODEL, AXIS_EXPERT)):
-            return flash_attention(q, k, v, causal=causal)
+            return flash_attention(q, k, v, causal=causal,
+                                   window=window)
         # GSPMD-partitioned step: fully-manual shard_map over the mesh;
         # batch and heads are embarrassingly parallel, no comms.
         return jax.shard_map(
             kernel, mesh=mesh, in_specs=(spec, spec, spec),
             out_specs=spec, check_vma=False)(q, k, v)
     if causal:
-        from tensorflow_distributed_tpu.parallel.ring_attention import (
-            causal_bias)
-        cmask = causal_bias(L, k.shape[1])
+        # window_keep is the same band the kernel masks with; as an
+        # additive bias for the XLA oracle path.
+        rows = jnp.arange(L)[:, None]
+        cols = jnp.arange(k.shape[1])[None, :]
+        cmask = jnp.where(window_keep(rows, cols, window), 0.0,
+                          float(NEG_INF))[None]
         mask = cmask if mask is None else mask + cmask
     return full_attention(q, k, v, mask)

@@ -105,6 +105,8 @@ def _build_model_and_state(cfg: TrainConfig, mesh, task):
             size_kw["shard_vocab"] = cfg.shard_vocab
         if cfg.n_kv_heads:
             size_kw["n_kv_heads"] = cfg.n_kv_heads
+        if cfg.attn_window:
+            size_kw["attn_window"] = cfg.attn_window
         if cfg.mlp_variant != "gelu":
             size_kw["mlp_variant"] = cfg.mlp_variant
         if cfg.norm != "layernorm":

@@ -144,6 +144,84 @@ def test_causal_multiblock_skip_matches_oracle():
                                    rtol=2e-4, atol=2e-4)
 
 
+def _window_bias(L, window):
+    from tensorflow_distributed_tpu.parallel.ring_attention import (
+        causal_bias)
+    rows = np.arange(L)[:, None]
+    cols = np.arange(L)[None, :]
+    extra = jnp.where(jnp.asarray(cols > rows - window), 0.0,
+                      float(NEG_INF))[None]
+    return causal_bias(L, L) + extra
+
+
+@pytest.mark.parametrize("window", [1, 17, 48, 64, 200, 256])
+def test_window_multiblock_matches_oracle(window):
+    """Sliding-window flash vs the dense masked oracle on an 8x4 block
+    grid (bq=32, bk=64): windows smaller than a block, spanning
+    several blocks, block-aligned, and >= L (== plain causal) all hit
+    the band predicates (_kv_needed/_q_needed) and the clamp index
+    maps differently. Forward AND all three gradient kernels."""
+    rng = np.random.default_rng(9)
+    B, L, H, D = 2, 256, 2, 16
+    mk = lambda: jnp.asarray(rng.normal(size=(B, L, H, D)), jnp.float32)
+    q, k, v = mk(), mk(), mk()
+
+    def flash(q, k, v):
+        return flash_attention(q, k, v, causal=True, window=window,
+                               block_q=32, block_k=64, interpret=True)
+
+    oracle_fn = lambda q, k, v: full_attention(  # noqa: E731
+        q, k, v, _window_bias(L, window))
+    np.testing.assert_allclose(np.asarray(flash(q, k, v)),
+                               np.asarray(oracle_fn(q, k, v)),
+                               rtol=2e-5, atol=2e-5)
+    gf = jax.grad(lambda q, k, v: jnp.sum(flash(q, k, v) ** 2),
+                  argnums=(0, 1, 2))(q, k, v)
+    go = jax.grad(lambda q, k, v: jnp.sum(oracle_fn(q, k, v) ** 2),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, go):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_window_at_or_past_length_equals_causal():
+    q, k, v = _qkv(seed=10)
+    plain = flash_attention(q, k, v, causal=True, block_q=64,
+                            block_k=64, interpret=True)
+    for w in (L, L + 100):
+        out = flash_attention(q, k, v, causal=True, window=w,
+                              block_q=64, block_k=64, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(plain),
+                                   rtol=1e-6, atol=1e-6)
+
+
+def test_window_requires_causal():
+    q, k, v = _qkv(seed=11)
+    with pytest.raises(ValueError, match="causal"):
+        flash_attention(q, k, v, window=8, interpret=True)
+    with pytest.raises(ValueError, match="window"):
+        flash_attention(q, k, v, causal=True, window=-1, interpret=True)
+    # The XLA-oracle dispatcher path must not silently drop the window
+    # for non-causal configs either.
+    with pytest.raises(ValueError, match="causal"):
+        attention(q, k, v, causal=False, window=8, allow_flash=False)
+
+
+def test_window_dispatcher_xla_fallback_matches_flash():
+    """attention() with a window on the non-flash path (allow_flash=
+    False) must agree with the windowed kernel — the two code paths a
+    user can land on depending on backend/shapes."""
+    rng = np.random.default_rng(12)
+    B, L2, H, D = 2, 128, 2, 16
+    mk = lambda: jnp.asarray(rng.normal(size=(B, L2, H, D)), jnp.float32)
+    q, k, v = mk(), mk(), mk()
+    xla = attention(q, k, v, causal=True, window=24, allow_flash=False)
+    fl = flash_attention(q, k, v, causal=True, window=24, block_q=32,
+                         block_k=32, interpret=True)
+    np.testing.assert_allclose(np.asarray(xla), np.asarray(fl),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_causal_multiblock_uneven_blocks():
     """bq != bk with bq > bk and bk > bq both exercise the floor-div
     arithmetic in the skip maps."""
