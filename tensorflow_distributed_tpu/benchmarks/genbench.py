@@ -36,6 +36,11 @@ def main(argv=None) -> None:
     parser.add_argument("--n-kv-heads", type=int, default=0,
                         help="also A/B decode with this many KV heads "
                         "(0 = skip the A/B)")
+    parser.add_argument("--kv-cache-quant", default="none",
+                        choices=["none", "int8"],
+                        help="also A/B decode with this cache "
+                        "storage (int8 halves the dominant decode "
+                        "HBM read vs bf16)")
     parser.add_argument("--iters", type=int, default=5)
     parser.add_argument("--out", default="")
     args = parser.parse_args(argv)
@@ -111,12 +116,19 @@ def main(argv=None) -> None:
             "new_tokens": args.new_tokens,
             "device": dev.device_kind, "n_kv_heads": model_kw.get(
                 "n_kv_heads", model.cfg.n_heads),
+            "kv_cache_quant": model_kw.get("kv_cache_quant", "none"),
         }
         return [dict(ln, **common) for ln in lines]
 
     lines = bench("")
     if args.n_kv_heads:
         lines += bench("_gqa", n_kv_heads=args.n_kv_heads)
+    if args.kv_cache_quant != "none":
+        lines += bench("_kvq", kv_cache_quant=args.kv_cache_quant)
+        if args.n_kv_heads:
+            # The composed story: narrow (GQA) AND thin (int8) cache.
+            lines += bench("_gqa_kvq", n_kv_heads=args.n_kv_heads,
+                           kv_cache_quant=args.kv_cache_quant)
 
     out = "\n".join(json.dumps(ln) for ln in lines)
     print(out)

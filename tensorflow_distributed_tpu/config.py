@@ -100,6 +100,10 @@ class TrainConfig:
     # decode cache to the window. Requires mesh.seq == 1 (the ring
     # schedule is not windowed; at W << L the window replaces it).
     attn_window: int = 0
+    # Decode KV-cache storage: "none" or "int8" (per-(token, head)
+    # absmax quantization, exact scale-adjusted int8 attend —
+    # models/transformer.py). Generation/eval path only.
+    kv_cache_quant: str = "none"
     # MLP nonlinearity for the transformer families: "gelu" (GPT-2/
     # BERT) or "swiglu" (gated, Llama-style).
     mlp_variant: str = "gelu"  # gelu | swiglu
@@ -371,6 +375,9 @@ class TrainConfig:
         if self.moe_experts < 0:
             raise ValueError(
                 f"moe_experts must be >= 0, got {self.moe_experts}")
+        if self.kv_cache_quant not in ("none", "int8"):
+            raise ValueError(
+                f"unknown kv_cache_quant {self.kv_cache_quant!r}")
         if self.attn_window < 0:
             raise ValueError(
                 f"attn_window must be >= 0, got {self.attn_window}")

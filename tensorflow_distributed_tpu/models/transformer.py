@@ -72,8 +72,6 @@ class TransformerConfig:
     # region, so the Mosaic call sees fully-manual axes ("Mosaic
     # kernels cannot be automatically partitioned" otherwise).
     use_flash: bool = True
-    # Mixture-of-Experts: 0 = dense MLP; > 0 replaces every block's MLP
-    # with an expert-parallel MoeMlp (models/moe.py).
     # Sliding-window attention (Mistral-style): each token attends
     # to the last `attn_window` positions only (0 = full causal).
     # Causal families only; rides the flash kernel's block-skip so
@@ -82,6 +80,15 @@ class TransformerConfig:
     # W << L this replaces ring attention (mesh.seq must be 1 —
     # windowing the zigzag schedule is not implemented).
     attn_window: int = 0
+    # KV-cache storage for decode: "none" (cache in compute dtype)
+    # or "int8" (per-(token, head) absmax quantization; the attend
+    # consumes int8 directly via exact scale-adjusted dots, so the
+    # full-cache HBM read — decode's dominant traffic — halves vs
+    # bf16). Composes with GQA: n_kv_heads narrows the cache,
+    # int8 thins it.
+    kv_cache_quant: str = "none"  # none | int8
+    # Mixture-of-Experts: 0 = dense MLP; > 0 replaces every block's MLP
+    # with an expert-parallel MoeMlp (models/moe.py).
     moe_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
@@ -262,48 +269,93 @@ class SelfAttention(nn.Module):
                 raise ValueError("decode=True needs a causal config")
             B, L = x.shape[0], x.shape[1]
             from tensorflow_distributed_tpu.parallel.ring_attention import (
-                _MASK, full_attention)
+                full_attention)
+            quant = cfg.kv_cache_quant == "int8"
+            cache_dt = jnp.int8 if quant else k.dtype
             ck = self.variable("cache", "key", jnp.zeros,
-                               (B, cfg.max_len, nk, dh), k.dtype)
+                               (B, cfg.max_len, nk, dh), cache_dt)
             cv = self.variable("cache", "value", jnp.zeros,
-                               (B, cfg.max_len, nk, dh), v.dtype)
+                               (B, cfg.max_len, nk, dh), cache_dt)
+            if quant:
+                # Per-(token, head) absmax scales — the standard
+                # inference quantization grain: one f32 per cached
+                # row, 2*dh fewer bytes than the row it scales.
+                cks = self.variable("cache", "key_scale", jnp.zeros,
+                                    (B, cfg.max_len, nk), jnp.float32)
+                cvs = self.variable("cache", "value_scale", jnp.zeros,
+                                    (B, cfg.max_len, nk), jnp.float32)
             ci = self.variable("cache", "index",
                                lambda: jnp.zeros((), jnp.int32))
             idx = ci.value
-            ck.value = jax.lax.dynamic_update_slice(ck.value, k,
-                                                    (0, idx, 0, 0))
-            cv.value = jax.lax.dynamic_update_slice(cv.value, v,
-                                                    (0, idx, 0, 0))
+
+            def q8(x):
+                scale = jnp.maximum(
+                    jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+                    / 127.0, 1e-8)                     # [B, L, nk]
+                rounded = jnp.round(x.astype(jnp.float32)
+                                    / scale[..., None])
+                return (jnp.clip(rounded, -127, 127).astype(jnp.int8),
+                        scale)
+
+            if quant:
+                k8, ks = q8(k)
+                v8, vs = q8(v)
+                ck.value = jax.lax.dynamic_update_slice(
+                    ck.value, k8, (0, idx, 0, 0))
+                cv.value = jax.lax.dynamic_update_slice(
+                    cv.value, v8, (0, idx, 0, 0))
+                cks.value = jax.lax.dynamic_update_slice(
+                    cks.value, ks, (0, idx, 0))
+                cvs.value = jax.lax.dynamic_update_slice(
+                    cvs.value, vs, (0, idx, 0))
+            else:
+                ck.value = jax.lax.dynamic_update_slice(ck.value, k,
+                                                        (0, idx, 0, 0))
+                cv.value = jax.lax.dynamic_update_slice(cv.value, v,
+                                                        (0, idx, 0, 0))
             ci.value = idx + L
             from tensorflow_distributed_tpu.ops.flash_attention import (
-                window_keep)
+                window_bias)
             rows = jnp.arange(L)[:, None]              # new-token offsets
             cols = jnp.arange(cfg.max_len)[None, :]
             # The SAME (pos - window, pos] band as training
-            # (window_keep is the one construction): cache entries
+            # (window_bias is the one construction): cache entries
             # older than the window are masked out.
-            bias = jnp.where(
-                window_keep(idx + rows, cols, cfg.attn_window),
-                0.0, _MASK)[None]
-            if nk == h:
-                out = full_attention(q, ck.value, cv.value, bias)
-            else:
-                # Grouped attend against the NARROW cache — widening
-                # it would re-materialize [B, max_len, H, Dh] every
-                # step and forfeit the decode-bandwidth win GQA
-                # exists for. Rows are never fully masked (the
-                # just-written diagonal entry at col idx+r is always
-                # inside the window band), so plain softmax is safe.
+            bias = window_bias(idx + rows, cols, cfg.attn_window)
+            def grouped_attend(kc, vc, kscale=None, vscale=None):
+                # ONE grouped attend for every cache layout (g == 1
+                # covers MHA): narrow (GQA) caches stay narrow, and
+                # int8 caches pass their per-(token, head) scales —
+                # the scale-adjusted dots are mathematically exact
+                # rescalings (q.dequant(K)^T = (q.K8^T) * kscale[col];
+                # P.dequant(V) = (P * vscale[col]).V8), so no
+                # dequantized cache is ever materialized and the only
+                # full-cache HBM reads are int8. Rows are never fully
+                # masked (the just-written diagonal entry at col
+                # idx+r is always inside the window band), so plain
+                # softmax is safe.
                 g = h // nk
                 qg = q.reshape(B, L, nk, g, dh).astype(jnp.float32)
                 s = jnp.einsum("bqngd,bknd->bngqk", qg,
-                               ck.value.astype(jnp.float32))
+                               kc.astype(jnp.float32))
+                if kscale is not None:
+                    s = s * kscale.transpose(0, 2, 1)[:, :, None, None]
                 s = s / jnp.sqrt(jnp.asarray(dh, jnp.float32))
                 s = s + bias[:, None, None]
                 p = jax.nn.softmax(s, axis=-1)
+                if vscale is not None:
+                    p = p * vscale.transpose(0, 2, 1)[:, :, None, None]
                 o = jnp.einsum("bngqk,bknd->bqngd", p,
-                               cv.value.astype(jnp.float32))
-                out = o.reshape(B, L, h, dh).astype(q.dtype)
+                               vc.astype(jnp.float32))
+                return o.reshape(B, L, h, dh).astype(q.dtype)
+
+            if quant:
+                out = grouped_attend(ck.value, cv.value, cks.value,
+                                     cvs.value)
+            elif nk == h:
+                out = full_attention(q, ck.value, cv.value, bias)
+            else:
+                out = grouped_attend(ck.value, cv.value)
         elif self.mesh is not None and self.mesh.shape[AXIS_SEQ] > 1:
             if cfg.attn_window:
                 raise ValueError(

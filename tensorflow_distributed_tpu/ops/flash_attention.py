@@ -58,6 +58,14 @@ def window_keep(rows, cols, window=0):
     return keep
 
 
+def window_bias(rows, cols, window=0):
+    """Additive-bias form of window_keep ([1, Lq, Lk]-broadcastable,
+    NEG_INF outside the band) — the one bias construction shared by
+    the XLA-oracle dispatcher path and the decode-cache mask."""
+    return jnp.where(window_keep(rows, cols, window), 0.0,
+                     float(NEG_INF))[None]
+
+
 def _causal_mask(s, i_q, i_k, bq, bk, window=0):
     """Causal mask, optionally sliding-window (window_keep)."""
     rows = i_q * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
@@ -770,11 +778,7 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array,
             kernel, mesh=mesh, in_specs=(spec, spec, spec),
             out_specs=spec, check_vma=False)(q, k, v)
     if causal:
-        # window_keep is the same band the kernel masks with; as an
-        # additive bias for the XLA oracle path.
-        rows = jnp.arange(L)[:, None]
-        cols = jnp.arange(k.shape[1])[None, :]
-        cmask = jnp.where(window_keep(rows, cols, window), 0.0,
-                          float(NEG_INF))[None]
+        cmask = window_bias(jnp.arange(L)[:, None],
+                            jnp.arange(k.shape[1])[None, :], window)
         mask = cmask if mask is None else mask + cmask
     return full_attention(q, k, v, mask)

@@ -107,6 +107,8 @@ def _build_model_and_state(cfg: TrainConfig, mesh, task):
             size_kw["n_kv_heads"] = cfg.n_kv_heads
         if cfg.attn_window:
             size_kw["attn_window"] = cfg.attn_window
+        if cfg.kv_cache_quant != "none":
+            size_kw["kv_cache_quant"] = cfg.kv_cache_quant
         if cfg.mlp_variant != "gelu":
             size_kw["mlp_variant"] = cfg.mlp_variant
         if cfg.norm != "layernorm":
