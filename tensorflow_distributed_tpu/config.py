@@ -241,6 +241,17 @@ class TrainConfig:
     # per stage). parallel.pipeline.pipeline_value_and_grad.
     pipeline_backward: str = "recompute"
 
+    # The runnable async-family mode (reference: sync_replicas=False,
+    # mnist_python_m.py:208,247-253; SURVEY N6): 1 = synchronous data
+    # parallelism (default — psum every step). H > 1 = local SGD:
+    # each data replica takes H optimizer steps on its own shard
+    # with NO gradient sync, then replicas pmean their params — the
+    # divergence-for-communication trade async-ps actually makes,
+    # expressed SPMD-native (train/local_sgd.py; exact sync-DP
+    # equivalence at H=1+SGD is a test). Pure-DP meshes, no EMA/
+    # grad-accum/ZeRO, models without mutable extra state.
+    param_sync_every: int = 1
+
     # --- eval / logging --------------------------------------------------
     eval_every: int = 100
     eval_batch_size: int = 1000  # reference validates 5x1000
@@ -366,6 +377,39 @@ class TrainConfig:
             raise ValueError(
                 f"label_smoothing must be in [0, 1), "
                 f"got {self.label_smoothing}")
+        if self.param_sync_every < 1:
+            raise ValueError(
+                f"param_sync_every must be >= 1, "
+                f"got {self.param_sync_every}")
+        if self.param_sync_every > 1:
+            bad = [a for a in ("model", "seq", "pipe", "expert")
+                   if getattr(self.mesh, a) > 1]
+            if bad:
+                raise ValueError(
+                    "param_sync_every > 1 (local SGD) needs a pure "
+                    f"data-parallel mesh; axes {bad} > 1")
+            if self.param_partition != "replicated":
+                raise ValueError(
+                    "param_sync_every > 1 needs "
+                    "param_partition=replicated (each replica owns "
+                    "its full diverged copy)")
+            if self.grad_accum_steps > 1:
+                raise ValueError(
+                    "param_sync_every > 1 does not compose with "
+                    "grad_accum_steps; raise batch_size instead")
+            if self.ema_decay:
+                raise ValueError(
+                    "param_sync_every > 1 does not compose with "
+                    "ema_decay (average-of-averages ambiguity)")
+            if self.model in ("resnet20", "resnet50"):
+                raise ValueError(
+                    "param_sync_every > 1 needs models without "
+                    "mutable extra state (BN statistics diverge "
+                    "with no principled average)")
+            if self.model == "pipelined_lm":
+                raise ValueError(
+                    "param_sync_every > 1 is a pure-DP mode; "
+                    "pipelined_lm is not supported")
         if not 0.0 <= self.ema_decay < 1.0:
             raise ValueError(
                 f"ema_decay must be in [0, 1), got {self.ema_decay}")

@@ -171,7 +171,9 @@ def save(ckpt_dir: str, state: Any, keep: int = 3,
     train loop does, at exit) before relying on ``latest_step``
     cluster-wide. A crash mid-write loses at most that checkpoint —
     the previous one is intact because publication is tmp+rename."""
-    step = int(jax.device_get(state.step))
+    # Local-SGD states carry a replica-stacked step [R] (identical
+    # values by construction); take the first for the checkpoint tag.
+    step = int(np.asarray(jax.device_get(state.step)).reshape(-1)[0])
     final = _step_dir(ckpt_dir, step)
     # Collective fetch BEFORE the chief gate: cross-process-partitioned
     # leaves need every process in the allgather. Non-chief processes

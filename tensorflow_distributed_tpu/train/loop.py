@@ -154,7 +154,15 @@ def evaluate_only(cfg: TrainConfig,
     mesh = make_mesh(cfg.mesh)
     task = make_task(cfg, mesh)
     _, state = _build_model_and_state(cfg, mesh, task)
-    state = ckpt.restore(cfg.checkpoint_dir, state)
+    if cfg.param_sync_every > 1:
+        # Local-SGD checkpoints persist the replica stack; restore
+        # into the stacked skeleton, evaluate the averaged view.
+        from tensorflow_distributed_tpu.train.local_sgd import (
+            averaged_view, stack_state)
+        state = averaged_view(
+            ckpt.restore(cfg.checkpoint_dir, stack_state(state, mesh)))
+    else:
+        state = ckpt.restore(cfg.checkpoint_dir, state)
     step = int(jax.device_get(state.step))
     eval_fn = make_eval_step(mesh, loss=task.eval_loss or task.loss,
                              batch_shardings=task.batch_shardings)
@@ -176,11 +184,26 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
     mesh = make_mesh(cfg.mesh)
     task = make_task(cfg, mesh)
     model, state = _build_model_and_state(cfg, mesh, task)
+    n_params = param_count(state.params)  # before replica stacking
+    local_sgd = cfg.param_sync_every > 1
+    if local_sgd:
+        from tensorflow_distributed_tpu.train.local_sgd import (
+            averaged_view, stack_state)
+        # Replica-stacked state from here on; checkpoints persist
+        # the stack (exact divergence survives resume), evals and
+        # the returned result use the averaged view.
+        state = stack_state(state, mesh)
+        view = averaged_view
+    else:
+        view = lambda s: s  # noqa: E731
 
     start_step = 0
     if cfg.resume and ckpt.latest_step(cfg.checkpoint_dir) is not None:
         state = ckpt.restore(cfg.checkpoint_dir, state)
-        start_step = int(jax.device_get(state.step))
+        # Stacked steps are identical across replicas; avoid paying
+        # a full averaged_view just to read the counter.
+        start_step = int(np.asarray(
+            jax.device_get(state.step)).reshape(-1)[0])
         logger.log_json({"event": "resumed", "step": start_step})
 
     if cfg.model == "pipelined_lm" and cfg.pipeline_schedule == "1f1b":
@@ -194,6 +217,13 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
                                        label_smoothing=cfg.label_smoothing,
                                        ema_decay=cfg.ema_decay,
                                        backward=cfg.pipeline_backward)
+    elif local_sgd:
+        from tensorflow_distributed_tpu.train.local_sgd import (
+            make_local_sgd_train_step)
+        step_fn = make_local_sgd_train_step(
+            mesh, cfg.param_sync_every, cfg.seed, loss=task.loss,
+            batch_shardings=task.batch_shardings,
+            grad_norm_metric=cfg.log_grad_norm)
     else:
         step_fn = make_train_step(
             mesh, cfg.seed, loss=task.loss,
@@ -206,7 +236,7 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
                              batch_shardings=task.batch_shardings)
     logger.log_json({
         "event": "start", "model": cfg.model, "task": task.name,
-        "params": param_count(state.params), "mesh": dict(mesh.shape),
+        "params": n_params, "mesh": dict(mesh.shape),
         "global_batch": cfg.batch_size, "start_step": start_step,
     })
 
@@ -232,7 +262,8 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
                     f"checkpoint: "
                     f"{ckpt.latest_step(cfg.checkpoint_dir) if cfg.checkpoint_dir else None}")
         if cfg.eval_every and step_now % cfg.eval_every == 0:
-            em = evaluate(state, eval_fn, task, mesh, cfg.eval_batch_size)
+            em = evaluate(view(state), eval_fn, task, mesh,
+                          cfg.eval_batch_size)
             logger.log(step_now, **{f"val_{k}": v for k, v in em.items()})
         if (cfg.checkpoint_dir and cfg.checkpoint_every
                 and step_now % cfg.checkpoint_every == 0):
@@ -298,9 +329,11 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
         ckpt.save(cfg.checkpoint_dir, state, cfg.keep_checkpoints,
                   background=cfg.checkpoint_async)
         ckpt.wait()
+    state_out = view(state)
     with Timer() as eval_t:
         final = ({} if preempted else
-                 evaluate(state, eval_fn, task, mesh, cfg.eval_batch_size))
+                 evaluate(state_out, eval_fn, task, mesh,
+                          cfg.eval_batch_size))
     if cfg.checkpoint_dir and not preempted:
         # The final save rides the SAME path as cadence saves: under
         # checkpoint_async a cadence save of this very step may still
@@ -316,15 +349,16 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
     # runs fewer than the configured horizon; reporting the horizon
     # would inflate throughput).
     steady_steps = max(
-        int(jax.device_get(state.step)) - start_step - steps_done, 0)
+        int(jax.device_get(state_out.step)) - start_step - steps_done, 0)
     sps = steady_steps / train_t.elapsed if train_t.elapsed > 0 else 0.0
     result = TrainResult(
-        state=state, train_seconds=compile_t.elapsed + train_t.elapsed,
+        state=state_out,
+        train_seconds=compile_t.elapsed + train_t.elapsed,
         eval_seconds=eval_t.elapsed, final_metrics=final,
         steps_per_sec=sps, images_per_sec=sps * cfg.batch_size,
         logger=logger)
     logger.log_json({
-        "event": "done", "steps": int(jax.device_get(state.step)),
+        "event": "done", "steps": int(jax.device_get(state_out.step)),
         "train_seconds": round(result.train_seconds, 3),
         "compile_seconds": round(compile_t.elapsed, 3),
         "steps_per_sec": round(sps, 3),
