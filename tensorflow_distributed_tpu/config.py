@@ -401,11 +401,14 @@ class TrainConfig:
                 raise ValueError(
                     "param_sync_every > 1 does not compose with "
                     "ema_decay (average-of-averages ambiguity)")
-            if self.model in ("resnet20", "resnet50"):
+            from tensorflow_distributed_tpu.models import (
+                MUTABLE_EXTRA_MODELS)
+            if self.model in MUTABLE_EXTRA_MODELS:
                 raise ValueError(
                     "param_sync_every > 1 needs models without "
                     "mutable extra state (BN statistics diverge "
-                    "with no principled average)")
+                    "with no principled average); "
+                    f"{self.model} carries them")
             if self.model == "pipelined_lm":
                 raise ValueError(
                     "param_sync_every > 1 is a pure-DP mode; "

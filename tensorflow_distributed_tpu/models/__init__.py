@@ -15,6 +15,13 @@ from tensorflow_distributed_tpu.models.cnn import MnistCNN  # noqa: F401
 MODEL_NAMES = ("mnist_cnn", "resnet20", "resnet50", "bert_mlm", "gpt_lm",
                "pipelined_lm", "moe_lm")
 
+# Families whose train state carries mutable variable collections
+# (BatchNorm statistics) — maintained HERE, next to the registry, so
+# capability checks (e.g. local SGD's no-divergent-stats rule,
+# config.validate) track new models; train.local_sgd.stack_state's
+# runtime extra-state check is the backstop.
+MUTABLE_EXTRA_MODELS = ("resnet20", "resnet50")
+
 
 def build_model(name: str, mesh=None, dropout_rate: Optional[float] = None,
                 init_scheme: str = "improved",

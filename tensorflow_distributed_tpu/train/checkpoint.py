@@ -82,6 +82,21 @@ def _fetch_host(state: Any, values: bool = True) -> Any:
     return out if values else None
 
 
+def host_step(state: Any) -> int:
+    """The state's step counter as a host int, replica-stack safe.
+
+    Local-SGD states carry a replica-stacked step [R] (identical
+    values by construction): index BEFORE device_get — an [R] array
+    sharded over a cross-process data axis is neither addressable
+    nor replicated (the _fetch_host restriction), but the [0]
+    indexing op produces a replicated scalar every process can
+    read."""
+    leaf = state.step
+    if getattr(leaf, "ndim", 0):
+        leaf = leaf[0]
+    return int(jax.device_get(leaf))
+
+
 def _step_dir(ckpt_dir: str, step: int) -> str:
     return os.path.join(ckpt_dir, f"{_STEP_PREFIX}{step:08d}")
 
@@ -171,9 +186,7 @@ def save(ckpt_dir: str, state: Any, keep: int = 3,
     train loop does, at exit) before relying on ``latest_step``
     cluster-wide. A crash mid-write loses at most that checkpoint —
     the previous one is intact because publication is tmp+rename."""
-    # Local-SGD states carry a replica-stacked step [R] (identical
-    # values by construction); take the first for the checkpoint tag.
-    step = int(np.asarray(jax.device_get(state.step)).reshape(-1)[0])
+    step = host_step(state)
     final = _step_dir(ckpt_dir, step)
     # Collective fetch BEFORE the chief gate: cross-process-partitioned
     # leaves need every process in the allgather. Non-chief processes
@@ -241,6 +254,40 @@ def wait() -> None:
             multihost_utils.sync_global_devices("tfd_ckpt_flush")
 
 
+def restore_averaged(ckpt_dir: str, state: Any,
+                     step: Optional[int] = None) -> Any:
+    """Restore a REPLICA-STACKED (local SGD) checkpoint into a PLAIN
+    template by averaging the replica dim on host — the mode=eval
+    path for local-SGD runs, independent of the evaluating mesh's
+    data-axis size (train on 8 replicas, validate on 1). Float
+    leaves average; integer leaves (step, opt counters) take
+    replica 0 (identical by construction)."""
+    step = step if step is not None else latest_step(ckpt_dir)
+    if step is None:
+        raise FileNotFoundError(f"no checkpoints under {ckpt_dir}")
+    path = os.path.join(_step_dir(ckpt_dir, step), "state.msgpack")
+    with open(path, "rb") as f:
+        raw = serialization.msgpack_restore(f.read())
+    if not (isinstance(raw, dict) and isinstance(raw.get("step"),
+                                                 np.ndarray)
+            and raw["step"].ndim == 1):
+        raise ValueError(
+            f"checkpoint at {path} is not replica-stacked (was it "
+            "saved with --param-sync-every > 1?)")
+
+    def mean0(x):
+        if isinstance(x, np.ndarray) and x.ndim:
+            if np.issubdtype(x.dtype, np.floating):
+                return x.mean(axis=0)
+            return x[0]
+        return x
+
+    for key in ("params", "opt_state", "step"):
+        if key in raw:
+            raw[key] = jax.tree_util.tree_map(mean0, raw[key])
+    return _restore_from_raw(raw, state)
+
+
 def restore(ckpt_dir: str, state: Any, step: Optional[int] = None) -> Any:
     """Restore into the structure/shardings of ``state`` (a freshly
     created template). ``step=None`` means latest."""
@@ -248,14 +295,20 @@ def restore(ckpt_dir: str, state: Any, step: Optional[int] = None) -> Any:
     if step is None:
         raise FileNotFoundError(f"no checkpoints under {ckpt_dir}")
     path = os.path.join(_step_dir(ckpt_dir, step), "state.msgpack")
+    with open(path, "rb") as f:
+        raw = serialization.msgpack_restore(f.read())
+    return _restore_from_raw(raw, state)
+
+
+def _restore_from_raw(raw: Any, state: Any) -> Any:
+    """Place a host state-dict into the template's structure and
+    shardings (the shared tail of restore/restore_averaged)."""
     # from_state_dict only needs the pytree STRUCTURE (plus leaf shapes
     # for shape-checking) — a zeros skeleton costs no device transfers
     # or collectives, unlike fetching the throwaway template's values.
     skeleton = jax.tree_util.tree_map(
         lambda leaf: np.zeros(leaf.shape, leaf.dtype)
         if isinstance(leaf, jax.Array) else leaf, state)
-    with open(path, "rb") as f:
-        raw = serialization.msgpack_restore(f.read())
     # EMA toggled between the saved run and this config must not brick
     # the restore: newly-enabled EMA seeds from the restored params
     # (the natural warm start); newly-disabled EMA drops the average.
@@ -276,6 +329,17 @@ def restore(ckpt_dir: str, state: Any, step: Optional[int] = None) -> Any:
     # a plain device_put of the full host value; each process supplies
     # its addressable shards via the callback form instead.
     def place(tmpl, host):
+        if (isinstance(tmpl, jax.Array)
+                and np.shape(host) != tmpl.shape):
+            # Catches replica-stacked vs plain state mismatches (a
+            # param_sync_every flip across --resume / mode=eval)
+            # with a clear error instead of an opaque shard_map
+            # shape failure — or silent garbage — downstream.
+            raise ValueError(
+                f"checkpoint leaf shape {np.shape(host)} != template "
+                f"{tmpl.shape}; was this run saved with a different "
+                "--param-sync-every (replica-stacked vs plain "
+                "state)?")
         if isinstance(tmpl, jax.Array) and not tmpl.is_fully_addressable:
             arr = np.asarray(host)
             return jax.make_array_from_callback(

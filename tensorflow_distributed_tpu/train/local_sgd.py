@@ -59,15 +59,22 @@ def stack_state(state: TrainState, mesh: Mesh) -> TrainState:
                          "(average-of-averages ambiguity); disable one")
     R = mesh.shape[AXIS_DATA]
 
-    def bcast(x):
-        x = jnp.asarray(x)
-        y = jnp.broadcast_to(x[None], (R,) + x.shape)
-        return jax.device_put(y, NamedSharding(mesh, P(AXIS_DATA)))
+    # Jitted with sharded out_shardings: XLA writes only each
+    # device's 1/R shard of the broadcast — no transient R-fold
+    # replicated copy of params + optimizer slots (an OOM risk at
+    # exactly the scale local SGD targets).
+    def bcast_tree(tree):
+        return jax.jit(
+            lambda t: jax.tree_util.tree_map(
+                lambda x: jnp.broadcast_to(x[None], (R,) + x.shape),
+                t),
+            out_shardings=NamedSharding(mesh, P(AXIS_DATA)))(tree)
 
     return state.replace(
-        step=bcast(state.step),
-        params=jax.tree_util.tree_map(bcast, state.params),
-        opt_state=jax.tree_util.tree_map(bcast, state.opt_state))
+        step=bcast_tree(jnp.asarray(state.step)),
+        params=bcast_tree(state.params),
+        opt_state=bcast_tree(jax.tree_util.tree_map(
+            jnp.asarray, state.opt_state)))
 
 
 def averaged_view(state: TrainState) -> TrainState:

@@ -155,12 +155,11 @@ def evaluate_only(cfg: TrainConfig,
     task = make_task(cfg, mesh)
     _, state = _build_model_and_state(cfg, mesh, task)
     if cfg.param_sync_every > 1:
-        # Local-SGD checkpoints persist the replica stack; restore
-        # into the stacked skeleton, evaluate the averaged view.
-        from tensorflow_distributed_tpu.train.local_sgd import (
-            averaged_view, stack_state)
-        state = averaged_view(
-            ckpt.restore(cfg.checkpoint_dir, stack_state(state, mesh)))
+        # Local-SGD checkpoints persist the replica stack; average
+        # it ON HOST into the plain template, so validation works on
+        # ANY mesh shape regardless of the training replica count
+        # (the documented eval-on-a-different-mesh capability).
+        state = ckpt.restore_averaged(cfg.checkpoint_dir, state)
     else:
         state = ckpt.restore(cfg.checkpoint_dir, state)
     step = int(jax.device_get(state.step))
@@ -200,10 +199,7 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
     start_step = 0
     if cfg.resume and ckpt.latest_step(cfg.checkpoint_dir) is not None:
         state = ckpt.restore(cfg.checkpoint_dir, state)
-        # Stacked steps are identical across replicas; avoid paying
-        # a full averaged_view just to read the counter.
-        start_step = int(np.asarray(
-            jax.device_get(state.step)).reshape(-1)[0])
+        start_step = ckpt.host_step(state)
         logger.log_json({"event": "resumed", "step": start_step})
 
     if cfg.model == "pipelined_lm" and cfg.pipeline_schedule == "1f1b":

@@ -101,6 +101,20 @@ def test_config_validation():
             TrainConfig(batch_size=32, **kw).validate()
 
 
+def test_sync_flip_across_resume_is_a_clear_error(tmp_path, mesh8):
+    """A checkpoint saved with one param_sync_every cannot silently
+    load into the other layout: restore's shape check names the knob
+    instead of failing opaquely inside the shard_map (or training on
+    garbage slices)."""
+    from tensorflow_distributed_tpu.train import checkpoint as ckpt
+
+    state, _ = _setup(mesh8, optax.sgd(1e-2))
+    ckpt.save(str(tmp_path), state)  # plain (unstacked) checkpoint
+    stacked_tmpl = stack_state(state, mesh8)
+    with pytest.raises(ValueError, match="param-sync-every"):
+        ckpt.restore(str(tmp_path), stacked_tmpl)
+
+
 @pytest.mark.slow
 def test_local_sgd_trains_and_resumes(tmp_path):
     """The full loop: H=4 local SGD reaches the synthetic-digit bar,
@@ -133,3 +147,24 @@ def test_local_sgd_trains_and_resumes(tmp_path):
         mesh=MeshConfig(data=8)))
     np.testing.assert_allclose(m["accuracy"],
                                r2.final_metrics["accuracy"], rtol=1e-5)
+
+    # The cross-mesh half of the capability: the stacked checkpoint
+    # averages ON HOST into a template on a DIFFERENT mesh (1 device
+    # vs 8 training replicas) — the restore path mode=eval rides.
+    from tensorflow_distributed_tpu.parallel.mesh import (
+        single_device_mesh)
+    from tensorflow_distributed_tpu.train import checkpoint as ckpt
+
+    from tensorflow_distributed_tpu.train.optim import make_optimizer
+
+    mesh1 = single_device_mesh(jax.devices()[0])
+    model = MnistCNN(dropout_rate=0.0, compute_dtype=jnp.float32)
+    tmpl = create_train_state(model, make_optimizer(cfg2),
+                              jnp.zeros((2, 28, 28, 1), jnp.float32),
+                              mesh1)
+    restored = ckpt.restore_averaged(str(tmp_path), tmpl)
+    want = averaged_view(r2.state) if r2.state.step.ndim else r2.state
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-6),
+        jax.device_get(restored.params), jax.device_get(want.params))
