@@ -46,34 +46,39 @@ def test_unknown_variants_raise():
             jax.random.key(0), _tokens())
 
 
-def test_llama_style_stack_trains_decodes_generates():
-    """The full modern composition in one model: rotary positions,
-    grouped KV heads, gated MLP, RMSNorm, tied output projection —
-    trains, cache-decodes at parity, and generates."""
-    from tensorflow_distributed_tpu.models.generate import generate
-
+def _stack_roundtrip(extra_cfg, toks, atol, rtol):
+    """Shared skeleton for the full-composition tests: init, forward-
+    vs-decode parity at the given tolerance, grad finiteness; returns
+    (model, params) for composition-specific follow-ups."""
     model = CausalLM(tiny_config(
         causal=True, pos_emb="rope", n_kv_heads=2, mlp_variant="swiglu",
         norm="rmsnorm", tie_embeddings=True, max_len=64,
-        compute_dtype=jnp.float32))
-    toks = _tokens(l=16)
+        compute_dtype=jnp.float32, **extra_cfg))
     params = model.init(jax.random.key(0), toks)["params"]
     assert "lm_head" not in params and "pos_emb" not in params
 
     full = model.apply({"params": params}, toks)
-    logits, state = model.apply({"params": params}, toks,
-                                decode=True,
-                                positions=jnp.arange(16)[None, :],
-                                mutable=["cache"])
+    logits, _ = model.apply({"params": params}, toks, decode=True,
+                            positions=jnp.arange(toks.shape[1])[None, :],
+                            mutable=["cache"])
     np.testing.assert_allclose(np.asarray(logits), np.asarray(full),
-                               atol=1e-4, rtol=1e-3)
+                               atol=atol, rtol=rtol)
 
     loss, grads = jax.value_and_grad(
         lambda p: jnp.mean(model.apply({"params": p}, toks) ** 2))(params)
     assert np.isfinite(float(loss))
     assert all(np.isfinite(np.asarray(g)).all()
                for g in jax.tree_util.tree_leaves(grads))
+    return model, params
 
+
+def test_llama_style_stack_trains_decodes_generates():
+    """The full modern composition in one model: rotary positions,
+    grouped KV heads, gated MLP, RMSNorm, tied output projection —
+    trains, cache-decodes at parity, and generates."""
+    from tensorflow_distributed_tpu.models.generate import generate
+
+    model, params = _stack_roundtrip({}, _tokens(l=16), 1e-4, 1e-3)
     out = generate(model, params, jnp.asarray([[1, 2, 3]], jnp.int32), 5,
                    temperature=0.7, top_p=0.9, key=jax.random.key(1))
     assert out.shape == (1, 5)
@@ -133,3 +138,20 @@ def test_llama_knobs_through_pipeline(devices8):
                         seq_axis=1)
     _, metrics = step(state, batch)
     assert np.isfinite(float(metrics["loss"]))
+
+
+def test_mistral_style_stack_trains_decodes_generates():
+    """The round-4 composition on top of the Llama stack: sliding-
+    window attention + int8 KV cache — decode tracks the windowed
+    forward within quantization error (including past the window
+    horizon), and generates deterministically."""
+    from tensorflow_distributed_tpu.models.generate import generate
+
+    model, params = _stack_roundtrip(
+        dict(attn_window=6, kv_cache_quant="int8"), _tokens(l=16, seed=2),
+        0.05, 0.05)
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    out1 = generate(model, params, prompt, 8)
+    out2 = generate(model, params, prompt, 8)
+    assert out1.shape == (1, 8)
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
