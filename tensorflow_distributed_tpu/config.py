@@ -144,6 +144,14 @@ class TrainConfig:
     # over them). 0 = the default (64). dataset="text" ignores it (the
     # byte corpus pins vocab to 256).
     synthetic_vocab: int = 0
+    # dataset='text' tokenization: "byte" (vocab = the 256 byte
+    # values, works on any file) or "bpe" (byte-level BPE trained ON
+    # the corpus — no downloads; cached next to the file). The model
+    # vocab follows the tokenizer (data/lm.py::text_clm).
+    text_tokenizer: str = "byte"  # byte | bpe
+    # Target merge count for text_tokenizer='bpe' (uint16 storage
+    # caps it at 65536; tiny corpora may train fewer).
+    bpe_vocab_size: int = 8192
     # Global batch. Reference: 128 per worker x 2 workers = 256 global
     # (mnist_python_m.py:70, replicas_to_aggregate :62-65).
     batch_size: int = 256
@@ -458,6 +466,14 @@ class TrainConfig:
             raise ValueError(
                 f"moe_capacity_factor must be > 0, "
                 f"got {self.moe_capacity_factor}")
+        if self.text_tokenizer not in ("byte", "bpe"):
+            raise ValueError(
+                f"unknown text_tokenizer {self.text_tokenizer!r}")
+        if self.text_tokenizer == "bpe" and not (
+                2 <= self.bpe_vocab_size <= 65536):
+            raise ValueError(
+                f"bpe_vocab_size must be in [2, 65536], "
+                f"got {self.bpe_vocab_size}")
         if self.moe_dispatch not in ("dense", "scatter"):
             raise ValueError(
                 f"unknown moe_dispatch {self.moe_dispatch!r}")

@@ -91,31 +91,122 @@ def synthetic_clm(n: int = 2048, seq_len: int = 128, vocab_size: int = 64,
                      vocab_size=vocab_size)
 
 
-def text_clm(path: str, seq_len: int = 128, seed: int = 0,
-             val_fraction: float = 0.1) -> tuple:
-    """Byte-level causal-LM datasets from a LOCAL text/binary file —
-    a real corpus path with zero egress and zero tokenizer downloads:
-    the vocabulary is the 256 byte values (char-level GPT, the nanoGPT
-    recipe). Returns (train, val) LmDatasets in the same
-    {tokens, targets, mask} layout as the synthetic generators.
+def train_or_load_bpe(path: str, vocab_size: int):
+    """Byte-level BPE trained ON the local corpus (HF ``tokenizers``,
+    which is baked into this image — no downloads, no egress).
+    UTF-8 TEXT files only (the trainer reads UTF-8; binary corpora
+    use tokenizer="byte") — text_clm validates that up front, and
+    within that contract ByteLevel pre-tokenization is lossless.
 
-    The file is split into non-overlapping (seq_len + 1)-byte windows;
-    the last seq_len bytes of each window are the targets of the first
-    seq_len. Windows are deterministically shuffled per ``seed``, and
-    the LAST ``val_fraction`` of the shuffle is held out — a random
-    split, so train and val share the same distribution even for files
-    whose style drifts start to end.
+    The trained vocab caches next to the corpus as
+    ``<path>.bpe<V>.<contenthash>.json`` — keyed by CONTENT, so
+    editing the corpus retrains instead of silently reusing a vocab
+    whose alphabet may not cover the new text (BPE has no unk token
+    here; unseen symbols would be silently dropped). The save is
+    atomic (tmp + os.replace): concurrent processes on a shared
+    filesystem at worst train redundantly, never read torn JSON."""
+    import hashlib
+    import os
+
+    from tokenizers import Tokenizer, decoders, models, pre_tokenizers
+    from tokenizers import trainers
+
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    cache = f"{path}.bpe{vocab_size}.{h.hexdigest()[:12]}.json"
+    if os.path.exists(cache):
+        return Tokenizer.from_file(cache)
+    tok = Tokenizer(models.BPE())
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    tok.train([path], trainers.BpeTrainer(vocab_size=vocab_size,
+                                          special_tokens=[],
+                                          show_progress=False))
+    tmp = f"{cache}.tmp.{os.getpid()}"
+    tok.save(tmp)
+    os.replace(tmp, cache)
+    return tok
+
+
+def _encode_corpus(path: str, tok) -> np.ndarray:
+    """Encode the corpus line-by-line into a compact uint16 buffer
+    (array.array, ~2 bytes/token transient — not a list of boxed
+    ints). newline="" disables universal-newline translation so the
+    encoder sees exactly the bytes the trainer saw (CRLF preserved);
+    errors="strict" + the text_clm validation guarantee UTF-8.
+    Encoding per line (overlong lines chunked at 1 MiB) only forbids
+    merges across those boundaries — standard and deterministic."""
+    import array
+
+    ids = array.array("H")
+    lim = 1 << 20
+    with open(path, "r", encoding="utf-8", errors="strict",
+              newline="") as f:
+        for line in f:
+            for i in range(0, len(line), lim):
+                ids.extend(tok.encode(line[i:i + lim]).ids)
+    return np.frombuffer(ids.tobytes(), dtype=np.uint16).copy()
+
+
+def text_clm(path: str, seq_len: int = 128, seed: int = 0,
+             val_fraction: float = 0.1, tokenizer: str = "byte",
+             bpe_vocab_size: int = 8192) -> tuple:
+    """Causal-LM datasets from a LOCAL text/binary file — a real corpus
+    path with zero egress. Two tokenizations:
+
+    - "byte" (default): the vocabulary is the 256 byte values
+      (char-level GPT, the nanoGPT recipe) — works on ANY file.
+    - "bpe": a byte-level BPE of ``bpe_vocab_size`` merges trained on
+      THIS corpus (train_or_load_bpe) — the subword path real LM
+      training uses; ~3-4x more text per window at the same seq_len.
+
+    Returns (train, val) LmDatasets in the same {tokens, targets, mask}
+    layout as the synthetic generators. The token stream is split into
+    non-overlapping (seq_len + 1)-token windows; the last seq_len
+    tokens of each window are the targets of the first seq_len.
+    Windows are deterministically shuffled per ``seed``, and the LAST
+    ``val_fraction`` of the shuffle is held out — a random split, so
+    train and val share the same distribution even for files whose
+    style drifts start to end.
     """
-    data = np.fromfile(path, dtype=np.uint8)
+    if tokenizer == "byte":
+        data = np.fromfile(path, dtype=np.uint8)
+        vocab = 256
+    elif tokenizer == "bpe":
+        if not 2 <= bpe_vocab_size <= 65536:
+            raise ValueError(
+                f"bpe_vocab_size must be in [2, 65536] (uint16 storage),"
+                f" got {bpe_vocab_size}")
+        try:
+            with open(path, "rb") as f:
+                f.read().decode("utf-8")
+        except UnicodeDecodeError as e:
+            raise ValueError(
+                f"{path!r} is not valid UTF-8 ({e}); "
+                "tokenizer='bpe' needs a text corpus — use "
+                "tokenizer='byte' for binary files") from None
+        tok = train_or_load_bpe(path, bpe_vocab_size)
+        data = _encode_corpus(path, tok)
+        # The trained vocab can come out smaller than requested on
+        # tiny corpora; the MODEL vocab must cover every emitted id
+        # (guarded: the too-small error below fires before max() on
+        # a near-empty stream).
+        vocab = int(tok.get_vocab_size())
+        if len(data):
+            vocab = max(vocab, int(data.max()) + 1)
+    else:
+        raise ValueError(f"tokenizer {tokenizer!r}; have ('byte', 'bpe')")
     win = seq_len + 1
     n = len(data) // win
     if n < 2:
         raise ValueError(
-            f"{path!r}: {len(data)} bytes < 2 windows of {win} "
-            f"(need seq_len+1 bytes per sequence)")
-    # Stay uint8 on the host (1 byte/token; batch() casts per batch)
-    # and skip the all-ones mask entirely — a 2 GB corpus costs ~2 GB
-    # here, not ~16.
+            f"{path!r}: {len(data)} tokens < 2 windows of {win} "
+            f"(need seq_len+1 tokens per sequence)")
+    # Stay narrow on the host (1-2 bytes/token; batch() casts per
+    # batch) and skip the all-ones mask entirely — a 2 GB corpus costs
+    # ~2 GB here, not ~16.
     seq = data[:n * win].reshape(n, win)
     order = np.random.default_rng(seed).permutation(n)
     seq = seq[order]
@@ -123,7 +214,7 @@ def text_clm(path: str, seq_len: int = 128, seed: int = 0,
 
     def make(rows):
         return LmDataset(tokens=rows[:, :-1], targets=rows[:, 1:],
-                         mask=None, vocab_size=256)
+                         mask=None, vocab_size=vocab)
 
     return make(seq[:-n_val]), make(seq[-n_val:])
 

@@ -114,8 +114,11 @@ def _build_model_and_state(cfg: TrainConfig, mesh, task):
         if cfg.norm != "layernorm":
             size_kw["norm"] = cfg.norm
         if cfg.dataset == "text":
-            # Byte-level corpus: the vocabulary IS the 256 byte values.
-            size_kw["vocab_size"] = 256
+            # The model vocab follows the TOKENIZER: 256 byte values,
+            # or whatever the corpus-trained BPE actually emitted
+            # (task.vocab_size reads the built dataset — tiny corpora
+            # can train fewer merges than requested).
+            size_kw["vocab_size"] = task.vocab_size
         elif cfg.synthetic_vocab:
             size_kw["vocab_size"] = cfg.synthetic_vocab
         if cfg.seq_len:

@@ -39,6 +39,11 @@ class Task:
     # regularizers (label smoothing) stay out of reported validation
     # numbers so they're comparable across smoothing settings.
     eval_loss: Optional[step_lib.LossFn] = None
+    # The dataset's actual vocabulary (LM tasks) — 0 for vision.
+    # train.loop sizes the model's embedding from this for
+    # dataset='text', where the tokenizer decides (256 bytes, or
+    # whatever the corpus-trained BPE emitted).
+    vocab_size: int = 0
 
 
 # --- vision (the reference's task) --------------------------------------
@@ -180,16 +185,19 @@ def _make_lm_task(cfg: TrainConfig, mesh: Mesh, objective: str,
     vocab_size = cfg.synthetic_vocab or vocab_size
 
     if cfg.dataset == "text":
-        # Byte-level causal LM over a LOCAL file (data.lm.text_clm):
-        # the real-corpus path, no egress, vocab = the 256 byte values
-        # (the model is built with vocab_size=256 by train.loop).
+        # Causal LM over a LOCAL file (data.lm.text_clm): the real-
+        # corpus path, no egress. The tokenizer decides the vocab —
+        # 256 byte values, or the corpus-trained BPE's actual size —
+        # and train.loop sizes the model from Task.vocab_size.
         if not objective.endswith("clm"):
             raise ValueError(
                 "dataset='text' is causal-LM only (gpt_lm / moe_lm / "
                 "pipelined_lm); bert_mlm has no byte-masking stream")
         from tensorflow_distributed_tpu.data.lm import text_clm
         train_ds, val_ds = text_clm(cfg.data_dir, seq_len=seq_len,
-                                    seed=cfg.seed)
+                                    seed=cfg.seed,
+                                    tokenizer=cfg.text_tokenizer,
+                                    bpe_vocab_size=cfg.bpe_vocab_size)
         # Fail at task creation, not after training: the final eval
         # needs >= one data-axis-wide batch of val rows, and the
         # batcher needs a full train batch.
@@ -246,7 +254,8 @@ def _make_lm_task(cfg: TrainConfig, mesh: Mesh, objective: str,
             (max(2, dict(mesh.shape).get(AXIS_DATA, 1)), seq_len),
             np.int32), seq_axis=1,
         train_stream=batcher.forever, eval_batches=eval_batches,
-        eval_size=len(val_ds), steps_per_epoch=batcher.steps_per_epoch)
+        eval_size=len(val_ds), steps_per_epoch=batcher.steps_per_epoch,
+        vocab_size=train_ds.vocab_size)
 
 
 def make_task(cfg: TrainConfig, mesh: Mesh) -> Task:
