@@ -179,9 +179,14 @@ def text_clm(path: str, seq_len: int = 128, seed: int = 0,
             raise ValueError(
                 f"bpe_vocab_size must be in [2, 65536] (uint16 storage),"
                 f" got {bpe_vocab_size}")
+        import codecs
+
+        dec = codecs.getincrementaldecoder("utf-8")()
         try:
             with open(path, "rb") as f:
-                f.read().decode("utf-8")
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    dec.decode(chunk)      # O(chunk) memory
+                dec.decode(b"", final=True)
         except UnicodeDecodeError as e:
             raise ValueError(
                 f"{path!r} is not valid UTF-8 ({e}); "

@@ -132,3 +132,145 @@ def generate(model, params, prompt: jax.Array, max_new_tokens: int, *,
         top_k, top_p = 0, 1.0
     return _compiled(model, max_new_tokens, temperature, top_k,
                      float(top_p))(params, prompt, key)
+
+
+@functools.lru_cache(maxsize=32)
+def _compiled_beam(model, max_new_tokens: int, num_beams: int,
+                   length_penalty: float, eos_id: int):
+    """One jitted beam-search program per (model, N, K, penalty, eos).
+
+    TPU-native shape discipline: beams ride a flat [B*K] batch through
+    the SAME cached decode path greedy uses (prefill once per beam,
+    one token per step under lax.scan, static shapes everywhere); the
+    per-step reindex after top-k is a batched gather of the cache
+    pytree along the flat beam dim.
+    """
+
+    @jax.jit
+    def run(params, prompt):
+        B, P = prompt.shape
+        K = num_beams
+        V = model.cfg.vocab_size
+        NEG = jnp.asarray(-1e30, jnp.float32)
+
+        # Prefill ONCE per batch row, then tile the cache to [B*K]:
+        # the K beam copies are byte-identical, so repeating the
+        # cache leaves costs 1/K of the prompt-dominant prefill
+        # FLOPs and HBM traffic that repeating the PROMPT would.
+        logits, state = model.apply(
+            {"params": params}, prompt, decode=True,
+            positions=jnp.arange(P)[None, :], mutable=["cache"])
+        cache = jax.tree_util.tree_map(
+            lambda c: jnp.repeat(c, K, axis=0)
+            if getattr(c, "ndim", 0) and c.shape[0] == B else c,
+            state["cache"])
+        logp0 = jax.nn.log_softmax(
+            logits[:, -1, :].astype(jnp.float32))      # [B, V]
+        # First expansion: B x top-K over the vocab seeds the beams.
+        scores, tok0 = jax.lax.top_k(logp0, K)         # [B, K]
+        toks0 = tok0.reshape(B * K).astype(jnp.int32)
+        alive0 = (toks0.reshape(B, K) != eos_id) if eos_id >= 0 else \
+            jnp.ones((B, K), bool)
+
+        def step(carry, i):
+            cache, scores, alive, tok = carry
+            logits, state = model.apply(
+                {"params": params, "cache": cache}, tok[:, None],
+                decode=True, positions=jnp.full((1, 1), P + i),
+                mutable=["cache"])  # fed token sits AT position P + i
+            cache = state["cache"]
+            logp = jax.nn.log_softmax(
+                logits[:, -1, :].astype(jnp.float32)).reshape(B, K, V)
+            # Finished beams emit ONLY eos at zero cost, so they keep
+            # their score and stay comparable with live beams.
+            if eos_id >= 0:
+                frozen = jnp.full((V,), NEG).at[eos_id].set(0.0)
+                logp = jnp.where(alive[..., None], logp, frozen)
+            cand = scores[..., None] + logp            # [B, K, V]
+            flat_scores, flat_idx = jax.lax.top_k(
+                cand.reshape(B, K * V), K)             # [B, K]
+            beam_idx = flat_idx // V                   # [B, K]
+            new_tok = (flat_idx % V).astype(jnp.int32)
+            gather = (jnp.arange(B)[:, None] * K
+                      + beam_idx).reshape(B * K)       # flat reindex
+            cache = jax.tree_util.tree_map(
+                lambda c: jnp.take(c, gather, axis=0)
+                if getattr(c, "ndim", 0) and c.shape[0] == B * K else c,
+                cache)
+            alive = jnp.take_along_axis(alive, beam_idx, axis=1)
+            if eos_id >= 0:
+                alive = jnp.logical_and(alive, new_tok != eos_id)
+            return ((cache, flat_scores, alive,
+                     new_tok.reshape(B * K)),
+                    (new_tok, beam_idx))
+
+        (_, scores, _, _), (toks, parents) = jax.lax.scan(
+            step, (cache, scores, alive0, toks0),
+            jnp.arange(max_new_tokens - 1))
+
+        # Backtrack parents to materialize each beam's token path.
+        def back(carry, sp):
+            ptr = carry                                # [B, K]
+            t, par = sp
+            tok_here = jnp.take_along_axis(t, ptr, axis=1)
+            ptr = jnp.take_along_axis(par, ptr, axis=1)
+            return ptr, tok_here
+
+        ptr0 = jnp.tile(jnp.arange(K)[None], (B, 1))
+        ptr, rev = jax.lax.scan(back, ptr0, (toks, parents),
+                                reverse=True)
+        first = jnp.take_along_axis(tok0, ptr, axis=1) # [B, K]
+        seq = jnp.concatenate([first[:, :, None],
+                               jnp.moveaxis(rev, 0, 2)], axis=2)
+        # Length-normalized ranking (GNMT-style): finished beams are
+        # shorter than max_new_tokens only when eos fired; count real
+        # tokens up to and including the first eos.
+        if eos_id >= 0:
+            is_eos = seq == eos_id
+            any_eos = is_eos.any(axis=2)
+            first_eos = jnp.argmax(is_eos, axis=2)
+            length = jnp.where(any_eos, first_eos + 1, seq.shape[2])
+        else:
+            length = jnp.full((B, K), seq.shape[2])
+        norm = scores / (length.astype(jnp.float32) ** length_penalty)
+        order = jnp.argsort(-norm, axis=1)
+        seq = jnp.take_along_axis(seq, order[:, :, None], axis=1)
+        return seq, jnp.take_along_axis(norm, order, axis=1)
+
+    return run
+
+
+def beam_search(model, params, prompt: jax.Array, max_new_tokens: int,
+                *, num_beams: int = 4, length_penalty: float = 1.0,
+                eos_id: Optional[int] = None):
+    """Beam-search continuation of ``prompt`` [B, P]: returns
+    (sequences [B, num_beams, max_new_tokens], scores [B, num_beams]),
+    beams sorted best-first by length-normalized log-probability
+    (GNMT ``length_penalty``; 0 disables normalization).
+
+    ``eos_id``: beams that emit it freeze (score kept, eos-padded) —
+    the standard early-finish semantics; None runs every beam to the
+    full budget. num_beams=1 is exactly greedy decoding (tested).
+    Same requirements as ``generate`` (causal model, mesh seq 1)."""
+    cfg = model.cfg
+    if not cfg.causal:
+        raise ValueError("beam_search() needs a causal model")
+    B, P = prompt.shape
+    if P + max_new_tokens > cfg.max_len:
+        raise ValueError(
+            f"prompt {P} + {max_new_tokens} new > max_len {cfg.max_len}")
+    if num_beams < 1:
+        raise ValueError(f"num_beams must be >= 1, got {num_beams}")
+    if num_beams > cfg.vocab_size:
+        raise ValueError(
+            f"num_beams {num_beams} > vocab_size {cfg.vocab_size} "
+            "(the first expansion is a top-k over the vocabulary)")
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    if eos_id is not None and not 0 <= eos_id < cfg.vocab_size:
+        raise ValueError(f"eos_id {eos_id} outside vocab "
+                         f"[0, {cfg.vocab_size})")
+    return _compiled_beam(model, max_new_tokens, num_beams,
+                          float(length_penalty),
+                          -1 if eos_id is None else int(eos_id))(
+        params, prompt)

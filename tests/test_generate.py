@@ -201,3 +201,58 @@ def test_kv_cache_quant_validation():
     with pytest.raises(ValueError, match="kv_cache_quant"):
         TrainConfig(model="gpt_lm", kv_cache_quant="fp4",
                     batch_size=32).validate()
+
+
+def test_beam_search_k1_is_greedy_and_beams_ordered():
+    """num_beams=1 must reproduce greedy decoding token for token; at
+    K=4 the returned beams are sorted best-first and the top beam's
+    raw score can only match or beat the greedy path's log-prob."""
+    from tensorflow_distributed_tpu.models.generate import beam_search
+
+    model = _model()
+    prompt = jnp.asarray(
+        np.random.default_rng(7).integers(0, 64, size=(3, 5)), jnp.int32)
+    params = model.init(jax.random.key(0), jnp.zeros((2, 16),
+                                                     jnp.int32))["params"]
+    greedy = generate(model, params, prompt, 6)
+    seq1, sc1 = beam_search(model, params, prompt, 6, num_beams=1,
+                            length_penalty=0.0)
+    np.testing.assert_array_equal(np.asarray(seq1[:, 0]),
+                                  np.asarray(greedy))
+
+    seq4, sc4 = beam_search(model, params, prompt, 6, num_beams=4,
+                            length_penalty=0.0)
+    assert seq4.shape == (3, 4, 6) and sc4.shape == (3, 4)
+    sc = np.asarray(sc4)
+    assert (np.diff(sc, axis=1) <= 1e-6).all()        # sorted desc
+    # With length_penalty=0 the scores are raw sums of log-probs; the
+    # best beam cannot be worse than the greedy path it contains in
+    # its search space.
+    np.testing.assert_array_compare(
+        lambda a, b: a >= b - 1e-5, sc[:, 0], np.asarray(sc1[:, 0]))
+    # Determinism.
+    seq4b, _ = beam_search(model, params, prompt, 6, num_beams=4,
+                           length_penalty=0.0)
+    np.testing.assert_array_equal(np.asarray(seq4), np.asarray(seq4b))
+
+
+def test_beam_search_eos_freezes_beams():
+    """A beam that emits eos_id freezes: it pads with eos at no score
+    cost and keeps competing on its frozen score."""
+    from tensorflow_distributed_tpu.models.generate import beam_search
+
+    model = _model()
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    params = model.init(jax.random.key(1), jnp.zeros((2, 16),
+                                                     jnp.int32))["params"]
+    seq, _ = beam_search(model, params, prompt, 8, num_beams=4, eos_id=5)
+    s = np.asarray(seq[0])
+    for beam in s:
+        hits = np.where(beam == 5)[0]
+        if hits.size:                                  # eos fired =>
+            assert (beam[hits[0]:] == 5).all()         # eos-padded tail
+
+    with pytest.raises(ValueError, match="eos_id"):
+        beam_search(model, params, prompt, 4, eos_id=999)
+    with pytest.raises(ValueError, match="num_beams"):
+        beam_search(model, params, prompt, 4, num_beams=0)
