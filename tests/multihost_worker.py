@@ -116,6 +116,32 @@ def main() -> None:
         with open(out_path, "w") as f:
             json.dump(run_xaxes_scenarios(_fetch_host), f)
         return
+    if phase == "local_sgd":
+        # Local SGD with the 8 replicas spanning BOTH processes: the
+        # replica-stacked step [8] is sharded across the process
+        # boundary, so ckpt.host_step's index-before-device_get and
+        # the stacked save/restore (collective fetch + per-process
+        # shard placement) all execute cross-process — the exact
+        # multi-host hazards round 4 hardened against. Train 3 steps
+        # (stacked checkpoint at 3), resume to 6.
+        base = dict(
+            model="mnist_cnn", dataset="synthetic", batch_size=64,
+            eval_every=0, log_every=0, eval_batch_size=128,
+            checkpoint_dir=os.environ["MH_CKPT_DIR"],
+            checkpoint_every=3, param_sync_every=2,
+            compute_dtype="float32", dropout_rate=0.0,
+            mesh=MeshConfig(data=8), seed=0)
+        train(TrainConfig(**base, train_steps=3))
+        result = train(TrainConfig(**base, train_steps=6, resume=True))
+        with open(out_path, "w") as f:
+            json.dump({
+                "step": int(jax.device_get(result.state.step)),
+                "final_metrics": {
+                    k: float(v)
+                    for k, v in result.final_metrics.items()},
+                "params_checksum": checksum(result.state),
+            }, f)
+        return
     if phase == "fsdp":
         # FSDP with the data axis spanning BOTH processes: params and
         # Adam slots are sharded across the process boundary, so the
