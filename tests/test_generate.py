@@ -256,3 +256,24 @@ def test_beam_search_eos_freezes_beams():
         beam_search(model, params, prompt, 4, eos_id=999)
     with pytest.raises(ValueError, match="num_beams"):
         beam_search(model, params, prompt, 4, num_beams=0)
+
+
+def test_beam_search_composes_with_quant_window_gqa():
+    """Beam search through the int8-quantized, windowed, grouped cache:
+    the per-step cache gather must reindex EVERY cache leaf (int8
+    values AND their scale arrays) and the prefill tile must replicate
+    them; deterministic, sorted output pins the composition."""
+    from tensorflow_distributed_tpu.models.generate import beam_search
+    from tensorflow_distributed_tpu.models.transformer import tiny_config
+
+    model = CausalLM(tiny_config(
+        causal=True, n_kv_heads=2, attn_window=6, kv_cache_quant="int8",
+        pos_emb="rope", max_len=32, compute_dtype=jnp.float32))
+    prompt = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((2, 16), jnp.int32))["params"]
+    s1, sc = beam_search(model, params, prompt, 8, num_beams=3)
+    s2, _ = beam_search(model, params, prompt, 8, num_beams=3)
+    np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))
+    assert s1.shape == (1, 3, 8)
+    assert (np.diff(np.asarray(sc), axis=1) <= 1e-6).all()
