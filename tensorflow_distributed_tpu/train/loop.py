@@ -69,13 +69,23 @@ def evaluate(state: TrainState, eval_fn, task: Task, mesh, batch: int
         for k, v in m.items():
             totals[k] = totals.get(k, 0.0) + float(v) * batch
         count += batch
+    out = {k: v / max(count, 1) for k, v in totals.items()}
+    if "loss" in out and task.name.endswith("clm"):
+        # exp of the AVERAGED cross-entropy (not an average of
+        # per-batch exponentials) — the standard LM eval number.
+        # CLM only: its batches weight every token equally, so the
+        # row-weighted batch average IS the token average; MLM's
+        # per-batch masked-token counts vary, which would make this
+        # a mean-of-means pseudo-perplexity — omitted rather than
+        # reported subtly wrong.
+        out["perplexity"] = float(np.exp(out["loss"]))
     if count < task.eval_size and is_chief():
         # Fixed-size SPMD batches truncate the split to a batch multiple
         # (exact for the reference's 5x1000 split) — surface the tail
         # drop instead of silently skewing small-split accuracy.
         print(f"[eval] split has {task.eval_size} rows; evaluated "
               f"{count} (remainder dropped by batch size {batch})")
-    return {k: v / max(count, 1) for k, v in totals.items()}
+    return out
 
 
 def _build_model_and_state(cfg: TrainConfig, mesh, task):

@@ -160,7 +160,6 @@ def test_int8_kv_cache_decode_close_to_full_forward():
     rounding of k/v themselves. Also pins the GQA branch (narrow AND
     thin cache, the composed decode-bandwidth story) and that
     generation runs deterministically end to end."""
-    from tensorflow_distributed_tpu.models.transformer import tiny_config
 
     for kw in ({}, {"n_kv_heads": 2}):
         model_q = CausalLM(tiny_config(causal=True, compute_dtype=jnp.float32,
@@ -264,7 +263,6 @@ def test_beam_search_composes_with_quant_window_gqa():
     values AND their scale arrays) and the prefill tile must replicate
     them; deterministic, sorted output pins the composition."""
     from tensorflow_distributed_tpu.models.generate import beam_search
-    from tensorflow_distributed_tpu.models.transformer import tiny_config
 
     model = CausalLM(tiny_config(
         causal=True, n_kv_heads=2, attn_window=6, kv_cache_quant="int8",

@@ -140,6 +140,9 @@ def test_ring_attention_across_processes(multihost_results):
         mesh=MeshConfig(data=1, seq=8), seed=0)
     single = train(cfg)
     for k, v in single.final_metrics.items():
+        if k == "perplexity":
+            continue  # derived as exp(loss): comparing loss covers
+            # it without the ~4x relative-error amplification
         np.testing.assert_allclose(a["lm_final_metrics"][k], v,
                                    rtol=1e-4, atol=1e-5)
 
@@ -175,6 +178,9 @@ def test_crash_and_resume_across_processes(tmp_path_factory):
         mesh=MeshConfig(data=8), seed=0)
     single = train(cfg)
     for k, v in single.final_metrics.items():
+        if k == "perplexity":
+            continue  # derived as exp(loss): comparing loss covers
+            # it without the ~4x relative-error amplification
         np.testing.assert_allclose(resumed[0]["final_metrics"][k], v,
                                    rtol=1e-4, atol=1e-5)
 
@@ -202,6 +208,9 @@ def test_fsdp_across_processes(tmp_path_factory):
         dropout_rate=0.0, mesh=MeshConfig(data=8), seed=0)
     single = train(cfg)
     for k, v in single.final_metrics.items():
+        if k == "perplexity":
+            continue  # derived as exp(loss): comparing loss covers
+            # it without the ~4x relative-error amplification
         np.testing.assert_allclose(results[0]["final_metrics"][k], v,
                                    rtol=1e-4, atol=1e-5)
 
@@ -235,6 +244,9 @@ def test_local_sgd_across_processes(tmp_path_factory):
         param_sync_every=2, compute_dtype="float32", dropout_rate=0.0,
         mesh=MeshConfig(data=8), seed=0))
     for k, v in single.final_metrics.items():
+        if k == "perplexity":
+            continue  # derived as exp(loss): comparing loss covers
+            # it without the ~4x relative-error amplification
         np.testing.assert_allclose(results[0]["final_metrics"][k], v,
                                    rtol=1e-4, atol=1e-5)
 
@@ -286,4 +298,7 @@ def test_parity_with_single_process(multihost_results):
 
     multi = results[0]["final_metrics"]
     for k, v in single.final_metrics.items():
+        if k == "perplexity":
+            continue  # derived as exp(loss): comparing loss covers
+            # it without the ~4x relative-error amplification
         np.testing.assert_allclose(multi[k], v, rtol=1e-4, atol=1e-5)
