@@ -287,6 +287,12 @@ class TrainConfig:
     # snapshot stays in-loop, the disk work overlaps training. The
     # loop flushes the writer (ckpt.wait) before returning.
     checkpoint_async: bool = False
+    # "native" (flax msgpack, chief-only atomic writes after a
+    # collective host fetch) or "orbax" (sharded OCDBT saves: every
+    # process writes/reads ITS OWN shards, no allgather — the scale
+    # path train/checkpoint.py's docstring documents). --resume
+    # auto-detects the on-disk format either way.
+    checkpoint_backend: str = "native"
 
     # --- profiling -------------------------------------------------------
     # Non-empty: the chief captures a jax.profiler trace of steps
@@ -320,6 +326,15 @@ class TrainConfig:
             raise ValueError(f"unknown data_backend {self.data_backend!r}")
         if self.remat not in ("none", "full", "dots"):
             raise ValueError(f"unknown remat {self.remat!r}")
+        if self.checkpoint_backend not in ("native", "orbax"):
+            raise ValueError(
+                f"unknown checkpoint_backend "
+                f"{self.checkpoint_backend!r}")
+        if self.checkpoint_backend == "orbax" and self.param_sync_every > 1:
+            raise ValueError(
+                "checkpoint_backend=orbax does not support local-SGD"
+                " replica-stacked states yet (restore_averaged reads"
+                " the native msgpack layout); use native")
         if self.pipeline_schedule not in ("gpipe", "1f1b"):
             raise ValueError(
                 f"unknown pipeline_schedule {self.pipeline_schedule!r}")

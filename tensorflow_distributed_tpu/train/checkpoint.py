@@ -13,14 +13,19 @@ Design:
   plus a small JSON manifest. Writes are atomic (tmp dir + rename), so
   a crash mid-save never corrupts the latest checkpoint — the recovery
   story the Supervisor's background saver provided (:245,:252).
-- Only the chief process writes (parallel.mesh.is_chief); every process
-  restores. Leaves fully addressable on this host come back via
-  ``jax.device_get``; leaves sharded ACROSS processes (FSDP over a
-  multi-host data axis, cross-process TP) are first allgathered to a
-  replicated layout — a collective, so ``save`` must be (and is) called
-  by every process, with only the chief writing the bytes. For the
-  model sizes this framework targets per-host full gathers are fine;
-  sharded per-host saves are an orbax upgrade path documented here.
+- NATIVE backend (default): only the chief process writes
+  (parallel.mesh.is_chief); every process restores. Leaves fully
+  addressable on this host come back via ``jax.device_get``; leaves
+  sharded ACROSS processes (FSDP over a multi-host data axis,
+  cross-process TP) are first allgathered to a replicated layout — a
+  collective, so ``save`` must be (and is) called by every process,
+  with only the chief writing the bytes. Fine for the model sizes this
+  framework targets.
+- ORBAX backend (``backend="orbax"`` / ``--checkpoint-backend orbax``,
+  the scale path): sharded OCDBT saves — every process writes and
+  reads ITS OWN shards, no allgather; completeness is published via a
+  chief-written commit marker (see ``_orbax_save``), and ``restore``
+  auto-detects which backend wrote a checkpoint.
 - Restore places leaves back on the mesh with the *current* state's
   shardings, so a checkpoint saved on one mesh shape restores onto
   another (e.g. train on 8 chips, fine-tune on 1).
@@ -102,15 +107,25 @@ def _step_dir(ckpt_dir: str, step: int) -> str:
 
 
 def available_steps(ckpt_dir: str) -> List[int]:
+    """COMPLETE checkpoints only: native dirs are atomic (presence
+    implies a full state.msgpack), orbax dirs count once the chief's
+    commit marker lands — an in-flight or crashed orbax save is
+    invisible here, so latest_step never shadows an intact older
+    checkpoint."""
     if not os.path.isdir(ckpt_dir):
         return []
     out = []
     for name in os.listdir(ckpt_dir):
-        if name.startswith(_STEP_PREFIX):
-            try:
-                out.append(int(name[len(_STEP_PREFIX):]))
-            except ValueError:
-                continue
+        if not name.startswith(_STEP_PREFIX):
+            continue
+        try:
+            step = int(name[len(_STEP_PREFIX):])
+        except ValueError:
+            continue
+        d = os.path.join(ckpt_dir, name)
+        if (os.path.exists(os.path.join(d, "state.msgpack"))
+                or os.path.exists(os.path.join(d, _ORBAX_MARKER))):
+            out.append(step)
     return sorted(out)
 
 
@@ -131,6 +146,126 @@ def _save_barrier(step: int) -> None:
         from jax.experimental import multihost_utils
 
         multihost_utils.sync_global_devices(f"tfd_ckpt_save_{step}")
+
+
+_ORBAX_DIRNAME = "orbax"
+_ORBAX_MARKER = "ORBAX_COMMITTED"
+_orbax_ckptr = None
+_orbax_pending: List[tuple] = []  # (ckpt_dir, step, keep) awaiting commit
+
+
+def _orbax():
+    """Lazy singleton StandardCheckpointer (its save is internally
+    async; ``orbax_wait`` flushes AND publishes)."""
+    global _orbax_ckptr
+    if _orbax_ckptr is None:
+        import orbax.checkpoint as ocp
+
+        _orbax_ckptr = ocp.StandardCheckpointer()
+    return _orbax_ckptr
+
+
+def _orbax_save(ckpt_dir: str, step: int, state: Any, keep: int,
+                background: bool) -> str:
+    """Sharded save via orbax (the scale path): every process writes
+    ITS OWN shards — no allgather-to-host, no chief gating (orbax
+    coordinates the processes itself). Layout:
+    ``<dir>/step_xxxxxxxx/orbax/`` plus a chief-written COMMIT MARKER
+    file, published only after orbax confirms the write — the step dir
+    itself appears early, so ``available_steps`` treats an unmarked
+    orbax dir as in-flight/crashed and skips it: a crash mid-save can
+    never shadow the intact previous checkpoint, and pruning (also
+    deferred to the marker phase) can never delete the last good one.
+    restore() auto-detects the layout, so --resume works regardless of
+    which backend wrote the checkpoint."""
+    final = _step_dir(ckpt_dir, step)
+    os.makedirs(ckpt_dir, exist_ok=True)
+    if background and _orbax_pending:
+        # Publish the PREVIOUS background save before scheduling the
+        # next (at most one unpublished save in flight — the native
+        # writer's bound): without this, markers would only land at
+        # the end-of-run wait() and a hard crash mid-training would
+        # lose every cadence checkpoint.
+        orbax_wait()
+    tree = serialization.to_state_dict(state)
+    _orbax().save(os.path.join(final, _ORBAX_DIRNAME), tree, force=True)
+    _orbax_pending.append((ckpt_dir, step, keep))
+    if not background:
+        orbax_wait()
+        _save_barrier(step)
+    return final
+
+
+def orbax_wait() -> None:
+    """Flush orbax's internal async write (blocks until every
+    process's shards are committed), then publish: the chief writes
+    the commit markers and prunes old steps — strictly AFTER the
+    commit, so a failed write leaves previous checkpoints untouched
+    and unmarked debris behind."""
+    global _orbax_pending
+    # Pop BEFORE the flush: if the shard write failed, the popped
+    # entries are dropped un-marked (correct — they stay invisible
+    # debris) instead of being re-published as committed by a later
+    # call after the error was already consumed.
+    pend, _orbax_pending[:] = _orbax_pending[:], []
+    if _orbax_ckptr is not None:
+        _orbax_ckptr.wait_until_finished()
+    if not is_chief():
+        return
+    for ckpt_dir, step, keep in pend:
+        marker = os.path.join(_step_dir(ckpt_dir, step), _ORBAX_MARKER)
+        with open(marker, "w"):
+            pass
+        for old in available_steps(ckpt_dir)[:-keep]:
+            shutil.rmtree(_step_dir(ckpt_dir, old), ignore_errors=True)
+
+
+def _orbax_restore(path: str, state: Any) -> Any:
+    """Sharded restore: each process reads its own shards directly into
+    the template's shardings — the inverse of the no-allgather save.
+
+    Mirrors _restore_from_raw's compatibility contract: an EMA toggle
+    across the save (reconciled via the checkpoint's metadata — newly
+    enabled EMA seeds from the restored params, newly disabled drops
+    the saved average), and a CLEAR error for replica-stacked vs plain
+    shape mismatches (a --param-sync-every flip)."""
+    item = os.path.join(path, _ORBAX_DIRNAME)
+    tmpl = serialization.to_state_dict(state)
+    saved = _orbax().metadata(item).item_metadata.tree
+
+    t_flat = dict(jax.tree_util.tree_flatten_with_path(
+        tmpl.get("params", {}))[0])
+    s_flat = dict(jax.tree_util.tree_flatten_with_path(
+        saved.get("params", {}))[0])
+    for pth, leaf in t_flat.items():
+        m = s_flat.get(pth)
+        if m is not None and tuple(m.shape) != tuple(np.shape(leaf)):
+            raise ValueError(
+                f"checkpoint leaf shape {tuple(m.shape)} != template "
+                f"{tuple(np.shape(leaf))} at {jax.tree_util.keystr(pth)};"
+                " was this run saved with a different --param-sync-every"
+                " (replica-stacked vs plain state)?")
+
+    want_ema = tmpl.get("ema") is not None
+    saved_ema = bool(saved.get("ema"))
+    abstract = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                       sharding=a.sharding)
+        if isinstance(a, jax.Array) else a, tmpl)
+    if want_ema and not saved_ema:
+        abstract["ema"] = None          # restore what was saved ...
+    if saved_ema and not want_ema:
+        # StandardCheckpointer cannot restore a strict subtree (probed:
+        # both a missing key and ema=None raise structure-mismatch), so
+        # the dropped average is read once and discarded — one extra
+        # params-sized read on this rare toggle path.
+        abstract["ema"] = abstract["params"]  # ema mirrors params
+    restored = _orbax().restore(item, abstract)
+    if want_ema and not saved_ema:
+        restored["ema"] = restored["params"]  # ... then seed the average
+    if saved_ema and not want_ema:
+        restored["ema"] = None
+    return serialization.from_state_dict(state, restored)
 
 
 # Single background writer: serializes at most one checkpoint at a
@@ -168,7 +303,7 @@ def _write(ckpt_dir: str, step: int, host_state: Any, keep: int) -> str:
 
 
 def save(ckpt_dir: str, state: Any, keep: int = 3,
-         background: bool = False) -> str:
+         background: bool = False, backend: str = "native") -> str:
     """Write state at its current step; prune to the newest ``keep``.
 
     Collective under multi-host (every process must call it; only the
@@ -187,6 +322,10 @@ def save(ckpt_dir: str, state: Any, keep: int = 3,
     cluster-wide. A crash mid-write loses at most that checkpoint —
     the previous one is intact because publication is tmp+rename."""
     step = host_step(state)
+    if backend == "orbax":
+        return _orbax_save(ckpt_dir, step, state, keep, background)
+    if backend != "native":
+        raise ValueError(f"unknown checkpoint backend {backend!r}")
     final = _step_dir(ckpt_dir, step)
     # Collective fetch BEFORE the chief gate: cross-process-partitioned
     # leaves need every process in the allgather. Non-chief processes
@@ -227,13 +366,22 @@ def save(ckpt_dir: str, state: Any, keep: int = 3,
 
 
 def wait() -> None:
-    """Block until outstanding background saves land; re-raise the
-    first writer error; barrier so ``latest_step`` is coherent
-    cluster-wide afterwards. No-op when nothing is pending."""
+    """Block until outstanding background saves land (both the
+    native writer thread and orbax's internal async write);
+    re-raise the first writer error; barrier so ``latest_step`` is
+    coherent cluster-wide afterwards. No-op when nothing is
+    pending."""
     with _writer_lock:
         pending, _pending[:] = _pending[:], []
     try:
         first_err = None
+        # Orbax flush INSIDE the try: a failed shard write on one
+        # process must still fall through to the finally barrier, or
+        # the other processes hang waiting for it.
+        try:
+            orbax_wait()
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            first_err = e
         for fut in pending:
             try:
                 fut.result()
@@ -294,7 +442,14 @@ def restore(ckpt_dir: str, state: Any, step: Optional[int] = None) -> Any:
     step = step if step is not None else latest_step(ckpt_dir)
     if step is None:
         raise FileNotFoundError(f"no checkpoints under {ckpt_dir}")
-    path = os.path.join(_step_dir(ckpt_dir, step), "state.msgpack")
+    step_path = _step_dir(ckpt_dir, step)
+    if os.path.exists(os.path.join(step_path, _ORBAX_MARKER)):
+        # Auto-detect via the COMMIT MARKER (not the orbax subdir):
+        # a crashed orbax re-save into a dir holding an intact
+        # native state.msgpack must fall through to the msgpack,
+        # not dispatch onto incomplete shard debris.
+        return _orbax_restore(step_path, state)
+    path = os.path.join(step_path, "state.msgpack")
     with open(path, "rb") as f:
         raw = serialization.msgpack_restore(f.read())
     return _restore_from_raw(raw, state)

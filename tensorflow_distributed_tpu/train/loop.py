@@ -277,7 +277,8 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
         if (cfg.checkpoint_dir and cfg.checkpoint_every
                 and step_now % cfg.checkpoint_every == 0):
             ckpt.save(cfg.checkpoint_dir, state, cfg.keep_checkpoints,
-                      background=cfg.checkpoint_async)
+                      background=cfg.checkpoint_async,
+                      backend=cfg.checkpoint_backend)
 
     # Warm-up compile outside the timed steady-state span (the
     # reference's timings conflated graph setup with steps; ours don't).
@@ -336,7 +337,8 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
         # before eval, which on a real validation split could outlive
         # the grace period and void the whole feature.
         ckpt.save(cfg.checkpoint_dir, state, cfg.keep_checkpoints,
-                  background=cfg.checkpoint_async)
+                  background=cfg.checkpoint_async,
+                  backend=cfg.checkpoint_backend)
         ckpt.wait()
     state_out = view(state)
     with Timer() as eval_t:
@@ -351,7 +353,8 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
         # dir. wait() then flushes the queue and barriers so
         # latest_step is coherent on return.
         ckpt.save(cfg.checkpoint_dir, state, cfg.keep_checkpoints,
-                  background=cfg.checkpoint_async)
+                  background=cfg.checkpoint_async,
+                  backend=cfg.checkpoint_backend)
         ckpt.wait()
 
     # Steps ACTUALLY executed in the timed span (a preemption break
