@@ -455,6 +455,26 @@ def restore(ckpt_dir: str, state: Any, step: Optional[int] = None) -> Any:
     return _restore_from_raw(raw, state)
 
 
+def _align_masked_opt(skel: Any, raw: Any) -> Any:
+    """Reconcile optax.masked wrappers across a resume: adding/removing
+    a weight-decay mask wraps a chain member in MaskedState — an extra
+    {"inner_state": ...} level whose own leaves are all empty — so a
+    checkpoint written on one side of the change restores on the other
+    by inserting/stripping that level to match the template skeleton.
+    Purely structural: no array values are invented or dropped."""
+    if not (isinstance(skel, dict) and isinstance(raw, dict)):
+        return raw
+    if (set(skel.keys()) == {"inner_state"}
+            and set(raw.keys()) != {"inner_state"}):
+        return {"inner_state": _align_masked_opt(skel["inner_state"],
+                                                 raw)}
+    if (set(raw.keys()) == {"inner_state"}
+            and set(skel.keys()) != {"inner_state"}):
+        return _align_masked_opt(skel, raw["inner_state"])
+    return {k: (_align_masked_opt(skel[k], v) if k in skel else v)
+            for k, v in raw.items()}
+
+
 def _restore_from_raw(raw: Any, state: Any) -> Any:
     """Place a host state-dict into the template's structure and
     shardings (the shared tail of restore/restore_averaged)."""
@@ -470,6 +490,11 @@ def _restore_from_raw(raw: Any, state: Any) -> Any:
     # Checkpoints written before TrainState grew the ema field have no
     # "ema" key at all — from_state_dict would raise on the missing
     # field even with EMA disabled, so absence means "EMA off".
+    if isinstance(raw, dict) and isinstance(raw.get("opt_state"),
+                                            dict):
+        raw["opt_state"] = _align_masked_opt(
+            serialization.to_state_dict(state).get("opt_state", {}),
+            raw["opt_state"])
     if isinstance(raw, dict) and hasattr(state, "ema"):
         raw.setdefault("ema", None)
         want, have = state.ema is not None, raw["ema"] is not None

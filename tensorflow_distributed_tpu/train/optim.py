@@ -27,11 +27,35 @@ def make_schedule(cfg: TrainConfig) -> optax.Schedule:
     raise ValueError(f"unknown lr_schedule {cfg.lr_schedule!r}")
 
 
+# Leaf NAMES that receive weight decay: projection kernels, embedding
+# tables, and the MoE expert/router matrices. Name-based, NOT
+# shape-based (ndim >= 2), deliberately: DenseGeneral biases are rank
+# (3, H, Dh), and the pipelined family stacks EVERY leaf (biases and
+# norm scales included) to rank N+2 — a shape rule would decay them.
+_DECAY_LEAF_NAMES = ("kernel", "embedding", "wi", "wo", "gate")
+
+
+def decay_mask(params):
+    """Standard weight-decay mask: decay weight MATRICES only (by leaf
+    name — _DECAY_LEAF_NAMES), never biases or norm scales/offsets.
+    Decaying norm scales pulls them toward zero, which fights the
+    normalization itself — the GPT-2/BERT recipes exclude them, and so
+    does every optimizer here."""
+    import jax
+
+    def walk(path, leaf):
+        name = path[-1].key if path else ""
+        return name in _DECAY_LEAF_NAMES
+
+    return jax.tree_util.tree_map_with_path(walk, params)
+
+
 def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
     sched = make_schedule(cfg)
     if cfg.optimizer == "adam":
         if cfg.weight_decay:
-            core = optax.adamw(sched, weight_decay=cfg.weight_decay)
+            core = optax.adamw(sched, weight_decay=cfg.weight_decay,
+                               mask=decay_mask)
         else:
             core = optax.adam(sched)
     elif cfg.optimizer == "sgd":
@@ -43,7 +67,8 @@ def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
         # sharding of whatever state remains.
         core = optax.adafactor(
             sched,
-            weight_decay_rate=cfg.weight_decay or None)
+            weight_decay_rate=cfg.weight_decay or None,
+            weight_decay_mask=decay_mask if cfg.weight_decay else None)
     else:
         raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
     if cfg.grad_clip_norm:
