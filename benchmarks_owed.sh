@@ -1,0 +1,38 @@
+#!/bin/bash
+# One-shot capture of every on-chip measurement owed since the round-4
+# TPU outage (PARITY.md "Round-4 TPU availability record"). Run on a
+# host with the live chip; each step is independent — failures don't
+# block the rest. Commit the JSONs it produces.
+set -x
+cd "$(dirname "$0")"
+
+# 1. Scatter-dispatch MoE A/B (dense dispatch einsums measured at ~25%
+#    of step FLOPs — the scatter path skips them entirely).
+timeout 580 python -m tensorflow_distributed_tpu.benchmarks.moebench \
+    --moe-dispatch scatter --out MOEBENCH_scatter.json
+# 1b. Refresh the dense artifact on the same code for a clean A/B.
+timeout 580 python -m tensorflow_distributed_tpu.benchmarks.moebench \
+    --out MOEBENCH.json
+
+# 2. Sliding-window flash A/B (band skip => O(L*W) compute; tokens/s
+#    should GROW as the window shrinks).
+timeout 580 python -m tensorflow_distributed_tpu.benchmarks.lm_perf \
+    --seq-len 4096 --batch 4 --remat dots --skip-ab --out WINBENCH_full.json
+timeout 580 python -m tensorflow_distributed_tpu.benchmarks.lm_perf \
+    --seq-len 4096 --batch 4 --remat dots --attn-window 512 --skip-ab \
+    --out WINBENCH_w512.json
+
+# 3. int8 KV-cache decode A/B, alone and composed with GQA.
+timeout 580 python -m tensorflow_distributed_tpu.benchmarks.genbench \
+    --n-kv-heads 2 --kv-cache-quant int8 --out GENBENCH_kvq.json
+
+# 4. Long-context training from the CLI at seq >= 2048 (VERDICT item 2).
+timeout 580 python -m tensorflow_distributed_tpu.cli --model gpt_lm \
+    --model-size small --seq-len 2048 --batch-size 8 --remat dots \
+    --pos-emb rope --train-steps 50 --eval-every 0 --log-every 10 \
+    --dataset synthetic 2>&1 | tail -5 > LONGCTX_r04.txt
+
+# 5. Better unpipelined headline (49.4% MFU at batch 16 measured
+#    pre-outage; record it as an artifact).
+timeout 580 python -m tensorflow_distributed_tpu.benchmarks.lm_perf \
+    --batch 16 --skip-ab --out LMBENCH_r04_b16.json
