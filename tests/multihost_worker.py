@@ -116,6 +116,34 @@ def main() -> None:
         with open(out_path, "w") as f:
             json.dump(run_xaxes_scenarios(_fetch_host), f)
         return
+    if phase == "orbax":
+        # Orbax checkpointing with FSDP params sharded ACROSS the
+        # process boundary: every process writes and restores ITS OWN
+        # shards (no allgather — the backend's whole point), the chief
+        # publishes the commit marker, and a same-cluster resume lands
+        # exactly where an uninterrupted run does.
+        base = dict(
+            model="mnist_cnn", dataset="synthetic", batch_size=64,
+            eval_every=0, log_every=0, eval_batch_size=128,
+            checkpoint_dir=os.environ["MH_CKPT_DIR"],
+            checkpoint_every=2, checkpoint_backend="orbax",
+            param_partition="fsdp", compute_dtype="float32",
+            dropout_rate=0.0, mesh=MeshConfig(data=8), seed=0)
+        train(TrainConfig(**base, train_steps=4))
+        result = train(TrainConfig(**base, train_steps=8, resume=True))
+        from tensorflow_distributed_tpu.train.checkpoint import _fetch_host
+        params = _fetch_host(result.state.params)
+        with open(out_path, "w") as f:
+            json.dump({
+                "step": int(jax.device_get(result.state.step)),
+                "final_metrics": {
+                    k: float(v)
+                    for k, v in result.final_metrics.items()},
+                "params_checksum": float(sum(
+                    abs(x).sum()
+                    for x in jax.tree_util.tree_leaves(params))),
+            }, f)
+        return
     if phase == "local_sgd":
         # Local SGD with the 8 replicas spanning BOTH processes: the
         # replica-stacked step [8] is sharded across the process
