@@ -75,7 +75,11 @@ def test_1f1b_stash_backward_matches_recompute(devices8):
     stash's HBM traffic costs more than re-running the stage forward
     on an underutilized MXU), so stash stays opt-in."""
     mesh = make_mesh(MeshConfig(data=2, pipe=4), devices8)
-    model, state, batch = _setup(mesh, dropout=0.2)
+    # remat=True inside the stage: the vjp residual set shrinks to the
+    # checkpoint-saved subset — the documented mitigation for stash's
+    # memory cost — and must compose transparently (jax.vjp of a
+    # rematted stage_fn just yields the smaller residual pytree).
+    model, state, batch = _setup(mesh, dropout=0.2, remat=True)
     steps = {
         mode: make_1f1b_train_step(model, mesh, donate=False,
                                    backward=mode)
