@@ -36,3 +36,12 @@ timeout 580 python -m tensorflow_distributed_tpu.cli --model gpt_lm \
 #    pre-outage; record it as an artifact).
 timeout 580 python -m tensorflow_distributed_tpu.benchmarks.lm_perf \
     --batch 16 --skip-ab --out LMBENCH_r04_b16.json
+
+# 6. Ring local-compute block-size sweep: the recorded RINGBENCH showed
+#    flash-partial ~parity with einsum at half-block 512 — find where
+#    (if anywhere) the kernel pulls ahead, for the dispatch tuning the
+#    parity result motivates.
+for hb in 256 512 1024 2048; do
+  timeout 580 python -m tensorflow_distributed_tpu.benchmarks.ringbench \
+      --half-block "$hb" --out "RINGBENCH_hb${hb}.json"
+done
