@@ -1,0 +1,22 @@
+#!/bin/bash
+# TPU tunnel watcher (round-4 outage protocol, PARITY.md "Round-4 TPU
+# availability record"): the failure mode is enumeration-works /
+# compute-hangs, so the probe is a REAL computation with a readback.
+# When a probe completes, run benchmarks_owed.sh once and exit.
+# Probe attempts are logged for the outage record.
+cd "$(dirname "$0")"
+while true; do
+  ts=$(date -u +%FT%TZ)
+  if timeout 120 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((256, 256), jnp.float32)
+assert float(jax.jit(lambda a: (a @ a).sum())(x)) == 256.0 * 256 * 256
+" >/dev/null 2>&1; then
+    echo "$ts probe_ok (jit matmul + readback)" >> TPU_PROBES_r04.log
+    bash benchmarks_owed.sh > owed_run.log 2>&1
+    echo "$(date -u +%FT%TZ) owed_run_done rc=$?" >> TPU_PROBES_r04.log
+    exit 0
+  fi
+  echo "$ts probe_fail (120s, no compute readback)" >> TPU_PROBES_r04.log
+  sleep 600
+done

@@ -87,7 +87,8 @@ def flops_per_token(params, cfg) -> float:
 
 def _build(size: str, seq_len: int, use_flash: bool, remat: str,
            batch: int, mesh, seed: int = 0, pipeline_mb: int = 0,
-           pipeline_backward: str = "recompute", attn_window: int = 0):
+           pipeline_backward: str = "recompute", attn_window: int = 0,
+           ce_chunk: int = 0):
     import jax
     import numpy as np
     import optax
@@ -98,7 +99,7 @@ def _build(size: str, seq_len: int, use_flash: bool, remat: str,
     from tensorflow_distributed_tpu.train.state import create_train_state
     from tensorflow_distributed_tpu.train.step import make_train_step
     from tensorflow_distributed_tpu.train.tasks import (
-        mlm_batch_shardings, mlm_loss)
+        make_mlm_loss, mlm_batch_shardings, mlm_loss)
 
     kw = dict(max_len=seq_len, dropout_rate=0.0, use_flash=use_flash)
     if attn_window:
@@ -125,7 +126,9 @@ def _build(size: str, seq_len: int, use_flash: bool, remat: str,
             model, mesh, seed, batch_shardings=mlm_batch_shardings(mesh),
             backward=pipeline_backward)
     else:
-        step = make_train_step(mesh, seed, loss=mlm_loss,
+        loss = (make_mlm_loss(ce_chunk=ce_chunk) if ce_chunk
+                else mlm_loss)
+        step = make_train_step(mesh, seed, loss=loss,
                                batch_shardings=mlm_batch_shardings(mesh))
     ds = synthetic_clm(n=batch, seq_len=seq_len,
                        vocab_size=model.cfg.vocab_size, seed=seed)
@@ -167,6 +170,11 @@ def main(argv=None) -> None:
                         "full causal); the flash kernel skips "
                         "blocks outside the band, so tokens/s "
                         "should GROW as the window shrinks")
+    parser.add_argument("--ce-chunk", type=int, default=0,
+                        help="> 0: fused vocab-chunked head+loss (ops/"
+                        "fused_ce.py) with this chunk width — the full "
+                        "[B, L, V] logits are never materialized; "
+                        "0 = dense path")
     parser.add_argument("--skip-ab", action="store_true",
                         help="skip the flash-vs-XLA attention A/B")
     parser.add_argument("--pipeline-backward", default="recompute",
@@ -206,10 +214,13 @@ def main(argv=None) -> None:
     kind = jax.devices()[0].device_kind
     peak = PEAK_BF16_FLOPS.get(kind)
 
+    if args.ce_chunk and pmb > 0:
+        parser.error("--ce-chunk is not available in pipeline mode "
+                     "(the last stage owns the head inside the pipe)")
     model, state, step, batch = _build(
         args.size, args.seq_len, True, args.remat, args.batch, mesh,
         pipeline_mb=pmb, pipeline_backward=args.pipeline_backward,
-        attn_window=args.attn_window)
+        attn_window=args.attn_window, ce_chunk=args.ce_chunk)
     n_params = param_count(state.params)
     fpt = flops_per_token(state.params, model.cfg)
 
@@ -228,6 +239,8 @@ def main(argv=None) -> None:
             "device": kind, "devices": n_dev, "remat": args.remat}
     if args.attn_window:
         meta["attn_window"] = args.attn_window
+    if args.ce_chunk:
+        meta["ce_chunk"] = args.ce_chunk
     if pmb > 0:
         meta["pipeline_microbatches"] = pmb
         meta["pipeline_backward"] = args.pipeline_backward
@@ -270,7 +283,8 @@ def main(argv=None) -> None:
         # don't fit 16G HBM at batch 16.
         del state, step, batch
         _, state_x, step_x, batch_x = _build(
-            args.size, args.seq_len, False, args.remat, args.batch, mesh)
+            args.size, args.seq_len, False, args.remat, args.batch, mesh,
+            attn_window=args.attn_window, ce_chunk=args.ce_chunk)
         dt_x, _, _, last_x = _timed_steps(step_x, state_x, batch_x,
                                           args.steps)
         assert np.isfinite(last_x)

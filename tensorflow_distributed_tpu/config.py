@@ -113,6 +113,15 @@ class TrainConfig:
     # mesh.model == 1. Not available for pipelined_lm (its shell params
     # carry no TP metadata).
     shard_vocab: bool = False
+    # Fused (vocab-chunked) head+loss for the LM families: > 0 runs the
+    # lm_head matmul INSIDE the training loss, ``ce_chunk`` vocab
+    # columns at a time with online-softmax statistics, so the full
+    # [B, L, V] logits (~825 MB bf16 at GPT-2-small train shapes) are
+    # never materialized in forward or backward (ops/fused_ce.py).
+    # 0 = dense path. Train-side only (eval keeps dense logits);
+    # incompatible with shard_vocab and pipelined_lm (the pipe's head
+    # lives stage-side). 8192 is a good first value at vocab 50257.
+    ce_chunk: int = 0
     # Block normalization: "layernorm" or "rmsnorm" (scale-only,
     # Llama-style). Transformer families only.
     norm: str = "layernorm"  # layernorm | rmsnorm
@@ -532,6 +541,31 @@ class TrainConfig:
                 "shard_vocab is not available for pipelined_lm (the "
                 "embedding shell carries no TP metadata; use mesh.pipe "
                 "for memory)")
+        if self.ce_chunk < 0:
+            raise ValueError(
+                f"ce_chunk must be >= 0, got {self.ce_chunk}")
+        if self.ce_chunk and self.model not in lm_families:
+            raise ValueError(
+                f"ce_chunk has no effect on model={self.model!r} "
+                f"(the fused head+loss exists for the LM families' "
+                f"50k-row vocabs); drop the flag")
+        if self.ce_chunk and self.model == "pipelined_lm":
+            raise ValueError(
+                "ce_chunk is not available for pipelined_lm (the last "
+                "stage owns the head inside the pipe schedule; the "
+                "fused loss runs outside it)")
+        if self.ce_chunk and self.shard_vocab:
+            raise ValueError(
+                "ce_chunk does not compose with shard_vocab (the fused "
+                "loss slices vocab chunks in its own scan; a model-"
+                "sharded vocab dim would all-gather per chunk — pick "
+                "one)")
+        if self.ce_chunk and self.mesh.model > 1:
+            raise ValueError(
+                "ce_chunk requires mesh.model == 1: the lm_head "
+                "kernel's vocab dim is TP-sharded under tensor "
+                "parallelism, so the fused loss's chunk slices would "
+                "all-gather the head every scan step")
         if self.seq_len < 0 or self.seq_len == 1:
             raise ValueError(
                 f"seq_len must be 0 (family default) or >= 2, "

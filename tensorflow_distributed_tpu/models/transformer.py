@@ -452,6 +452,31 @@ class Block(nn.Module):
         return x + y
 
 
+class _LmHead(nn.Module):
+    """The untied output projection, param-compatible with the nn.Dense
+    it replaced (same 'kernel'/'bias' names, shapes, inits — checkpoints
+    carry over), but able to hand out its parameters WITHOUT computing
+    logits: the fused-CE path (ops/fused_ce.py) runs the head matmul
+    inside the loss, chunk by chunk, so the model must expose the raw
+    [D, V] kernel instead of a [B, L, V] product."""
+
+    d_in: int
+    d_out: int
+    kernel_init: Any
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x: Optional[jax.Array] = None):
+        kernel = self.param("kernel", self.kernel_init,
+                            (self.d_in, self.d_out))
+        bias = self.param("bias", nn.initializers.zeros_init(),
+                          (self.d_out,))
+        if x is None:
+            return kernel, bias
+        return (jnp.dot(x.astype(self.dtype), kernel.astype(self.dtype))
+                + bias.astype(self.dtype))
+
+
 class TransformerLM(nn.Module):
     """Transformer LM backbone: tokens [B, L] int32 -> logits [B, L, V].
 
@@ -466,8 +491,25 @@ class TransformerLM(nn.Module):
     @nn.compact
     def __call__(self, tokens: jax.Array, *, train: bool = False,
                  decode: bool = False,
-                 positions: Optional[jax.Array] = None) -> jax.Array:
+                 positions: Optional[jax.Array] = None,
+                 features_only: bool = False):
         cfg = self.cfg
+        if features_only and cfg.shard_vocab:
+            # The fused loss slices vocab chunks in its own scan; a
+            # model-sharded vocab dim would all-gather per chunk.
+            raise ValueError("features_only (fused CE) does not compose "
+                             "with shard_vocab")
+        if (features_only and self.mesh is not None
+                and dict(self.mesh.shape).get(AXIS_MODEL, 1) > 1):
+            # Same per-chunk gather problem by another route: the untied
+            # head kernel's vocab dim carries TP metadata whenever
+            # tp_partitioning is on, so at mesh.model > 1 the chunk
+            # slices would cross shard boundaries. config.validate
+            # mirrors this for the CLI.
+            raise ValueError("features_only (fused CE) requires "
+                             "mesh.model == 1 (the head's vocab dim is "
+                             "TP-sharded; chunk slices would gather it "
+                             "per step)")
         if cfg.pos_emb not in ("learned", "rope"):
             raise ValueError(f"pos_emb {cfg.pos_emb!r}; "
                              f"have ('learned', 'rope')")
@@ -525,6 +567,18 @@ class TransformerLM(nn.Module):
             x = block(cfg, self.mesh, name=f"layer_{i}")(x, train, decode,
                                                          positions)
         x = _norm(cfg, "ln_f")(x)
+        if features_only:
+            # Hand the loss the pieces of the head instead of its
+            # product: (features, head matrix, bias, vocab axis of the
+            # matrix) — ops.fused_ce consumes them chunk by chunk.
+            xc = x.astype(cfg.compute_dtype)
+            if cfg.tie_embeddings:
+                return xc, emb.embedding[:cfg.vocab_size], None, 0
+            head = _LmHead(cfg.d_model, cfg.vocab_size,
+                           _maybe_partitioned(cfg, (None, AXIS_MODEL)),
+                           cfg.compute_dtype, name="lm_head")
+            kernel, bias = head(None)
+            return xc, kernel, bias, 1
         if cfg.tie_embeddings:
             # Cast the shared table to compute dtype so the logits
             # matmul (the model's largest) stays on the bf16 MXU path
@@ -541,10 +595,10 @@ class TransformerLM(nn.Module):
             # (the kernel's vocab dim is TP-sharded whenever
             # tp_partitioning is on).
             head_pad = ((-cfg.vocab_size) % tp if cfg.shard_vocab else 0)
-            logits = nn.Dense(
-                cfg.vocab_size + head_pad,
-                kernel_init=_maybe_partitioned(cfg, (None, AXIS_MODEL)),
-                dtype=cfg.compute_dtype, name="lm_head")(
+            logits = _LmHead(
+                cfg.d_model, cfg.vocab_size + head_pad,
+                _maybe_partitioned(cfg, (None, AXIS_MODEL)),
+                cfg.compute_dtype, name="lm_head")(
                 x.astype(cfg.compute_dtype))
             if head_pad:
                 logits = logits[..., :cfg.vocab_size]

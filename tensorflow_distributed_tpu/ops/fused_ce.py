@@ -1,0 +1,225 @@
+"""Fused (vocab-chunked) linear + softmax cross-entropy.
+
+The dense LM loss path materializes the full ``[B, L, V]`` logits tensor
+twice per step — once in the forward (the head matmul's output) and once
+in the backward (``softmax - onehot``). At GPT-2-small shapes (batch 8,
+seq 1024, vocab 50257) that is ~825 MB of bf16 per materialization, pure
+HBM traffic the MXU waits on. No reference counterpart — the reference's
+output layer is 10 classes (`mnist_python_m.py:196,205`), where none of
+this matters; it exists for the LM families' 50k-row heads.
+
+This op fuses the head matmul into the loss with an **online softmax
+over vocabulary chunks** (the same running (m, l) recurrence the flash
+attention kernels use over key blocks, ops/flash_attention.py): the
+forward scans vocab chunks of the head matrix, keeping only the running
+max / normalizer / gold-logit / argmax accumulators (all ``[B, L]``),
+and the custom-VJP backward **recomputes** each chunk's logits to form
+its slice of ``softmax - onehot`` on the fly. Peak logits memory drops
+from ``[B, L, V]`` to ``[B, L, chunk]``; full logits are never written.
+
+Chunking over *vocab* (not tokens) is the SPMD-friendly choice: the
+batch/seq dims — the ones sharded over the ``data``/``seq`` mesh axes —
+pass through untouched, so under pjit every device simply runs the same
+chunk loop on its own activation shard; no resharding, no collectives
+beyond the loss reductions that were already there.
+
+Semantics match ``ops.losses.masked_ce_sums`` exactly (unnormalized
+(ce_sum, correct, mask_sum) pieces, f32 statistics, label smoothing as
+the (1-eps)/eps-uniform target mixture); parity — values and gradients
+— is pinned in tests/test_fused_ce.py.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _pad_vocab(w: jax.Array, bias: Optional[jax.Array], vocab_size: int,
+               chunk: int, w_vocab_axis: int):
+    """Zero-pad the vocab dim up to a chunk multiple so every scan step
+    slices a full, non-clamped chunk (dynamic_slice clamps out-of-range
+    starts, which would silently alias the last rows)."""
+    pad = (-vocab_size) % chunk
+    if pad:
+        widths = [(0, 0)] * w.ndim
+        widths[w_vocab_axis] = (0, pad)
+        w = jnp.pad(w, widths)
+        if bias is not None:
+            bias = jnp.pad(bias, (0, pad))
+    return w, bias, vocab_size + pad
+
+
+def _chunk_logits(x: jax.Array, w: jax.Array, bias: Optional[jax.Array],
+                  c0: jax.Array, chunk: int, vocab_size: int,
+                  w_vocab_axis: int) -> Tuple[jax.Array, jax.Array]:
+    """Logits for vocab columns [c0, c0+chunk) in f32, with columns past
+    the real vocab masked to -inf. Returns (logits [..., chunk],
+    valid [chunk] bool)."""
+    wc = jax.lax.dynamic_slice_in_dim(w, c0, chunk, axis=w_vocab_axis)
+    wc = wc.astype(x.dtype)
+    eq = "...d,cd->...c" if w_vocab_axis == 0 else "...d,dc->...c"
+    logits = jnp.einsum(eq, x, wc,
+                        preferred_element_type=jnp.float32)
+    if bias is not None:
+        bc = jax.lax.dynamic_slice_in_dim(bias, c0, chunk, axis=0)
+        logits = logits + bc.astype(jnp.float32)
+    valid = (c0 + jnp.arange(chunk)) < vocab_size
+    logits = jnp.where(valid, logits, -jnp.inf)
+    return logits, valid
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def fused_ce_sums(x: jax.Array, w: jax.Array, bias: Optional[jax.Array],
+                  targets: jax.Array, mask: jax.Array,
+                  vocab_size: int, chunk: int,
+                  label_smoothing: float = 0.0,
+                  w_vocab_axis: int = 0):
+    """Unnormalized masked-CE pieces of ``x @ w (+ bias)`` without
+    materializing the logits: (ce_sum, correct_sum, mask_sum) — the same
+    contract as ops.losses.masked_ce_sums, so the pipeline-style global
+    normalization applies unchanged.
+
+    x: [..., D] features (compute dtype); w: head matrix with the vocab
+    dim on ``w_vocab_axis`` (0: a [V, D] tied embedding table, 1: a
+    [D, V] untied head kernel); targets/mask: [...]; ``chunk``: vocab
+    columns per scan step (the peak-logits knob). Only ce_sum is
+    differentiable (wrt x, w, bias); correct/mask_sum are metrics.
+    """
+    out, _ = _fwd_pass(x, w, bias, targets, mask, vocab_size, chunk,
+                       label_smoothing, w_vocab_axis)
+    return out
+
+
+def _fwd_pass(x, w, bias, targets, mask, vocab_size, chunk,
+              label_smoothing, w_vocab_axis):
+    wp, bp, vpad = _pad_vocab(w, bias, vocab_size, chunk, w_vocab_axis)
+    n_chunks = vpad // chunk
+    bshape = targets.shape
+    targets = targets.astype(jnp.int32)
+
+    def body(carry, c_idx):
+        m, l, gold, lsum, best_v, best_i = carry
+        c0 = c_idx * chunk
+        logits, valid = _chunk_logits(x, wp, bp, c0, chunk, vocab_size,
+                                      w_vocab_axis)
+        # Online logsumexp (the flash recurrence over vocab columns).
+        cmax = jnp.max(logits, axis=-1)
+        new_m = jnp.maximum(m, cmax)
+        l = l * jnp.exp(m - new_m) + jnp.sum(
+            jnp.exp(logits - new_m[..., None]), axis=-1)
+        # Gold logit: at most one chunk contains each target.
+        idx = targets - c0
+        hit = (idx >= 0) & (idx < chunk)
+        g = jnp.take_along_axis(
+            logits, jnp.clip(idx, 0, chunk - 1)[..., None], axis=-1)[..., 0]
+        gold = gold + jnp.where(hit, g, 0.0)
+        # Smoothing needs sum(logits) over the REAL vocab only.
+        if label_smoothing:
+            lsum = lsum + jnp.sum(jnp.where(valid, logits, 0.0), axis=-1)
+        # Running argmax: strict > keeps the first max, matching
+        # jnp.argmax over the full row.
+        cidx = jnp.argmax(logits, axis=-1).astype(jnp.int32) + c0
+        take = cmax > best_v
+        best_v = jnp.where(take, cmax, best_v)
+        best_i = jnp.where(take, cidx, best_i)
+        return (new_m, l, gold, lsum, best_v, best_i), None
+
+    init = (jnp.full(bshape, -jnp.inf, jnp.float32),
+            jnp.zeros(bshape, jnp.float32),
+            jnp.zeros(bshape, jnp.float32),
+            jnp.zeros(bshape, jnp.float32),
+            jnp.full(bshape, -jnp.inf, jnp.float32),
+            jnp.full(bshape, -1, jnp.int32))
+    (m, l, gold, lsum, _, best_i), _ = jax.lax.scan(
+        body, init, jnp.arange(n_chunks))
+
+    lse = m + jnp.log(l)
+    if label_smoothing:
+        gold = ((1.0 - label_smoothing) * gold
+                + (label_smoothing / vocab_size) * lsum)
+    fmask = mask.astype(jnp.float32)
+    ce_sum = jnp.sum((lse - gold) * fmask)
+    correct = jnp.sum((best_i == targets).astype(jnp.float32) * fmask)
+    out = (ce_sum, correct, jnp.sum(fmask))
+    return out, (x, w, bias, targets, mask, lse)
+
+
+def _bwd_pass(vocab_size, chunk, label_smoothing, w_vocab_axis, res, g):
+    x, w, bias, targets, mask, lse = res
+    g_ce = g[0]  # correct/mask_sum are metrics: cotangents ignored
+    wp, bp, vpad = _pad_vocab(w, bias, vocab_size, chunk, w_vocab_axis)
+    n_chunks = vpad // chunk
+    targets = targets.astype(jnp.int32)
+    # d ce_sum / d logits = mask * (softmax - smoothed_onehot), where
+    # smoothed_onehot = (1-eps)*onehot + (eps/V) on real columns.
+    scale = (mask.astype(jnp.float32) * g_ce)[..., None]
+    batch_axes = tuple(range(x.ndim - 1))
+
+    def body(dx, c_idx):
+        c0 = c_idx * chunk
+        logits, valid = _chunk_logits(x, wp, bp, c0, chunk, vocab_size,
+                                      w_vocab_axis)
+        p = jnp.exp(logits - lse[..., None])  # -inf columns -> exactly 0
+        idx = targets - c0
+        hit = ((idx >= 0) & (idx < chunk))[..., None]
+        onehot = hit & (jnp.arange(chunk) == jnp.clip(idx, 0, chunk - 1)
+                        [..., None])
+        dlogits = p - (1.0 - label_smoothing) * onehot
+        if label_smoothing:
+            dlogits = dlogits - (label_smoothing / vocab_size) * valid
+        dlogits = (dlogits * scale).astype(x.dtype)
+        wc = jax.lax.dynamic_slice_in_dim(
+            wp, c0, chunk, axis=w_vocab_axis).astype(x.dtype)
+        if w_vocab_axis == 0:
+            dx = dx + jnp.einsum("...c,cd->...d", dlogits, wc,
+                                 preferred_element_type=jnp.float32)
+            dwc = jnp.einsum("...c,...d->cd", dlogits, x,
+                             preferred_element_type=jnp.float32)
+        else:
+            dx = dx + jnp.einsum("...c,dc->...d", dlogits, wc,
+                                 preferred_element_type=jnp.float32)
+            dwc = jnp.einsum("...d,...c->dc", x, dlogits,
+                             preferred_element_type=jnp.float32)
+        dbc = jnp.sum(dlogits.astype(jnp.float32), axis=batch_axes)
+        return dx, (dwc, dbc)
+
+    dx0 = jnp.zeros(x.shape, jnp.float32)
+    dx, (dw_chunks, db_chunks) = jax.lax.scan(
+        body, dx0, jnp.arange(n_chunks))
+
+    # Reassemble the stacked per-chunk head grads and drop the padding.
+    if w_vocab_axis == 0:
+        dw = dw_chunks.reshape(vpad, -1)[:vocab_size]
+    else:
+        dw = jnp.moveaxis(dw_chunks, 0, 1).reshape(
+            x.shape[-1], vpad)[:, :vocab_size]
+    db = (db_chunks.reshape(vpad)[:vocab_size].astype(
+        bias.dtype if bias is not None else jnp.float32)
+        if bias is not None else None)
+    return (dx.astype(x.dtype), dw.astype(w.dtype), db,
+            np.zeros(targets.shape, jax.dtypes.float0),
+            jnp.zeros_like(mask))
+
+
+fused_ce_sums.defvjp(_fwd_pass, _bwd_pass)
+
+
+def fused_masked_cross_entropy(x: jax.Array, w: jax.Array,
+                               bias: Optional[jax.Array],
+                               targets: jax.Array, mask: jax.Array, *,
+                               vocab_size: int, chunk: int,
+                               label_smoothing: float = 0.0,
+                               w_vocab_axis: int = 0):
+    """Mean masked CE + accuracy from the fused pieces — the drop-in
+    for masked_softmax_cross_entropy + masked_accuracy when the caller
+    holds features instead of logits. Returns (loss, accuracy)."""
+    ce_sum, correct, n = fused_ce_sums(
+        x, w, bias, targets, mask, vocab_size, chunk, label_smoothing,
+        w_vocab_axis)
+    n = jnp.maximum(n, 1.0)
+    return ce_sum / n, correct / n

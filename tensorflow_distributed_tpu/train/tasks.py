@@ -100,9 +100,36 @@ def _make_vision_task(cfg: TrainConfig, mesh: Mesh) -> Task:
 
 # --- masked LM (BASELINE.json stretch family) ---------------------------
 
-def make_mlm_loss(label_smoothing: float = 0.0):
+def _fused_lm_metrics(apply_fn, variables, batch, rngs, train,
+                      label_smoothing, ce_chunk, mutable=False):
+    """Shared fused-CE body (mlm + moe losses): apply in features_only
+    mode and run the head matmul inside the chunked loss — the full
+    [B, L, V] logits are never materialized (ops/fused_ce.py).
+    Returns (loss, accuracy, mutated_collections)."""
+    from tensorflow_distributed_tpu.ops.fused_ce import (
+        fused_masked_cross_entropy)
+    out = apply_fn(variables, batch["tokens"], train=train, rngs=rngs,
+                   mutable=mutable, features_only=True)
+    (feats, w, bias, v_axis), mut = out if mutable else (out, {})
+    loss, acc = fused_masked_cross_entropy(
+        feats, w, bias, batch["targets"], batch["mask"],
+        vocab_size=w.shape[v_axis], chunk=ce_chunk,
+        label_smoothing=label_smoothing, w_vocab_axis=v_axis)
+    return loss, acc, mut
+
+
+def make_mlm_loss(label_smoothing: float = 0.0, ce_chunk: int = 0):
     def mlm_loss(apply_fn, params, extra, batch, dropout_key, train):
         """Masked-LM objective over a {tokens, targets, mask} batch."""
+        if ce_chunk:
+            variables = {"params": params, **extra}
+            rngs = {"dropout": dropout_key} if train else {}
+            mutable = list(extra) if (train and extra) else False
+            loss, acc, mut = _fused_lm_metrics(
+                apply_fn, variables, batch, rngs, train, label_smoothing,
+                ce_chunk, mutable=mutable)
+            new_extra = dict(mut) if mutable else extra
+            return loss, ({"loss": loss, "accuracy": acc}, new_extra)
         logits, new_extra = step_lib.apply_model(
             apply_fn, params, extra, batch["tokens"], dropout_key, train)
         loss = masked_softmax_cross_entropy(logits, batch["targets"],
@@ -125,7 +152,7 @@ MOE_AUX_WEIGHT = 0.01  # Switch-Transformer-style coefficient
 
 def make_moe_loss(aux_weight: float = MOE_AUX_WEIGHT,
                   zloss_weight: float = 0.0,
-                  label_smoothing: float = 0.0):
+                  label_smoothing: float = 0.0, ce_chunk: int = 0):
     """CLM objective + router losses from the "moe_aux" collection the
     MoeMlp layers sow (models/moe.py): load-balance (weighted by
     ``aux_weight``), router z-loss (``zloss_weight``), and the
@@ -138,10 +165,16 @@ def make_moe_loss(aux_weight: float = MOE_AUX_WEIGHT,
         variables = {"params": params,
                      **{k: v for k, v in extra.items() if k != "moe_aux"}}
         rngs = {"dropout": dropout_key} if train else {}
-        logits, mut = apply_fn(variables, batch["tokens"], train=train,
-                               rngs=rngs, mutable=["moe_aux"])
-        loss = masked_softmax_cross_entropy(logits, batch["targets"],
-                                            batch["mask"], label_smoothing)
+        if ce_chunk:
+            loss, acc, mut = _fused_lm_metrics(
+                apply_fn, variables, batch, rngs, train, label_smoothing,
+                ce_chunk, mutable=["moe_aux"])
+        else:
+            logits, mut = apply_fn(variables, batch["tokens"], train=train,
+                                   rngs=rngs, mutable=["moe_aux"])
+            loss = masked_softmax_cross_entropy(
+                logits, batch["targets"], batch["mask"], label_smoothing)
+            acc = masked_accuracy(logits, batch["targets"], batch["mask"])
         aux = collect_aux(mut.get("moe_aux", {}))
         lb = aux.get("load_balance", 0.0)
         z = aux.get("z_loss", 0.0)
@@ -149,8 +182,7 @@ def make_moe_loss(aux_weight: float = MOE_AUX_WEIGHT,
         metrics = {
             "loss": loss, "aux_loss": lb, "z_loss": z,
             "dropped_frac": aux.get("dropped_fraction", 0.0),
-            "accuracy": masked_accuracy(logits, batch["targets"],
-                                        batch["mask"]),
+            "accuracy": acc,
         }
         return total, (metrics, extra)
 
@@ -241,10 +273,13 @@ def _make_lm_task(cfg: TrainConfig, mesh: Mesh, objective: str,
     return Task(
         name=objective,
         loss=(make_moe_loss(cfg.moe_aux_weight, cfg.moe_zloss_weight,
-                            cfg.label_smoothing)
-              if moe else make_mlm_loss(cfg.label_smoothing)),
+                            cfg.label_smoothing, ce_chunk=cfg.ce_chunk)
+              if moe else make_mlm_loss(cfg.label_smoothing,
+                                        ce_chunk=cfg.ce_chunk)),
         # Eval drops the train-only smoothing but keeps the router
         # terms (they're part of the MoE objective being reported).
+        # The fused head is a train-side memory/bandwidth choice; eval
+        # keeps the dense path (it wants logits-level metrics anyway).
         eval_loss=(make_moe_loss(cfg.moe_aux_weight, cfg.moe_zloss_weight)
                    if moe else mlm_loss),
         batch_shardings=mlm_batch_shardings(mesh),

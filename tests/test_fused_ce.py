@@ -1,0 +1,222 @@
+"""Fused (vocab-chunked) linear+CE vs the dense oracle.
+
+The contract: ops.fused_ce.fused_ce_sums computes EXACTLY what
+ops.losses.masked_ce_sums computes on logits = x @ w (+ bias) — values
+AND gradients wrt x / w / bias — while never materializing the full
+logits. Oracle parity runs in f32 where the comparison is tight.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorflow_distributed_tpu.ops.fused_ce import (
+    fused_ce_sums, fused_masked_cross_entropy)
+from tensorflow_distributed_tpu.ops.losses import masked_ce_sums
+
+B, L, D, V = 2, 16, 24, 51  # V deliberately prime: never chunk-aligned
+
+
+def _mk(seed=0, vocab=V, bias=True):
+    rng = np.random.RandomState(seed)
+    x = jnp.asarray(rng.randn(B, L, D).astype(np.float32))
+    w = jnp.asarray((0.1 * rng.randn(vocab, D)).astype(np.float32))
+    b = jnp.asarray((0.1 * rng.randn(vocab)).astype(np.float32)) \
+        if bias else None
+    t = jnp.asarray(rng.randint(0, vocab, size=(B, L)).astype(np.int32))
+    m = jnp.asarray((rng.rand(B, L) < 0.7).astype(np.float32))
+    return x, w, b, t, m
+
+
+def _dense(x, w, b, t, m, smoothing=0.0):
+    logits = jnp.einsum("bld,vd->blv", x, w)
+    if b is not None:
+        logits = logits + b
+    return masked_ce_sums(logits, t, m, smoothing)
+
+
+@pytest.mark.parametrize("chunk", [8, 16, 51, 64])
+@pytest.mark.parametrize("smoothing", [0.0, 0.1])
+def test_values_match_dense(chunk, smoothing):
+    x, w, b, t, m = _mk()
+    want = _dense(x, w, b, t, m, smoothing)
+    got = fused_ce_sums(x, w, b, t, m, V, chunk, smoothing, 0)
+    for g, wnt in zip(got, want):
+        np.testing.assert_allclose(g, wnt, rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("smoothing", [0.0, 0.1])
+def test_grads_match_dense(smoothing):
+    x, w, b, t, m = _mk(seed=1)
+
+    def dense_loss(x, w, b):
+        ce, _, n = _dense(x, w, b, t, m, smoothing)
+        return ce / n
+
+    def fused_loss(x, w, b):
+        ce, _, n = fused_ce_sums(x, w, b, t, m, V, 16, smoothing, 0)
+        return ce / n
+
+    gd = jax.grad(dense_loss, argnums=(0, 1, 2))(x, w, b)
+    gf = jax.jit(jax.grad(fused_loss, argnums=(0, 1, 2)))(x, w, b)
+    for a, e in zip(gf, gd):
+        np.testing.assert_allclose(a, e, rtol=1e-4, atol=1e-5)
+
+
+def test_untied_orientation_and_no_bias():
+    """w_vocab_axis=1 ([D, V] untied-kernel layout), bias=None."""
+    x, w, _, t, m = _mk(seed=2, bias=False)
+    wk = w.T  # [D, V]
+
+    def dense_loss(x, wk):
+        ce, _, n = masked_ce_sums(jnp.einsum("bld,dv->blv", x, wk), t, m)
+        return ce / n
+
+    def fused_loss(x, wk):
+        ce, _, n = fused_ce_sums(x, wk, None, t, m, V, 16, 0.0, 1)
+        return ce / n
+
+    np.testing.assert_allclose(fused_loss(x, wk), dense_loss(x, wk),
+                               rtol=2e-5)
+    gd = jax.grad(dense_loss, argnums=(0, 1))(x, wk)
+    gf = jax.grad(fused_loss, argnums=(0, 1))(x, wk)
+    for a, e in zip(gf, gd):
+        np.testing.assert_allclose(a, e, rtol=1e-4, atol=1e-5)
+
+
+def test_accuracy_matches_argmax_first_max():
+    """The running argmax keeps the FIRST maximum across chunk
+    boundaries, like jnp.argmax on the full row — pin it with
+    duplicated columns straddling a chunk edge."""
+    x = jnp.ones((1, 1, 2), jnp.float32)
+    # Columns 1 and 9 are identical rows of w -> identical logits;
+    # chunk=4 puts them in different chunks. argmax must say 1.
+    w = np.zeros((12, 2), np.float32)
+    w[1] = w[9] = 3.0
+    t = jnp.asarray([[1]], jnp.int32)
+    m = jnp.ones((1, 1), jnp.float32)
+    _, correct, _ = fused_ce_sums(x, jnp.asarray(w), None, t, m,
+                                  12, 4, 0.0, 0)
+    assert float(correct) == 1.0
+    t9 = jnp.asarray([[9]], jnp.int32)
+    _, correct, _ = fused_ce_sums(x, jnp.asarray(w), None, t9, m,
+                                  12, 4, 0.0, 0)
+    assert float(correct) == 0.0  # argmax picked 1, the first max
+
+
+def test_wrapper_matches_mean_forms():
+    from tensorflow_distributed_tpu.ops.losses import (
+        masked_accuracy, masked_softmax_cross_entropy)
+    x, w, b, t, m = _mk(seed=3)
+    logits = jnp.einsum("bld,vd->blv", x, w) + b
+    loss, acc = fused_masked_cross_entropy(x, w, b, t, m, vocab_size=V,
+                                           chunk=16)
+    np.testing.assert_allclose(loss, masked_softmax_cross_entropy(
+        logits, t, m), rtol=2e-5)
+    np.testing.assert_allclose(acc, masked_accuracy(logits, t, m),
+                               rtol=2e-5)
+
+
+def test_bf16_features_close_to_dense_bf16():
+    """The real call site hands bf16 features; the fused path (f32
+    accumulation) must stay within bf16-roundoff of the dense path."""
+    x, w, b, t, m = _mk(seed=4)
+    xb = x.astype(jnp.bfloat16)
+    logits = jnp.einsum("bld,vd->blv", xb, w.astype(jnp.bfloat16),
+                        preferred_element_type=jnp.float32) + b
+    want = masked_ce_sums(logits, t, m)
+    got = fused_ce_sums(xb, w, b, t, m, V, 16, 0.0, 0)
+    np.testing.assert_allclose(got[0], want[0], rtol=2e-2)
+    assert float(got[2]) == float(want[2])
+
+
+@pytest.mark.parametrize("tie", [False, True])
+def test_model_features_mode_consistent_with_logits(tie):
+    """apply(features_only=True) hands out exactly the pieces whose
+    product is the dense logits path."""
+    from tensorflow_distributed_tpu.models import build_model
+
+    model = build_model("gpt_lm", size="tiny", tie_embeddings=tie,
+                        compute_dtype=jnp.float32)
+    tokens = jnp.asarray(np.arange(8, dtype=np.int32).reshape(2, 4) % 64)
+    params = model.init(jax.random.PRNGKey(0), tokens)
+    logits = model.apply(params, tokens)
+    feats, w, b, v_axis = model.apply(params, tokens, features_only=True)
+    eq = "bld,vd->blv" if v_axis == 0 else "bld,dv->blv"
+    rebuilt = jnp.einsum(eq, feats, w) + (0.0 if b is None else b)
+    np.testing.assert_allclose(rebuilt, logits, rtol=1e-5, atol=1e-5)
+    assert (b is None) == tie
+
+
+def test_train_step_parity_dense_vs_fused(devices8):
+    """Same tiny GPT, same seeds: --ce-chunk must reproduce the dense
+    path's training trajectory (f32 compute keeps parity tight)."""
+    from tensorflow_distributed_tpu.config import MeshConfig, TrainConfig
+    from tensorflow_distributed_tpu.train.loop import train
+
+    base = dict(model="gpt_lm", model_size="tiny", dataset="synthetic",
+                batch_size=16, train_steps=5, eval_every=0, log_every=0,
+                eval_batch_size=16, compute_dtype="float32",
+                learning_rate=1e-3, label_smoothing=0.1,
+                mesh=MeshConfig(data=4, seq=2))
+    dense = train(TrainConfig(**base))
+    fused = train(TrainConfig(**base, ce_chunk=24))
+    np.testing.assert_allclose(fused.final_metrics["loss"],
+                               dense.final_metrics["loss"],
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_config_rejects_bad_combinations():
+    from tensorflow_distributed_tpu.config import TrainConfig
+
+    with pytest.raises(ValueError, match="shard_vocab"):
+        TrainConfig(model="gpt_lm", ce_chunk=8192,
+                    shard_vocab=True).validate()
+    with pytest.raises(ValueError, match="pipelined_lm"):
+        TrainConfig(model="pipelined_lm", ce_chunk=8192).validate()
+    with pytest.raises(ValueError, match="LM families"):
+        TrainConfig(model="mnist_cnn", ce_chunk=8192).validate()
+    from tensorflow_distributed_tpu.config import MeshConfig
+    with pytest.raises(ValueError, match="mesh.model"):
+        TrainConfig(model="gpt_lm", ce_chunk=8192,
+                    mesh=MeshConfig(model=2)).validate()
+
+
+def test_moe_train_step_parity_dense_vs_fused(devices8):
+    """The MoE loss's fused branch must reproduce its dense branch —
+    including the router-aux terms collected through the mutable
+    'moe_aux' apply."""
+    from tensorflow_distributed_tpu.config import MeshConfig, TrainConfig
+    from tensorflow_distributed_tpu.train.loop import train
+
+    base = dict(model="moe_lm", model_size="tiny", dataset="synthetic",
+                batch_size=16, train_steps=3, eval_every=0, log_every=0,
+                eval_batch_size=16, compute_dtype="float32",
+                learning_rate=1e-3, mesh=MeshConfig(data=4, expert=2))
+    dense = train(TrainConfig(**base))
+    fused = train(TrainConfig(**base, ce_chunk=24))
+    np.testing.assert_allclose(fused.final_metrics["loss"],
+                               dense.final_metrics["loss"],
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_sharded_matches_single_device():
+    """Under pjit with batch over 'data' and seq over 'seq', the chunk
+    scan runs per-shard with no resharding; results match 1-device."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    devs = np.array(jax.devices()[:4]).reshape(2, 2)
+    mesh = Mesh(devs, ("data", "seq"))
+    x, w, b, t, m = _mk(seed=5)
+
+    def f(x, w, b, t, m):
+        ce, correct, n = fused_ce_sums(x, w, b, t, m, V, 16, 0.1, 0)
+        return ce, correct, n
+
+    want = f(x, w, b, t, m)
+    s = NamedSharding(mesh, P("data", "seq"))
+    xs = jax.device_put(x, NamedSharding(mesh, P("data", "seq", None)))
+    ts, ms = jax.device_put(t, s), jax.device_put(m, s)
+    got = jax.jit(f)(xs, w, b, ts, ms)
+    for g, wnt in zip(got, want):
+        np.testing.assert_allclose(g, wnt, rtol=2e-5, atol=2e-5)
