@@ -37,6 +37,13 @@ timeout 580 python -m tensorflow_distributed_tpu.cli --model gpt_lm \
 timeout 580 python -m tensorflow_distributed_tpu.benchmarks.lm_perf \
     --batch 16 --skip-ab --out LMBENCH_r04_b16.json
 
+# 5b. Fused vocab-chunked CE A/B (ops/fused_ce.py): dense [B,L,V]
+#     logits vs the chunked head+loss, same step otherwise.
+timeout 580 python -m tensorflow_distributed_tpu.benchmarks.lm_perf \
+    --batch 8 --skip-ab --out CEBENCH_dense.json
+timeout 580 python -m tensorflow_distributed_tpu.benchmarks.lm_perf \
+    --batch 8 --skip-ab --ce-chunk 8192 --out CEBENCH_fused.json
+
 # 6. Ring local-compute block-size sweep: the recorded RINGBENCH showed
 #    flash-partial ~parity with einsum at half-block 512 — find where
 #    (if anywhere) the kernel pulls ahead, for the dispatch tuning the
