@@ -44,6 +44,13 @@ timeout 580 python -m tensorflow_distributed_tpu.benchmarks.lm_perf \
 timeout 580 python -m tensorflow_distributed_tpu.benchmarks.lm_perf \
     --batch 8 --skip-ab --ce-chunk 8192 --out CEBENCH_fused.json
 
+# 5c. Stash-backward re-measure AFTER the weight-leaf hoist (the
+#     19.9%-MFU number in PARITY predates it; matched shapes vs the
+#     recompute run it lost to).
+timeout 580 python -m tensorflow_distributed_tpu.benchmarks.lm_perf \
+    --batch 32 --pipeline-microbatches 4 --pipeline-backward stash \
+    --skip-ab --out STASHBENCH_hoisted.json
+
 # 6. Ring local-compute block-size sweep: the recorded RINGBENCH showed
 #    flash-partial ~parity with einsum at half-block 512 — find where
 #    (if anywhere) the kernel pulls ahead, for the dispatch tuning the

@@ -180,6 +180,66 @@ def bubble_fraction(num_microbatches: int, num_stages: int,
     raise ValueError(f"schedule {schedule!r}; have ('gpipe', '1f1b')")
 
 
+def variant_residual_mask(res_fn: Callable[[Any, jax.Array, jax.Array],
+                                           list],
+                          params: Any, x0: jax.Array) -> list:
+    """Which vjp-residual leaves actually vary per microbatch?
+
+    ``res_fn(params, x_mb, m) -> flat residual leaves`` (m: the
+    microbatch index that seeds dropout keys). Returns a bool per leaf:
+    True = depends on (x_mb, m) and must be ring-buffered per in-flight
+    microbatch; False = a pure function of the stage params (weight
+    matrices and their compute-dtype casts — the transpose operands
+    ``jax.vjp`` captures alongside the activations), identical for
+    every microbatch, so the stash backward computes it ONCE per step
+    instead of storing D copies.
+
+    The split is read off the jaxpr: seed the variant set with the
+    (x, m) input vars and propagate — any equation consuming a variant
+    var marks all its outputs variant. Call/scan/cond/remat equations
+    are handled at the equation level, i.e. conservatively: a false
+    positive only stashes more than needed, never corrupts a gradient.
+    """
+    flat_p, tree_p = jax.tree_util.tree_flatten(params)
+    n_p = len(flat_p)
+
+    def flat_fn(*args):
+        p = jax.tree_util.tree_unflatten(tree_p, args[:n_p])
+        return res_fn(p, args[n_p], args[n_p + 1])
+
+    from jax.extend.core import Literal
+
+    closed = jax.make_jaxpr(flat_fn)(*flat_p, x0, jnp.int32(0))
+    jaxpr = closed.jaxpr
+    variant = set(jaxpr.invars[n_p:])  # the x and m vars
+    for eqn in jaxpr.eqns:
+        if any(not isinstance(v, Literal) and v in variant
+               for v in eqn.invars):
+            variant.update(eqn.outvars)
+    return [not isinstance(v, Literal) and v in variant
+            for v in jaxpr.outvars]
+
+
+def split_by_mask(leaves, mask):
+    """(variant_leaves, const_leaves) per the bool mask — the single
+    inverse pair with merge_by_mask; all stash bookkeeping goes
+    through these two so the pairing can't drift."""
+    if len(leaves) != len(mask):
+        raise AssertionError(f"{len(leaves)} leaves vs {len(mask)} mask")
+    return ([l for l, v in zip(leaves, mask) if v],
+            [l for l, v in zip(leaves, mask) if not v])
+
+
+def merge_by_mask(variant_leaves, const_leaves, mask):
+    """Inverse of split_by_mask: reassemble the full leaf list."""
+    vs, cs = iter(variant_leaves), iter(const_leaves)
+    out = [next(vs) if v else next(cs) for v in mask]
+    for leftover in (vs, cs):
+        if next(leftover, None) is not None:
+            raise AssertionError("leaf count mismatch in merge_by_mask")
+    return out
+
+
 def pipeline_value_and_grad(stage_fn: Callable[..., jax.Array],
                             last_fn: Callable[[Any, jax.Array, Any],
                                               tuple],
@@ -250,15 +310,17 @@ def pipeline_value_and_grad(stage_fn: Callable[..., jax.Array],
         into per-slot ring buffers like any activation, and the
         backward tick re-attaches them to the (static) treedef
         obtained via ``jax.eval_shape`` — no recompute, Megatron's
-        default memory/compute trade. Costs D copies of the stage's
-        FULL residual set: every layer's activations AND the stage
-        weight matrices the transpose needs (vjp residuals include
-        them, and the ring buffer stores all leaves — hoisting the
-        microbatch-invariant weight leaves out is a known possible
-        optimization, unimplemented). Measured on v5e (PARITY.md):
-        that HBM traffic makes stash SLOWER than recompute at
-        GPT-2-small shapes — it stays opt-in for configurations
-        where the trade flips (short stages, faster HBM).
+        default memory/compute trade. Ring-buffered leaves are only
+        the MICROBATCH-VARIANT residuals: ``variant_residual_mask``
+        reads the residual jaxpr and splits out the leaves that are a
+        pure function of params (the stage weight matrices and their
+        compute-dtype casts, which jax.vjp captures as transpose
+        operands) — those are computed once per step instead of D
+        copies per ring. Before this hoist, the weight copies
+        dominated stash's HBM traffic and made it measurably SLOWER
+        than recompute on v5e at GPT-2-small shapes (19.9% vs 30.8%
+        MFU, PARITY.md) — that measurement predates the hoist and is
+        owed a re-run; stash stays opt-in until it's re-measured.
     """
     S = mesh.shape[AXIS_PIPE]
     M = num_microbatches
@@ -338,9 +400,31 @@ def pipeline_value_and_grad(stage_fn: Callable[..., jax.Array],
                 lambda p, xx: jax.vjp(with_key(jnp.int32(0)), p, xx)[1],
                 params, xm[0])
             res_treedef = jax.tree_util.tree_structure(vjp_abs)
+            abs_leaves = jax.tree_util.tree_leaves(vjp_abs)
+            # Ring-buffer only the leaves that actually vary per
+            # microbatch. The rest — the stage weights and their
+            # compute-dtype casts, which jax.vjp captures as transpose
+            # operands — are a pure function of params: compute them
+            # ONCE per step instead of storing D copies (at GPT-scale
+            # stages the weight copies dominated the stash's HBM
+            # traffic and made it lose to recompute, PARITY.md).
+            res_mask = variant_residual_mask(
+                lambda p, xx, m: jax.tree_util.tree_leaves(
+                    jax.vjp(with_key(m), p, xx)[1]),
+                params, xm[0])
+            if all(res_mask):
+                const_leaves = []
+            else:
+                # x enters as zeros; every computation feeding only the
+                # discarded variant outputs is dead code XLA removes,
+                # so this costs the casts, not a stage forward.
+                res0 = jax.vjp(with_key(jnp.int32(0)), params,
+                               jnp.zeros_like(xm[0]))[1]
+                _, const_leaves = split_by_mask(
+                    jax.tree_util.tree_leaves(res0), res_mask)
+            variant_abs, _ = split_by_mask(abs_leaves, res_mask)
             stash0 = tuple(
-                jnp.zeros((D,) + l.shape, l.dtype)
-                for l in jax.tree_util.tree_leaves(vjp_abs))
+                jnp.zeros((D,) + l.shape, l.dtype) for l in variant_abs)
         else:
             stash0 = jnp.zeros((D,) + xm[0].shape, xm.dtype)
 
@@ -370,11 +454,11 @@ def pipeline_value_and_grad(stage_fn: Callable[..., jax.Array],
                     # strict: a residual-structure drift between the
                     # eval_shape template and this trace must fail
                     # loudly, not silently stash stale zeros.
+                    vleaves, _ = split_by_mask(
+                        jax.tree_util.tree_leaves(vjp_fn), res_mask)
                     stash = tuple(
                         jax.lax.dynamic_update_index_in_dim(sb, l, slot, 0)
-                        for sb, l in zip(
-                            stash, jax.tree_util.tree_leaves(vjp_fn),
-                            strict=True))
+                        for sb, l in zip(stash, vleaves, strict=True))
                     return y, aux_v, stash
                 y, aux_v = with_key(mf_c)(params, inp)
                 stash = jax.lax.dynamic_update_index_in_dim(
@@ -420,11 +504,13 @@ def pipeline_value_and_grad(stage_fn: Callable[..., jax.Array],
                 slot = jnp.mod(mb_c, D)
                 cot = jnp.where(is_last, hdy, bwd_msg)
                 if stash_residuals:
+                    stashed = [
+                        jax.lax.dynamic_index_in_dim(sb, slot, 0,
+                                                     keepdims=False)
+                        for sb in stash]
                     vjp_fn = jax.tree_util.tree_unflatten(
                         res_treedef,
-                        [jax.lax.dynamic_index_in_dim(sb, slot, 0,
-                                                      keepdims=False)
-                         for sb in stash])
+                        merge_by_mask(stashed, const_leaves, res_mask))
                     return vjp_fn((cot.astype(xm.dtype), aux_seed))
                 x_saved = jax.lax.dynamic_index_in_dim(
                     stash, slot, 0, keepdims=False)

@@ -65,6 +65,54 @@ def test_1f1b_matches_gpipe(devices8):
         st_g.params, st_f.params)
 
 
+def test_variant_residual_mask_splits_weights_from_activations():
+    """The stash backward's hoist: residual leaves that are a pure
+    function of params (weight matrices, their compute-dtype casts)
+    must be flagged invariant — verified BEHAVIORALLY: leaves the mask
+    calls invariant are bit-identical across different (x, m), leaves
+    it calls variant include everything that moves. Dropout-mask
+    residuals depend on the microbatch index through the key fold and
+    must stay variant even though they don't depend on x."""
+    from tensorflow_distributed_tpu.parallel.pipeline import (
+        variant_residual_mask)
+
+    base_key = jax.random.PRNGKey(7)
+    params = {"w": jnp.linspace(0, 1, 64).reshape(8, 8)
+              .astype(jnp.float32), "b": jnp.ones((8,), jnp.float32)}
+
+    def stage(p, x, m):
+        h = x @ p["w"].astype(jnp.bfloat16).astype(jnp.float32) + p["b"]
+        keep = jax.random.bernoulli(
+            jax.random.fold_in(base_key, m), 0.8, h.shape)
+        return jnp.tanh(h) * keep
+
+    def res_fn(p, x, m):
+        _, vjp = jax.vjp(lambda pp, xx: stage(pp, xx, m), p, x)
+        return jax.tree_util.tree_leaves(vjp)
+
+    x1 = jnp.ones((4, 8), jnp.float32)
+    x2 = 2.0 * x1
+    mask = variant_residual_mask(res_fn, params, x1)
+    ra = res_fn(params, x1, jnp.int32(0))
+    rb = res_fn(params, x2, jnp.int32(1))
+    assert len(mask) == len(ra)
+    hoisted = [i for i, v in enumerate(mask) if not v]
+    assert hoisted, "no leaf hoisted — the weight cast should be"
+    for i in hoisted:
+        np.testing.assert_array_equal(np.asarray(ra[i]),
+                                      np.asarray(rb[i]))
+    # Something must still be stashed (activations, dropout masks).
+    assert any(mask)
+    # The dropout mask moved with m at fixed x — the mask may not
+    # call every moving leaf invariant.
+    rc = res_fn(params, x1, jnp.int32(1))
+    moved = [i for i in range(len(ra))
+             if np.asarray(ra[i]).shape == np.asarray(rc[i]).shape
+             and not np.array_equal(np.asarray(ra[i]),
+                                    np.asarray(rc[i]))]
+    assert all(mask[i] for i in moved)
+
+
 def test_1f1b_stash_backward_matches_recompute(devices8):
     """backward="stash" (residual ring buffers, no forward recompute)
     is a memory/compute trade, not a math change: same batch + state
