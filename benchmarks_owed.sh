@@ -43,6 +43,9 @@ timeout 580 python -m tensorflow_distributed_tpu.benchmarks.lm_perf \
     --batch 8 --skip-ab --out CEBENCH_dense.json
 timeout 580 python -m tensorflow_distributed_tpu.benchmarks.lm_perf \
     --batch 8 --skip-ab --ce-chunk 8192 --out CEBENCH_fused.json
+timeout 580 python -m tensorflow_distributed_tpu.benchmarks.lm_perf \
+    --batch 8 --skip-ab --ce-chunk 8192 --ce-impl kernel \
+    --out CEBENCH_kernel.json
 
 # 5c. Stash-backward re-measure AFTER the weight-leaf hoist (the
 #     19.9%-MFU number in PARITY predates it; matched shapes vs the

@@ -88,7 +88,7 @@ def flops_per_token(params, cfg) -> float:
 def _build(size: str, seq_len: int, use_flash: bool, remat: str,
            batch: int, mesh, seed: int = 0, pipeline_mb: int = 0,
            pipeline_backward: str = "recompute", attn_window: int = 0,
-           ce_chunk: int = 0):
+           ce_chunk: int = 0, ce_impl: str = "scan"):
     import jax
     import numpy as np
     import optax
@@ -126,8 +126,8 @@ def _build(size: str, seq_len: int, use_flash: bool, remat: str,
             model, mesh, seed, batch_shardings=mlm_batch_shardings(mesh),
             backward=pipeline_backward)
     else:
-        loss = (make_mlm_loss(ce_chunk=ce_chunk) if ce_chunk
-                else mlm_loss)
+        loss = (make_mlm_loss(ce_chunk=ce_chunk, ce_impl=ce_impl,
+                              mesh=mesh) if ce_chunk else mlm_loss)
         step = make_train_step(mesh, seed, loss=loss,
                                batch_shardings=mlm_batch_shardings(mesh))
     ds = synthetic_clm(n=batch, seq_len=seq_len,
@@ -175,6 +175,11 @@ def main(argv=None) -> None:
                         "fused_ce.py) with this chunk width — the full "
                         "[B, L, V] logits are never materialized; "
                         "0 = dense path")
+    parser.add_argument("--ce-impl", default="scan",
+                        choices=["scan", "kernel"],
+                        help="fused-loss formulation (with --ce-chunk): "
+                        "lax.scan chunks or the Pallas flash-CE "
+                        "kernels (ops/fused_ce_kernel.py)")
     parser.add_argument("--skip-ab", action="store_true",
                         help="skip the flash-vs-XLA attention A/B")
     parser.add_argument("--pipeline-backward", default="recompute",
@@ -217,10 +222,16 @@ def main(argv=None) -> None:
     if args.ce_chunk and pmb > 0:
         parser.error("--ce-chunk is not available in pipeline mode "
                      "(the last stage owns the head inside the pipe)")
+    if args.ce_impl != "scan" and not args.ce_chunk:
+        # Same rule as TrainConfig.validate: refuse knobs that would
+        # be silently ignored (and mislabel the benchmark record).
+        parser.error("--ce-impl requires --ce-chunk > 0 (the fused "
+                     "head+loss master switch)")
     model, state, step, batch = _build(
         args.size, args.seq_len, True, args.remat, args.batch, mesh,
         pipeline_mb=pmb, pipeline_backward=args.pipeline_backward,
-        attn_window=args.attn_window, ce_chunk=args.ce_chunk)
+        attn_window=args.attn_window, ce_chunk=args.ce_chunk,
+        ce_impl=args.ce_impl)
     n_params = param_count(state.params)
     fpt = flops_per_token(state.params, model.cfg)
 
@@ -241,6 +252,7 @@ def main(argv=None) -> None:
         meta["attn_window"] = args.attn_window
     if args.ce_chunk:
         meta["ce_chunk"] = args.ce_chunk
+        meta["ce_impl"] = args.ce_impl
     if pmb > 0:
         meta["pipeline_microbatches"] = pmb
         meta["pipeline_backward"] = args.pipeline_backward
@@ -284,7 +296,8 @@ def main(argv=None) -> None:
         del state, step, batch
         _, state_x, step_x, batch_x = _build(
             args.size, args.seq_len, False, args.remat, args.batch, mesh,
-            attn_window=args.attn_window, ce_chunk=args.ce_chunk)
+            attn_window=args.attn_window, ce_chunk=args.ce_chunk,
+            ce_impl=args.ce_impl)
         dt_x, _, _, last_x = _timed_steps(step_x, state_x, batch_x,
                                           args.steps)
         assert np.isfinite(last_x)

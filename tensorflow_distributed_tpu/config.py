@@ -122,6 +122,13 @@ class TrainConfig:
     # incompatible with shard_vocab and pipelined_lm (the pipe's head
     # lives stage-side). 8192 is a good first value at vocab 50257.
     ce_chunk: int = 0
+    # Fused-loss formulation when ce_chunk > 0: "scan" (lax.scan over
+    # vocab chunks — all shapes, SPMD-transparent) or "kernel" (the
+    # Pallas flash-CE triple, ops/fused_ce_kernel.py — logits blocks
+    # live only in VMEM; per-device token count and d_model must be
+    # multiples of 8, tokens must divide the 256 block when above it —
+    # kernel_supported() is the authority).
+    ce_impl: str = "scan"  # scan | kernel
     # Block normalization: "layernorm" or "rmsnorm" (scale-only,
     # Llama-style). Transformer families only.
     norm: str = "layernorm"  # layernorm | rmsnorm
@@ -560,6 +567,14 @@ class TrainConfig:
                 "loss slices vocab chunks in its own scan; a model-"
                 "sharded vocab dim would all-gather per chunk — pick "
                 "one)")
+        if self.ce_impl not in ("scan", "kernel"):
+            raise ValueError(
+                f"unknown ce_impl {self.ce_impl!r}; have "
+                f"('scan', 'kernel')")
+        if self.ce_impl != "scan" and not self.ce_chunk:
+            raise ValueError(
+                "ce_impl has no effect without ce_chunk > 0 (the fused "
+                "head+loss master switch); add --ce-chunk")
         if self.ce_chunk and self.mesh.model > 1:
             raise ValueError(
                 "ce_chunk requires mesh.model == 1: the lm_head "

@@ -214,12 +214,75 @@ def fused_masked_cross_entropy(x: jax.Array, w: jax.Array,
                                targets: jax.Array, mask: jax.Array, *,
                                vocab_size: int, chunk: int,
                                label_smoothing: float = 0.0,
-                               w_vocab_axis: int = 0):
+                               w_vocab_axis: int = 0,
+                               impl: str = "scan", mesh=None):
     """Mean masked CE + accuracy from the fused pieces — the drop-in
     for masked_softmax_cross_entropy + masked_accuracy when the caller
-    holds features instead of logits. Returns (loss, accuracy)."""
-    ce_sum, correct, n = fused_ce_sums(
-        x, w, bias, targets, mask, vocab_size, chunk, label_smoothing,
-        w_vocab_axis)
+    holds features instead of logits. Returns (loss, accuracy).
+
+    ``impl``: "scan" (this module's lax.scan formulation — all shapes,
+    SPMD-transparent) or "kernel" (the Pallas flash-CE triple,
+    ops/fused_ce_kernel.py — logits blocks live only in VMEM). The
+    kernel has no GSPMD partitioning rule, so on a multi-device
+    ``mesh`` it runs inside a shard_map over the batch/seq axes with
+    the loss reductions psummed — the same wrap the flash-attention
+    dispatcher uses (ops/flash_attention.py::attention).
+    """
+    if impl == "kernel":
+        ce_sum, correct, n = _kernel_sums(
+            x, w, bias, targets, mask, vocab_size, label_smoothing,
+            w_vocab_axis, mesh)
+    elif impl == "scan":
+        ce_sum, correct, n = fused_ce_sums(
+            x, w, bias, targets, mask, vocab_size, chunk,
+            label_smoothing, w_vocab_axis)
+    else:
+        raise ValueError(f"impl {impl!r}; have ('scan', 'kernel')")
     n = jnp.maximum(n, 1.0)
     return ce_sum / n, correct / n
+
+
+def _kernel_sums(x, w, bias, targets, mask, vocab_size, label_smoothing,
+                 w_vocab_axis, mesh):
+    from jax.sharding import PartitionSpec as P
+
+    from tensorflow_distributed_tpu.ops.fused_ce_kernel import (
+        fused_ce_sums_kernel, kernel_supported)
+    from tensorflow_distributed_tpu.parallel.mesh import (
+        AXIS_DATA, AXIS_SEQ)
+
+    D = x.shape[-1]
+    if bias is None:
+        # Materialize the zero bias OUTSIDE the shard_map: None is an
+        # empty pytree and cannot carry a partition spec.
+        bias = jnp.zeros((vocab_size,), jnp.float32)
+
+    def local(x, w, bias, targets, mask):
+        T = x.size // D
+        if not kernel_supported(T, D):
+            raise ValueError(
+                f"ce_impl='kernel' unsupported for per-device shard "
+                f"T={T}, D={D} (tokens must divide the 256 block, D "
+                f"must be an 8 multiple); use ce_impl='scan'")
+        return fused_ce_sums_kernel(
+            x, w, bias, targets, mask, vocab_size,
+            label_smoothing=label_smoothing, w_vocab_axis=w_vocab_axis)
+
+    if mesh is None or all(
+            mesh.shape[a] == 1 for a in (AXIS_DATA, AXIS_SEQ)):
+        return local(x, w, bias, targets, mask)
+
+    def sharded(x, w, bias, targets, mask):
+        ce, corr, n = local(x, w, bias, targets, mask)
+        # Tokens shard over (data, seq); every other axis holds
+        # replicas (model == 1 is enforced upstream) — psum only the
+        # token-sharding axes so replicas don't double-count.
+        return tuple(jax.lax.psum(v, (AXIS_DATA, AXIS_SEQ))
+                     for v in (ce, corr, n))
+
+    tok = P(AXIS_DATA, AXIS_SEQ)
+    return jax.shard_map(
+        sharded, mesh=mesh,
+        in_specs=(P(AXIS_DATA, AXIS_SEQ, None), P(), P(), tok, tok),
+        out_specs=(P(), P(), P()), check_vma=False)(
+        x, w, bias, targets, mask)

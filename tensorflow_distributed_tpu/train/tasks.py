@@ -101,10 +101,12 @@ def _make_vision_task(cfg: TrainConfig, mesh: Mesh) -> Task:
 # --- masked LM (BASELINE.json stretch family) ---------------------------
 
 def _fused_lm_metrics(apply_fn, variables, batch, rngs, train,
-                      label_smoothing, ce_chunk, mutable=False):
+                      label_smoothing, ce_chunk, mutable=False,
+                      ce_impl="scan", mesh=None):
     """Shared fused-CE body (mlm + moe losses): apply in features_only
     mode and run the head matmul inside the chunked loss — the full
-    [B, L, V] logits are never materialized (ops/fused_ce.py).
+    [B, L, V] logits are never materialized (ops/fused_ce.py; the
+    Pallas flash-CE triple when ce_impl='kernel').
     Returns (loss, accuracy, mutated_collections)."""
     from tensorflow_distributed_tpu.ops.fused_ce import (
         fused_masked_cross_entropy)
@@ -114,11 +116,13 @@ def _fused_lm_metrics(apply_fn, variables, batch, rngs, train,
     loss, acc = fused_masked_cross_entropy(
         feats, w, bias, batch["targets"], batch["mask"],
         vocab_size=w.shape[v_axis], chunk=ce_chunk,
-        label_smoothing=label_smoothing, w_vocab_axis=v_axis)
+        label_smoothing=label_smoothing, w_vocab_axis=v_axis,
+        impl=ce_impl, mesh=mesh)
     return loss, acc, mut
 
 
-def make_mlm_loss(label_smoothing: float = 0.0, ce_chunk: int = 0):
+def make_mlm_loss(label_smoothing: float = 0.0, ce_chunk: int = 0,
+                  ce_impl: str = "scan", mesh=None):
     def mlm_loss(apply_fn, params, extra, batch, dropout_key, train):
         """Masked-LM objective over a {tokens, targets, mask} batch."""
         if ce_chunk:
@@ -127,7 +131,7 @@ def make_mlm_loss(label_smoothing: float = 0.0, ce_chunk: int = 0):
             mutable = list(extra) if (train and extra) else False
             loss, acc, mut = _fused_lm_metrics(
                 apply_fn, variables, batch, rngs, train, label_smoothing,
-                ce_chunk, mutable=mutable)
+                ce_chunk, mutable=mutable, ce_impl=ce_impl, mesh=mesh)
             new_extra = dict(mut) if mutable else extra
             return loss, ({"loss": loss, "accuracy": acc}, new_extra)
         logits, new_extra = step_lib.apply_model(
@@ -152,7 +156,8 @@ MOE_AUX_WEIGHT = 0.01  # Switch-Transformer-style coefficient
 
 def make_moe_loss(aux_weight: float = MOE_AUX_WEIGHT,
                   zloss_weight: float = 0.0,
-                  label_smoothing: float = 0.0, ce_chunk: int = 0):
+                  label_smoothing: float = 0.0, ce_chunk: int = 0,
+                  ce_impl: str = "scan", mesh=None):
     """CLM objective + router losses from the "moe_aux" collection the
     MoeMlp layers sow (models/moe.py): load-balance (weighted by
     ``aux_weight``), router z-loss (``zloss_weight``), and the
@@ -168,7 +173,8 @@ def make_moe_loss(aux_weight: float = MOE_AUX_WEIGHT,
         if ce_chunk:
             loss, acc, mut = _fused_lm_metrics(
                 apply_fn, variables, batch, rngs, train, label_smoothing,
-                ce_chunk, mutable=["moe_aux"])
+                ce_chunk, mutable=["moe_aux"], ce_impl=ce_impl,
+                mesh=mesh)
         else:
             logits, mut = apply_fn(variables, batch["tokens"], train=train,
                                    rngs=rngs, mutable=["moe_aux"])
@@ -273,9 +279,11 @@ def _make_lm_task(cfg: TrainConfig, mesh: Mesh, objective: str,
     return Task(
         name=objective,
         loss=(make_moe_loss(cfg.moe_aux_weight, cfg.moe_zloss_weight,
-                            cfg.label_smoothing, ce_chunk=cfg.ce_chunk)
+                            cfg.label_smoothing, ce_chunk=cfg.ce_chunk,
+                            ce_impl=cfg.ce_impl, mesh=mesh)
               if moe else make_mlm_loss(cfg.label_smoothing,
-                                        ce_chunk=cfg.ce_chunk)),
+                                        ce_chunk=cfg.ce_chunk,
+                                        ce_impl=cfg.ce_impl, mesh=mesh)),
         # Eval drops the train-only smoothing but keeps the router
         # terms (they're part of the MoE objective being reported).
         # The fused head is a train-side memory/bandwidth choice; eval
