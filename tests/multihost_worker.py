@@ -92,6 +92,51 @@ def run_xaxes_scenarios(fetch):
             "expert_loss": expert_loss, "expert_checksum": expert_sum}
 
 
+def run_fusedce_scenario(fetch):
+    """Fused-CE (Pallas kernel formulation) with the token axes
+    spanning BOTH processes: the loss's shard_map runs the per-device
+    kernel on each process's (data, seq) shard and psums the CE /
+    correct / mask reductions across the process boundary. Shared
+    definition for workers and the single-process oracle (same
+    pattern as run_xaxes_scenarios)."""
+    import jax
+    import numpy as np
+    import optax
+
+    from tensorflow_distributed_tpu.config import MeshConfig
+    from tensorflow_distributed_tpu.data.lm import synthetic_clm
+    from tensorflow_distributed_tpu.models.transformer import gpt_lm
+    from tensorflow_distributed_tpu.parallel.mesh import make_mesh
+    from tensorflow_distributed_tpu.parallel.sharding import shard_batch
+    from tensorflow_distributed_tpu.train.state import create_train_state
+    from tensorflow_distributed_tpu.train.step import make_train_step
+    from tensorflow_distributed_tpu.train.tasks import (
+        make_mlm_loss, mlm_batch_shardings)
+
+    mesh = make_mesh(MeshConfig(data=4, seq=2))
+    model = gpt_lm(mesh, size="tiny", max_len=16, dropout_rate=0.0,
+                   compute_dtype=jax.numpy.float32)
+    step = make_train_step(
+        mesh, donate=False,
+        loss=make_mlm_loss(ce_chunk=48, ce_impl="kernel", mesh=mesh),
+        batch_shardings=mlm_batch_shardings(mesh))
+    # Init sample: batch dim must divide the data axis (ring
+    # attention's shard_map slices it).
+    state = create_train_state(model, optax.adam(1e-3),
+                               np.zeros((4, 16), np.int32), mesh)
+    ds = synthetic_clm(n=64, seq_len=16, vocab_size=64, seed=0)
+    for i in range(3):
+        state, m = step(state, shard_batch(
+            mesh, ds.batch(np.arange(16 * i, 16 * (i + 1))),
+            seq_axis=1))
+    checksum = float(sum(abs(x).sum()
+                         for x in jax.tree_util.tree_leaves(
+                             fetch(state.params))))
+    return {"fusedce_loss": float(jax.device_get(m["loss"])),
+            "fusedce_accuracy": float(jax.device_get(m["accuracy"])),
+            "fusedce_checksum": checksum}
+
+
 def main() -> None:
     out_path = sys.argv[1]
     import jax
@@ -115,6 +160,14 @@ def main() -> None:
         bootstrap()
         with open(out_path, "w") as f:
             json.dump(run_xaxes_scenarios(_fetch_host), f)
+        return
+    if phase == "fusedce":
+        from tensorflow_distributed_tpu.parallel.mesh import bootstrap
+        from tensorflow_distributed_tpu.train.checkpoint import _fetch_host
+
+        bootstrap()
+        with open(out_path, "w") as f:
+            json.dump(run_fusedce_scenario(_fetch_host), f)
         return
     if phase == "orbax":
         # Orbax checkpointing with FSDP params sharded ACROSS the

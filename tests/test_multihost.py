@@ -311,6 +311,32 @@ def test_pipeline_and_expert_axes_across_processes(tmp_path_factory):
                                    err_msg=key)
 
 
+def test_fused_ce_kernel_across_processes(tmp_path_factory):
+    """The fused-CE Pallas path with its loss reductions spanning the
+    process boundary: the dispatcher's shard_map psums ce/correct/mask
+    over (data, seq), and here those axes cross processes. Must match
+    the single-process oracle running THE SAME scenario definition."""
+    tmp = tmp_path_factory.mktemp("multihost_fusedce")
+    results, _ = _launch_cluster(tmp, tmp / "ckpt", "fusedce",
+                                 extra_env={"MH_PHASE": "fusedce"})
+    a, b = results
+    assert a == b  # SPMD: both processes computed identical results
+
+    import importlib.util
+
+    import jax
+
+    spec = importlib.util.spec_from_file_location(
+        "multihost_worker",
+        os.path.join(REPO, "tests", "multihost_worker.py"))
+    worker_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(worker_mod)
+    oracle = worker_mod.run_fusedce_scenario(jax.device_get)
+    for key, got in a.items():
+        np.testing.assert_allclose(got, oracle[key], rtol=1e-4,
+                                   err_msg=key)
+
+
 def test_parity_with_single_process(multihost_results):
     """2-process x 4-device == 1-process x 8-device, same config: the
     N-vs-1 equivalence of SURVEY.md §7 extended across process
