@@ -124,7 +124,7 @@ def _build(size: str, seq_len: int, use_flash: bool, remat: str,
     if pipeline_mb > 0:
         step = make_1f1b_train_step(
             model, mesh, seed, batch_shardings=mlm_batch_shardings(mesh),
-            backward=pipeline_backward)
+            backward=pipeline_backward, ce_chunk=ce_chunk)
     else:
         loss = (make_mlm_loss(ce_chunk=ce_chunk, ce_impl=ce_impl,
                               mesh=mesh) if ce_chunk else mlm_loss)
@@ -219,9 +219,10 @@ def main(argv=None) -> None:
     kind = jax.devices()[0].device_kind
     peak = PEAK_BF16_FLOPS.get(kind)
 
-    if args.ce_chunk and pmb > 0:
-        parser.error("--ce-chunk is not available in pipeline mode "
-                     "(the last stage owns the head inside the pipe)")
+    if args.ce_impl == "kernel" and pmb > 0:
+        parser.error("--ce-impl kernel is not available in pipeline "
+                     "mode (config.TrainConfig.validate has the why); "
+                     "--ce-chunk with the default scan impl composes")
     if args.ce_impl != "scan" and not args.ce_chunk:
         # Same rule as TrainConfig.validate: refuse knobs that would
         # be silently ignored (and mislabel the benchmark record).

@@ -119,8 +119,10 @@ class TrainConfig:
     # [B, L, V] logits (~825 MB bf16 at GPT-2-small train shapes) are
     # never materialized in forward or backward (ops/fused_ce.py).
     # 0 = dense path. Train-side only (eval keeps dense logits);
-    # incompatible with shard_vocab and pipelined_lm (the pipe's head
-    # lives stage-side). 8192 is a good first value at vocab 50257.
+    # incompatible with shard_vocab and mesh.model > 1. Composes with
+    # pipelined_lm: the 1F1B last stage runs the fused loss inside its
+    # scheduled head vjp (train/pipeline_step.py). 8192 is a good
+    # first value at vocab 50257.
     ce_chunk: int = 0
     # Fused-loss formulation when ce_chunk > 0: "scan" (lax.scan over
     # vocab chunks — all shapes, SPMD-transparent) or "kernel" (the
@@ -556,11 +558,13 @@ class TrainConfig:
                 f"ce_chunk has no effect on model={self.model!r} "
                 f"(the fused head+loss exists for the LM families' "
                 f"50k-row vocabs); drop the flag")
-        if self.ce_chunk and self.model == "pipelined_lm":
+        if (self.ce_impl == "kernel" and self.model == "pipelined_lm"):
             raise ValueError(
-                "ce_chunk is not available for pipelined_lm (the last "
-                "stage owns the head inside the pipe schedule; the "
-                "fused loss runs outside it)")
+                "ce_impl='kernel' is not available for pipelined_lm "
+                "(the 1F1B schedule drives the fused loss through its "
+                "own vjp at the last stage — the scan formulation "
+                "composes there; the Mosaic kernel's shard_map wrap "
+                "does not). Use the default ce_impl='scan'")
         if self.ce_chunk and self.shard_vocab:
             raise ValueError(
                 "ce_chunk does not compose with shard_vocab (the fused "

@@ -37,8 +37,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh
 
 from tensorflow_distributed_tpu.models.transformer import (
-    Block, TransformerConfig, _dense_init, _norm, resolve_remat_policy,
-    tiny_config)
+    Block, TransformerConfig, _dense_init, _LmHead, _norm,
+    resolve_remat_policy, tiny_config)
 from tensorflow_distributed_tpu.parallel.mesh import (
     AXIS_MODEL, AXIS_PIPE, AXIS_SEQ)
 from tensorflow_distributed_tpu.parallel.pipeline import (
@@ -102,11 +102,13 @@ class _Shell(nn.Module):
         if not cfg.tie_embeddings:
             # Tied: the head IS tok_emb (both live in this one shell
             # module, so tying is shell-local — same scheme as
-            # models/transformer.py's TransformerLM).
-            self.lm_head = nn.Dense(cfg.vocab_size,
-                                    kernel_init=_dense_init(),
-                                    dtype=cfg.compute_dtype,
-                                    name="lm_head")
+            # models/transformer.py's TransformerLM). _LmHead is the
+            # Dense-compatible head that can hand out its kernel/bias
+            # without computing logits (the fused-CE path).
+            self.lm_head = _LmHead(cfg.d_model, cfg.vocab_size,
+                                   _dense_init(),
+                                   cfg.compute_dtype,
+                                   name="lm_head")
 
     def embed(self, tokens: jax.Array) -> jax.Array:
         L = tokens.shape[1]
@@ -126,6 +128,18 @@ class _Shell(nn.Module):
             logits = jnp.einsum("...d,vd->...v", x, table)
             return logits[..., :cfg.vocab_size].astype(jnp.float32)
         return self.lm_head(x).astype(jnp.float32)
+
+    def head_pieces(self, x: jax.Array):
+        """(features, head matrix, bias, vocab axis) — the fused-CE
+        contract (same as TransformerLM's features_only mode): the
+        head matmul runs inside the loss, chunk by chunk, so the
+        [mb, L, V] logits never materialize at the last stage."""
+        cfg = self.cfg
+        x = self.ln_f(x).astype(cfg.compute_dtype)
+        if cfg.tie_embeddings:
+            return x, self.tok_emb.embedding[:cfg.vocab_size], None, 0
+        kernel, bias = self.lm_head(None)
+        return x, kernel, bias, 1
 
     def __call__(self, tokens: jax.Array) -> jax.Array:  # init path only
         return self.head(self.embed(tokens))
@@ -265,9 +279,13 @@ class PipelinedLM:
         return self._shell.apply({"params": shell_params}, x,
                                  method="head")
 
+    def head_pieces(self, shell_params: Any, x: jax.Array):
+        return self._shell.apply({"params": shell_params}, x,
+                                 method="head_pieces")
+
     def apply(self, variables: Any, tokens: jax.Array, *,
               train: bool = False, rngs: Optional[Any] = None,
-              mutable: Any = ()):
+              mutable: Any = (), features_only: bool = False):
         """Forward pass. ``mutable=["moe_aux"]`` (the flax collection
         surface train.tasks.make_moe_loss speaks) additionally returns
         the router losses collected THROUGH the pipeline schedule —
@@ -297,6 +315,7 @@ class PipelinedLM:
         stage_fn = self.make_stage_fn(train, use_dropout,
                                       with_aux=want_aux)
         rng = rngs["dropout"] if use_dropout else None
+        out = (self.head_pieces if features_only else self.head)
         if want_aux:
             x, aux_sums = pipeline_apply(
                 stage_fn, p["blocks"], x, self.mesh,
@@ -304,10 +323,10 @@ class PipelinedLM:
             denom = self.cfg.n_layers * self.num_microbatches
             mut = {"moe_aux": {"pipeline": {
                 k: (v / denom,) for k, v in aux_sums.items()}}}
-            return self.head(p["shell"], x), mut
+            return out(p["shell"], x), mut
         x = pipeline_apply(stage_fn, p["blocks"], x, self.mesh,
                            self.num_microbatches, rng=rng)
-        return self.head(p["shell"], x)
+        return out(p["shell"], x)
 
 
 def pipelined_lm(mesh: Mesh, size: str = "tiny", causal: bool = True,

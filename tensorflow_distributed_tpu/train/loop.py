@@ -225,7 +225,8 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
                                        grad_norm_metric=cfg.log_grad_norm,
                                        label_smoothing=cfg.label_smoothing,
                                        ema_decay=cfg.ema_decay,
-                                       backward=cfg.pipeline_backward)
+                                       backward=cfg.pipeline_backward,
+                                       ce_chunk=cfg.ce_chunk)
     elif local_sgd:
         from tensorflow_distributed_tpu.train.local_sgd import (
             make_local_sgd_train_step)

@@ -55,7 +55,8 @@ def make_1f1b_train_step(model: PipelinedLM, mesh: Mesh, seed: int = 0,
                          grad_norm_metric: bool = False,
                          label_smoothing: float = 0.0,
                          ema_decay: float = 0.0,
-                         backward: str = "recompute"
+                         backward: str = "recompute",
+                         ce_chunk: int = 0
                          ) -> Callable[[TrainState, Any],
                                        Tuple[TrainState, Dict]]:
     """Build the jitted 1F1B step for a PipelinedLM.
@@ -72,6 +73,12 @@ def make_1f1b_train_step(model: PipelinedLM, mesh: Mesh, seed: int = 0,
     (input stash + per-stage remat — minimal memory) or "stash"
     (residual stash, no forward recompute — the higher-MFU trade; see
     that function's docstring and PARITY.md for the chip numbers).
+
+    ``ce_chunk`` > 0 fuses the head into the per-microbatch loss
+    (ops/fused_ce.py, scan formulation): last_fn hands the schedule's
+    head vjp the chunked custom-VJP op instead of dense logits, so the
+    last stage never materializes [mb, L, V] — it composes because the
+    schedule already drives last_fn through an explicit jax.vjp.
     """
     if batch_shardings is None:
         batch_shardings = mlm_batch_shardings(mesh)
@@ -90,12 +97,24 @@ def make_1f1b_train_step(model: PipelinedLM, mesh: Mesh, seed: int = 0,
         stage_fn = model.make_stage_fn(train=True, with_rng=use_dropout,
                                        with_aux=moe)
 
-        def last_fn(sp, y_mb, aux_mb):
-            logits = model.head(sp, y_mb)
-            tgt, msk = aux_mb
-            ce_sum, correct, n = masked_ce_sums(logits, tgt, msk,
-                                                label_smoothing)
-            return ce_sum, {"correct": correct, "mask": n}
+        if ce_chunk:
+            from tensorflow_distributed_tpu.ops.fused_ce import (
+                fused_ce_sums)
+
+            def last_fn(sp, y_mb, aux_mb):
+                feats, w, bias, v_axis = model.head_pieces(sp, y_mb)
+                tgt, msk = aux_mb
+                ce_sum, correct, n = fused_ce_sums(
+                    feats, w, bias, tgt, msk, w.shape[v_axis], ce_chunk,
+                    label_smoothing, v_axis)
+                return ce_sum, {"correct": correct, "mask": n}
+        else:
+            def last_fn(sp, y_mb, aux_mb):
+                logits = model.head(sp, y_mb)
+                tgt, msk = aux_mb
+                ce_sum, correct, n = masked_ce_sums(logits, tgt, msk,
+                                                    label_smoothing)
+                return ce_sum, {"correct": correct, "mask": n}
 
         kw = dict(rng=dkey if use_dropout else None,
                   cotangent_scale=1.0 / total, backward=backward)

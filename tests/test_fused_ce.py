@@ -174,7 +174,8 @@ def test_config_rejects_bad_combinations():
         TrainConfig(model="gpt_lm", ce_chunk=8192,
                     shard_vocab=True).validate()
     with pytest.raises(ValueError, match="pipelined_lm"):
-        TrainConfig(model="pipelined_lm", ce_chunk=8192).validate()
+        TrainConfig(model="pipelined_lm", ce_chunk=8192,
+                    ce_impl="kernel").validate()
     with pytest.raises(ValueError, match="LM families"):
         TrainConfig(model="mnist_cnn", ce_chunk=8192).validate()
     from tensorflow_distributed_tpu.config import MeshConfig

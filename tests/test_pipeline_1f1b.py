@@ -65,6 +65,57 @@ def test_1f1b_matches_gpipe(devices8):
         st_g.params, st_f.params)
 
 
+@pytest.mark.parametrize("tie", [False, True])
+def test_1f1b_fused_ce_matches_dense_head(devices8, tie):
+    """ce_chunk > 0 swaps the last stage's dense head+loss for the
+    chunked custom-VJP op INSIDE the scheduled head vjp — a loss-
+    formulation change, not a math change: same batch + state must
+    reproduce the dense 1F1B step (loss, accuracy, updated params),
+    tied and untied heads both."""
+    mesh = make_mesh(MeshConfig(data=2, pipe=4), devices8)
+    model, state, batch = _setup(mesh, tie_embeddings=tie,
+                                 pos_emb="rope" if tie else "learned")
+    dense_step = make_1f1b_train_step(model, mesh, donate=False)
+    fused_step = make_1f1b_train_step(model, mesh, donate=False,
+                                      ce_chunk=24)
+    st_d, met_d = dense_step(state, batch)
+    st_f, met_f = fused_step(state, batch)
+    np.testing.assert_allclose(float(met_f["loss"]),
+                               float(met_d["loss"]), rtol=1e-6)
+    np.testing.assert_allclose(float(met_f["accuracy"]),
+                               float(met_d["accuracy"]), rtol=1e-6)
+    # Not bitwise: the fused op's streaming logsumexp reduces in a
+    # different order than the dense one; Adam amplifies the last-ulp
+    # grad differences on near-zero-grad params.
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=3e-5, rtol=1e-3),
+        st_d.params, st_f.params)
+
+
+def test_gpipe_fused_ce_matches_dense_head(devices8):
+    """The GPipe path reaches the fused loss through
+    PipelinedLM.apply(features_only=True) — make_mlm_loss(ce_chunk)
+    must reproduce the dense mlm_loss trajectory."""
+    from tensorflow_distributed_tpu.train.tasks import make_mlm_loss
+
+    mesh = make_mesh(MeshConfig(data=2, pipe=4), devices8)
+    model, state, batch = _setup(mesh)
+    dense = make_train_step(mesh, loss=mlm_loss, donate=False,
+                            batch_shardings=mlm_batch_shardings(mesh))
+    fused = make_train_step(mesh, loss=make_mlm_loss(ce_chunk=24),
+                            donate=False,
+                            batch_shardings=mlm_batch_shardings(mesh))
+    st_d, met_d = dense(state, batch)
+    st_f, met_f = fused(state, batch)
+    np.testing.assert_allclose(float(met_f["loss"]),
+                               float(met_d["loss"]), rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=3e-5, rtol=1e-3),
+        st_d.params, st_f.params)
+
+
 def test_variant_residual_mask_splits_weights_from_activations():
     """The stash backward's hoist: residual leaves that are a pure
     function of params (weight matrices, their compute-dtype casts)
