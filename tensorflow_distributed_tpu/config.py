@@ -118,11 +118,13 @@ class TrainConfig:
     # columns at a time with online-softmax statistics, so the full
     # [B, L, V] logits (~825 MB bf16 at GPT-2-small train shapes) are
     # never materialized in forward or backward (ops/fused_ce.py).
-    # 0 = dense path. Train-side only (eval keeps dense logits);
-    # incompatible with shard_vocab and mesh.model > 1. Composes with
-    # pipelined_lm: the 1F1B last stage runs the fused loss inside its
-    # scheduled head vjp (train/pipeline_step.py). 8192 is a good
-    # first value at vocab 50257.
+    # 0 = dense path. Train-side only (eval keeps dense logits).
+    # Composes with pipelined_lm (the 1F1B last stage runs the fused
+    # loss inside its scheduled head vjp, train/pipeline_step.py) and
+    # with tensor parallelism / shard_vocab (at mesh.model > 1 the
+    # scan impl switches to the Megatron vocab-parallel form: each TP
+    # rank scans its own head shard, stats combine with pmax/psum).
+    # 8192 is a good first value at vocab 50257.
     ce_chunk: int = 0
     # Fused-loss formulation when ce_chunk > 0: "scan" (lax.scan over
     # vocab chunks — all shapes, SPMD-transparent) or "kernel" (the
@@ -565,12 +567,12 @@ class TrainConfig:
                 "own vjp at the last stage — the scan formulation "
                 "composes there; the Mosaic kernel's shard_map wrap "
                 "does not). Use the default ce_impl='scan'")
-        if self.ce_chunk and self.shard_vocab:
+        if self.ce_impl == "kernel" and self.shard_vocab:
             raise ValueError(
-                "ce_chunk does not compose with shard_vocab (the fused "
-                "loss slices vocab chunks in its own scan; a model-"
-                "sharded vocab dim would all-gather per chunk — pick "
-                "one)")
+                "ce_impl='kernel' does not compose with shard_vocab "
+                "(the Mosaic kernel wants the whole head per device); "
+                "the default ce_impl='scan' runs the vocab-parallel "
+                "form instead")
         if self.ce_impl not in ("scan", "kernel"):
             raise ValueError(
                 f"unknown ce_impl {self.ce_impl!r}; have "
@@ -579,12 +581,12 @@ class TrainConfig:
             raise ValueError(
                 "ce_impl has no effect without ce_chunk > 0 (the fused "
                 "head+loss master switch); add --ce-chunk")
-        if self.ce_chunk and self.mesh.model > 1:
+        if self.ce_impl == "kernel" and self.mesh.model > 1:
             raise ValueError(
-                "ce_chunk requires mesh.model == 1: the lm_head "
-                "kernel's vocab dim is TP-sharded under tensor "
-                "parallelism, so the fused loss's chunk slices would "
-                "all-gather the head every scan step")
+                "ce_impl='kernel' requires mesh.model == 1 (the "
+                "Mosaic kernel wants the whole head per device); the "
+                "default ce_impl='scan' runs the Megatron vocab-"
+                "parallel form over the model axis instead")
         if self.seq_len < 0 or self.seq_len == 1:
             raise ValueError(
                 f"seq_len must be 0 (family default) or >= 2, "

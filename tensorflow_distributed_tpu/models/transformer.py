@@ -494,22 +494,6 @@ class TransformerLM(nn.Module):
                  positions: Optional[jax.Array] = None,
                  features_only: bool = False):
         cfg = self.cfg
-        if features_only and cfg.shard_vocab:
-            # The fused loss slices vocab chunks in its own scan; a
-            # model-sharded vocab dim would all-gather per chunk.
-            raise ValueError("features_only (fused CE) does not compose "
-                             "with shard_vocab")
-        if (features_only and self.mesh is not None
-                and dict(self.mesh.shape).get(AXIS_MODEL, 1) > 1):
-            # Same per-chunk gather problem by another route: the untied
-            # head kernel's vocab dim carries TP metadata whenever
-            # tp_partitioning is on, so at mesh.model > 1 the chunk
-            # slices would cross shard boundaries. config.validate
-            # mirrors this for the CLI.
-            raise ValueError("features_only (fused CE) requires "
-                             "mesh.model == 1 (the head's vocab dim is "
-                             "TP-sharded; chunk slices would gather it "
-                             "per step)")
         if cfg.pos_emb not in ("learned", "rope"):
             raise ValueError(f"pos_emb {cfg.pos_emb!r}; "
                              f"have ('learned', 'rope')")
@@ -570,14 +554,21 @@ class TransformerLM(nn.Module):
         if features_only:
             # Hand the loss the pieces of the head instead of its
             # product: (features, head matrix, bias, vocab axis of the
-            # matrix) — ops.fused_ce consumes them chunk by chunk.
+            # matrix) — ops.fused_ce consumes them chunk by chunk
+            # (single-rank scan, Pallas kernel, or at mesh.model > 1
+            # the vocab-parallel form — padding rows are sliced off
+            # here and re-derived where the TP dispatch needs them).
             xc = x.astype(cfg.compute_dtype)
             if cfg.tie_embeddings:
                 return xc, emb.embedding[:cfg.vocab_size], None, 0
-            head = _LmHead(cfg.d_model, cfg.vocab_size,
+            head_pad = ((-cfg.vocab_size) % tp if cfg.shard_vocab else 0)
+            head = _LmHead(cfg.d_model, cfg.vocab_size + head_pad,
                            _maybe_partitioned(cfg, (None, AXIS_MODEL)),
                            cfg.compute_dtype, name="lm_head")
             kernel, bias = head(None)
+            if head_pad:
+                kernel, bias = (kernel[:, :cfg.vocab_size],
+                                bias[:cfg.vocab_size])
             return xc, kernel, bias, 1
         if cfg.tie_embeddings:
             # Cast the shared table to compute dtype so the logits

@@ -23,6 +23,17 @@ pass through untouched, so under pjit every device simply runs the same
 chunk loop on its own activation shard; no resharding, no collectives
 beyond the loss reductions that were already there.
 
+**Vocab-parallel form** (``mesh.model > 1``): the Megatron
+vocab-parallel cross-entropy, SPMD-native. Each TP rank scans only its
+own vocab shard of the head matrix and keeps PARTIAL per-token stats;
+the global softmax statistics come from one ``pmax`` (running max) and
+three ``psum``s (normalizer, gold logit, smoothing sum) over the
+``model`` axis, plus a ``pmin`` tie-break for the first-max argmax.
+The hand-written backward recomputes each rank's chunk logits against
+the GLOBAL logsumexp and psums the feature gradient; head-shard grads
+stay local. This is what lets the fused loss compose with tensor
+parallelism and the Megatron vocab-sharded embedding (shard_vocab).
+
 Semantics match ``ops.losses.masked_ce_sums`` exactly (unnormalized
 (ce_sum, correct, mask_sum) pieces, f32 statistics, label smoothing as
 the (1-eps)/eps-uniform target mixture); parity — values and gradients
@@ -38,28 +49,34 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+INT_BIG = 2 ** 30
 
-def _pad_vocab(w: jax.Array, bias: Optional[jax.Array], vocab_size: int,
+
+def _pad_vocab(w: jax.Array, bias: Optional[jax.Array], rows: int,
                chunk: int, w_vocab_axis: int):
-    """Zero-pad the vocab dim up to a chunk multiple so every scan step
-    slices a full, non-clamped chunk (dynamic_slice clamps out-of-range
-    starts, which would silently alias the last rows)."""
-    pad = (-vocab_size) % chunk
+    """Zero-pad the vocab dim from ``rows`` up to a chunk multiple so
+    every scan step slices a full, non-clamped chunk (dynamic_slice
+    clamps out-of-range starts, which would silently alias the last
+    rows)."""
+    pad = (-rows) % chunk
     if pad:
         widths = [(0, 0)] * w.ndim
         widths[w_vocab_axis] = (0, pad)
         w = jnp.pad(w, widths)
         if bias is not None:
             bias = jnp.pad(bias, (0, pad))
-    return w, bias, vocab_size + pad
+    return w, bias, rows + pad
 
 
 def _chunk_logits(x: jax.Array, w: jax.Array, bias: Optional[jax.Array],
-                  c0: jax.Array, chunk: int, vocab_size: int,
+                  c0: jax.Array, chunk: int, local_rows,
+                  col_offset, vocab_size: int,
                   w_vocab_axis: int) -> Tuple[jax.Array, jax.Array]:
-    """Logits for vocab columns [c0, c0+chunk) in f32, with columns past
-    the real vocab masked to -inf. Returns (logits [..., chunk],
-    valid [chunk] bool)."""
+    """Logits for LOCAL vocab columns [c0, c0+chunk) in f32. A column
+    is valid iff it is a real row of this shard (< local_rows — per-
+    rank chunk padding is not) AND its GLOBAL id (col_offset + local
+    id) is a real vocab entry. Invalid columns read -inf. Returns
+    (logits [..., chunk], valid [chunk] bool)."""
     wc = jax.lax.dynamic_slice_in_dim(w, c0, chunk, axis=w_vocab_axis)
     wc = wc.astype(x.dtype)
     eq = "...d,cd->...c" if w_vocab_axis == 0 else "...d,dc->...c"
@@ -68,10 +85,140 @@ def _chunk_logits(x: jax.Array, w: jax.Array, bias: Optional[jax.Array],
     if bias is not None:
         bc = jax.lax.dynamic_slice_in_dim(bias, c0, chunk, axis=0)
         logits = logits + bc.astype(jnp.float32)
-    valid = (c0 + jnp.arange(chunk)) < vocab_size
+    local_col = c0 + jnp.arange(chunk)
+    valid = jnp.logical_and(local_col < local_rows,
+                            col_offset + local_col < vocab_size)
     logits = jnp.where(valid, logits, -jnp.inf)
     return logits, valid
 
+
+def _scan_stats(x, wp, bp, targets, n_chunks, chunk, local_rows,
+                col_offset, vocab_size, label_smoothing, w_vocab_axis):
+    """The forward chunk scan: per-token partial stats over this head
+    (shard). Returns (m, l, gold, lsum, best_v, best_i) — best_i in
+    GLOBAL vocab ids (-1 where this shard saw nothing). The caller
+    finishes locally (single rank) or combines across the model axis
+    (vocab-parallel)."""
+    bshape = targets.shape
+    targets = targets.astype(jnp.int32)
+
+    def body(carry, c_idx):
+        m, l, gold, lsum, best_v, best_i = carry
+        c0 = c_idx * chunk
+        logits, valid = _chunk_logits(x, wp, bp, c0, chunk, local_rows,
+                                      col_offset, vocab_size,
+                                      w_vocab_axis)
+        # Online logsumexp (the flash recurrence over vocab columns).
+        cmax = jnp.max(logits, axis=-1)
+        new_m = jnp.maximum(m, cmax)
+        l = l * jnp.exp(m - new_m) + jnp.sum(
+            jnp.exp(logits - new_m[..., None]), axis=-1)
+        # Gold logit: at most one (rank, chunk) contains each target.
+        # The local-row bound matters in the vocab-parallel form: a
+        # target owned by the NEXT rank falls in [local_rows, chunk)
+        # here — chunk padding, whose logit reads -inf.
+        idx = targets - col_offset - c0
+        hit = (idx >= 0) & (idx < chunk) & (c0 + idx < local_rows)
+        g = jnp.take_along_axis(
+            logits, jnp.clip(idx, 0, chunk - 1)[..., None], axis=-1)[..., 0]
+        gold = gold + jnp.where(hit, g, 0.0)
+        # Smoothing needs sum(logits) over the REAL vocab only.
+        if label_smoothing:
+            lsum = lsum + jnp.sum(jnp.where(valid, logits, 0.0), axis=-1)
+        # Running argmax: strict > keeps the first max, matching
+        # jnp.argmax over the full row.
+        cidx = (jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                + col_offset + c0)
+        take = cmax > best_v
+        best_v = jnp.where(take, cmax, best_v)
+        best_i = jnp.where(take, cidx, best_i)
+        return (new_m, l, gold, lsum, best_v, best_i), None
+
+    init = (jnp.full(bshape, -jnp.inf, jnp.float32),
+            jnp.zeros(bshape, jnp.float32),
+            jnp.zeros(bshape, jnp.float32),
+            jnp.zeros(bshape, jnp.float32),
+            jnp.full(bshape, -jnp.inf, jnp.float32),
+            jnp.full(bshape, -1, jnp.int32))
+    (m, l, gold, lsum, best_v, best_i), _ = jax.lax.scan(
+        body, init, jnp.arange(n_chunks))
+    return m, l, gold, lsum, best_v, best_i
+
+
+def _finish(lse, gold, lsum, best_i, targets, mask, vocab_size,
+            label_smoothing):
+    """(ce_sum, correct, mask_sum) from finished global stats."""
+    if label_smoothing:
+        gold = ((1.0 - label_smoothing) * gold
+                + (label_smoothing / vocab_size) * lsum)
+    fmask = mask.astype(jnp.float32)
+    ce_sum = jnp.sum((lse - gold) * fmask)
+    correct = jnp.sum(
+        (best_i == targets.astype(jnp.int32)).astype(jnp.float32) * fmask)
+    return ce_sum, correct, jnp.sum(fmask)
+
+
+def _bwd_scan(x, wp, bp, targets, lse, coef, n_chunks, chunk,
+              local_rows, col_offset, vocab_size, label_smoothing,
+              w_vocab_axis):
+    """The backward chunk scan over this head (shard): recompute each
+    chunk's logits against the GLOBAL lse, form its softmax-minus-
+    smoothed-onehot slice scaled by ``coef`` (mask * upstream), and
+    accumulate (dx_local, dw_chunks, db_chunks). In the vocab-parallel
+    form dx_local is this rank's partial (psum outside)."""
+    targets = targets.astype(jnp.int32)
+    scale = coef[..., None]
+    batch_axes = tuple(range(x.ndim - 1))
+
+    def body(dx, c_idx):
+        c0 = c_idx * chunk
+        logits, valid = _chunk_logits(x, wp, bp, c0, chunk, local_rows,
+                                      col_offset, vocab_size,
+                                      w_vocab_axis)
+        p = jnp.exp(logits - lse[..., None])  # -inf columns -> exactly 0
+        idx = targets - col_offset - c0
+        hit = ((idx >= 0) & (idx < chunk)
+               & (c0 + idx < local_rows))[..., None]
+        onehot = hit & (jnp.arange(chunk) == jnp.clip(idx, 0, chunk - 1)
+                        [..., None])
+        dlogits = p - (1.0 - label_smoothing) * onehot
+        if label_smoothing:
+            dlogits = dlogits - (label_smoothing / vocab_size) * valid
+        dlogits = (dlogits * scale).astype(x.dtype)
+        wc = jax.lax.dynamic_slice_in_dim(
+            wp, c0, chunk, axis=w_vocab_axis).astype(x.dtype)
+        if w_vocab_axis == 0:
+            dx = dx + jnp.einsum("...c,cd->...d", dlogits, wc,
+                                 preferred_element_type=jnp.float32)
+            dwc = jnp.einsum("...c,...d->cd", dlogits, x,
+                             preferred_element_type=jnp.float32)
+        else:
+            dx = dx + jnp.einsum("...c,dc->...d", dlogits, wc,
+                                 preferred_element_type=jnp.float32)
+            dwc = jnp.einsum("...d,...c->dc", x, dlogits,
+                             preferred_element_type=jnp.float32)
+        dbc = jnp.sum(dlogits.astype(jnp.float32), axis=batch_axes)
+        return dx, (dwc, dbc)
+
+    dx0 = jnp.zeros(x.shape, jnp.float32)
+    return jax.lax.scan(body, dx0, jnp.arange(n_chunks))
+
+
+def _reassemble_dw(dw_chunks, db_chunks, rows, padded_rows, D,
+                   w_vocab_axis, w_dtype, bias):
+    """Stacked per-chunk head grads -> [rows]-sliced dw (+ db)."""
+    if w_vocab_axis == 0:
+        dw = dw_chunks.reshape(padded_rows, -1)[:rows]
+    else:
+        dw = jnp.moveaxis(dw_chunks, 0, 1).reshape(
+            D, padded_rows)[:, :rows]
+    db = (db_chunks.reshape(padded_rows)[:rows].astype(
+        bias.dtype if bias is not None else jnp.float32)
+        if bias is not None else None)
+    return dw.astype(w_dtype), db
+
+
+# -------------------------------------------------- single-rank op
 
 @partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
 def fused_ce_sums(x: jax.Array, w: jax.Array, bias: Optional[jax.Array],
@@ -98,54 +245,12 @@ def fused_ce_sums(x: jax.Array, w: jax.Array, bias: Optional[jax.Array],
 def _fwd_pass(x, w, bias, targets, mask, vocab_size, chunk,
               label_smoothing, w_vocab_axis):
     wp, bp, vpad = _pad_vocab(w, bias, vocab_size, chunk, w_vocab_axis)
-    n_chunks = vpad // chunk
-    bshape = targets.shape
-    targets = targets.astype(jnp.int32)
-
-    def body(carry, c_idx):
-        m, l, gold, lsum, best_v, best_i = carry
-        c0 = c_idx * chunk
-        logits, valid = _chunk_logits(x, wp, bp, c0, chunk, vocab_size,
-                                      w_vocab_axis)
-        # Online logsumexp (the flash recurrence over vocab columns).
-        cmax = jnp.max(logits, axis=-1)
-        new_m = jnp.maximum(m, cmax)
-        l = l * jnp.exp(m - new_m) + jnp.sum(
-            jnp.exp(logits - new_m[..., None]), axis=-1)
-        # Gold logit: at most one chunk contains each target.
-        idx = targets - c0
-        hit = (idx >= 0) & (idx < chunk)
-        g = jnp.take_along_axis(
-            logits, jnp.clip(idx, 0, chunk - 1)[..., None], axis=-1)[..., 0]
-        gold = gold + jnp.where(hit, g, 0.0)
-        # Smoothing needs sum(logits) over the REAL vocab only.
-        if label_smoothing:
-            lsum = lsum + jnp.sum(jnp.where(valid, logits, 0.0), axis=-1)
-        # Running argmax: strict > keeps the first max, matching
-        # jnp.argmax over the full row.
-        cidx = jnp.argmax(logits, axis=-1).astype(jnp.int32) + c0
-        take = cmax > best_v
-        best_v = jnp.where(take, cmax, best_v)
-        best_i = jnp.where(take, cidx, best_i)
-        return (new_m, l, gold, lsum, best_v, best_i), None
-
-    init = (jnp.full(bshape, -jnp.inf, jnp.float32),
-            jnp.zeros(bshape, jnp.float32),
-            jnp.zeros(bshape, jnp.float32),
-            jnp.zeros(bshape, jnp.float32),
-            jnp.full(bshape, -jnp.inf, jnp.float32),
-            jnp.full(bshape, -1, jnp.int32))
-    (m, l, gold, lsum, _, best_i), _ = jax.lax.scan(
-        body, init, jnp.arange(n_chunks))
-
+    m, l, gold, lsum, _, best_i = _scan_stats(
+        x, wp, bp, targets, vpad // chunk, chunk, vocab_size, 0,
+        vocab_size, label_smoothing, w_vocab_axis)
     lse = m + jnp.log(l)
-    if label_smoothing:
-        gold = ((1.0 - label_smoothing) * gold
-                + (label_smoothing / vocab_size) * lsum)
-    fmask = mask.astype(jnp.float32)
-    ce_sum = jnp.sum((lse - gold) * fmask)
-    correct = jnp.sum((best_i == targets).astype(jnp.float32) * fmask)
-    out = (ce_sum, correct, jnp.sum(fmask))
+    out = _finish(lse, gold, lsum, best_i, targets, mask, vocab_size,
+                  label_smoothing)
     return out, (x, w, bias, targets, mask, lse)
 
 
@@ -153,61 +258,129 @@ def _bwd_pass(vocab_size, chunk, label_smoothing, w_vocab_axis, res, g):
     x, w, bias, targets, mask, lse = res
     g_ce = g[0]  # correct/mask_sum are metrics: cotangents ignored
     wp, bp, vpad = _pad_vocab(w, bias, vocab_size, chunk, w_vocab_axis)
-    n_chunks = vpad // chunk
-    targets = targets.astype(jnp.int32)
-    # d ce_sum / d logits = mask * (softmax - smoothed_onehot), where
-    # smoothed_onehot = (1-eps)*onehot + (eps/V) on real columns.
-    scale = (mask.astype(jnp.float32) * g_ce)[..., None]
-    batch_axes = tuple(range(x.ndim - 1))
-
-    def body(dx, c_idx):
-        c0 = c_idx * chunk
-        logits, valid = _chunk_logits(x, wp, bp, c0, chunk, vocab_size,
-                                      w_vocab_axis)
-        p = jnp.exp(logits - lse[..., None])  # -inf columns -> exactly 0
-        idx = targets - c0
-        hit = ((idx >= 0) & (idx < chunk))[..., None]
-        onehot = hit & (jnp.arange(chunk) == jnp.clip(idx, 0, chunk - 1)
-                        [..., None])
-        dlogits = p - (1.0 - label_smoothing) * onehot
-        if label_smoothing:
-            dlogits = dlogits - (label_smoothing / vocab_size) * valid
-        dlogits = (dlogits * scale).astype(x.dtype)
-        wc = jax.lax.dynamic_slice_in_dim(
-            wp, c0, chunk, axis=w_vocab_axis).astype(x.dtype)
-        if w_vocab_axis == 0:
-            dx = dx + jnp.einsum("...c,cd->...d", dlogits, wc,
-                                 preferred_element_type=jnp.float32)
-            dwc = jnp.einsum("...c,...d->cd", dlogits, x,
-                             preferred_element_type=jnp.float32)
-        else:
-            dx = dx + jnp.einsum("...c,dc->...d", dlogits, wc,
-                                 preferred_element_type=jnp.float32)
-            dwc = jnp.einsum("...d,...c->dc", x, dlogits,
-                             preferred_element_type=jnp.float32)
-        dbc = jnp.sum(dlogits.astype(jnp.float32), axis=batch_axes)
-        return dx, (dwc, dbc)
-
-    dx0 = jnp.zeros(x.shape, jnp.float32)
-    dx, (dw_chunks, db_chunks) = jax.lax.scan(
-        body, dx0, jnp.arange(n_chunks))
-
-    # Reassemble the stacked per-chunk head grads and drop the padding.
-    if w_vocab_axis == 0:
-        dw = dw_chunks.reshape(vpad, -1)[:vocab_size]
-    else:
-        dw = jnp.moveaxis(dw_chunks, 0, 1).reshape(
-            x.shape[-1], vpad)[:, :vocab_size]
-    db = (db_chunks.reshape(vpad)[:vocab_size].astype(
-        bias.dtype if bias is not None else jnp.float32)
-        if bias is not None else None)
-    return (dx.astype(x.dtype), dw.astype(w.dtype), db,
+    coef = mask.astype(jnp.float32) * g_ce
+    dx, (dw_chunks, db_chunks) = _bwd_scan(
+        x, wp, bp, targets, lse, coef, vpad // chunk, chunk, vocab_size,
+        0, vocab_size, label_smoothing, w_vocab_axis)
+    dw, db = _reassemble_dw(dw_chunks, db_chunks, vocab_size, vpad,
+                            x.shape[-1], w_vocab_axis, w.dtype, bias)
+    return (dx.astype(x.dtype), dw, db,
             np.zeros(targets.shape, jax.dtypes.float0),
             jnp.zeros_like(mask))
 
 
 fused_ce_sums.defvjp(_fwd_pass, _bwd_pass)
 
+
+# ----------------------------------------------- vocab-parallel op
+
+@partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10))
+def _shard_ce_given_lse(x, w, bias, targets, mask, lse, off,
+                        vocab_size, chunk, label_smoothing,
+                        w_vocab_axis):
+    """This shard's CE contribution GIVEN the global logsumexp.
+
+    Value: -sum_t mask_t * smoothed_gold_shard(t) — the shard-
+    decomposable part of masked CE (the caller adds sum(mask * lse)
+    once and psums these over the model axis). Backward: the EXACT
+    gradient of the GLOBAL masked CE restricted to this shard's
+    columns — d ce/d logits = mask * (softmax - smoothed_onehot) is a
+    total derivative through the lse path, so a stop-gradient lse
+    VALUE is all it needs.
+
+    Deliberately pure-local: no collectives inside the custom-VJP
+    boundary. shard_map splits a replicated output's cotangent across
+    devices expecting the body's OWN collectives' transposes to
+    restore it — a convention hand-written backwards must not depend
+    on. Here every collective (the lse combine, the psum of these
+    values, the dx reassembly) lives in plain differentiable code
+    whose AD is exact.
+    """
+    rows = w.shape[w_vocab_axis]
+    wp, bp, rpad = _pad_vocab(w, bias, rows, chunk, w_vocab_axis)
+    _, _, gold, lsum, _, _ = _scan_stats(
+        x, wp, bp, targets, rpad // chunk, chunk, rows, off,
+        vocab_size, label_smoothing, w_vocab_axis)
+    if label_smoothing:
+        gold = ((1.0 - label_smoothing) * gold
+                + (label_smoothing / vocab_size) * lsum)
+    return -jnp.sum(gold * mask.astype(jnp.float32))
+
+
+def _shard_ce_fwd(x, w, bias, targets, mask, lse, off, vocab_size,
+                  chunk, label_smoothing, w_vocab_axis):
+    out = _shard_ce_given_lse(x, w, bias, targets, mask, lse, off,
+                              vocab_size, chunk, label_smoothing,
+                              w_vocab_axis)
+    return out, (x, w, bias, targets, mask, lse, off)
+
+
+def _shard_ce_bwd(vocab_size, chunk, label_smoothing, w_vocab_axis,
+                  res, g_ce):
+    x, w, bias, targets, mask, lse, off = res
+    rows = w.shape[w_vocab_axis]
+    wp, bp, rpad = _pad_vocab(w, bias, rows, chunk, w_vocab_axis)
+    coef = mask.astype(jnp.float32) * g_ce
+    dx, (dw_chunks, db_chunks) = _bwd_scan(
+        x, wp, bp, targets, lse, coef, rpad // chunk, chunk, rows, off,
+        vocab_size, label_smoothing, w_vocab_axis)
+    dw, db = _reassemble_dw(dw_chunks, db_chunks, rows, rpad,
+                            x.shape[-1], w_vocab_axis, w.dtype, bias)
+    # dx is this shard's columns' contribution; x arrives replicated
+    # over the model axis, so shard_map's input transpose psums the
+    # rank contributions — exactly the reassembly the math wants.
+    return (dx.astype(x.dtype), dw, db,
+            np.zeros(targets.shape, jax.dtypes.float0),
+            jnp.zeros_like(mask), jnp.zeros_like(lse),
+            np.zeros(np.shape(off), jax.dtypes.float0))
+
+
+_shard_ce_given_lse.defvjp(_shard_ce_fwd, _shard_ce_bwd)
+
+
+def vocab_parallel_ce_sums(x, w, bias, targets, mask, vocab_size,
+                           chunk, label_smoothing, w_vocab_axis,
+                           model_axis):
+    """The Megatron vocab-parallel fused CE — call INSIDE a shard_map
+    where ``model_axis`` is manual and ``w``/``bias`` are this rank's
+    vocab shard (every rank the same row count; rank r owns global ids
+    [r*rows, (r+1)*rows)). Returns (ce_sum, correct, mask_sum) over
+    the tokens this rank holds, replicated across the model axis
+    (callers psum over the token axes)."""
+    rows = w.shape[w_vocab_axis]
+    off = jax.lax.axis_index(model_axis) * rows
+    sg = jax.lax.stop_gradient
+    # Global softmax stats from partial scans, in PLAIN code (see
+    # _shard_ce_given_lse for why): stop-gradient inputs so AD never
+    # tries to save this scan's chunk intermediates.
+    wp, bp, rpad = _pad_vocab(sg(w), sg(bias), rows, chunk,
+                              w_vocab_axis)
+    m, l, _, _, best_v, best_i = _scan_stats(
+        sg(x), wp, bp, targets, rpad // chunk, chunk, rows, off,
+        vocab_size, 0.0, w_vocab_axis)
+    M = jax.lax.pmax(m, model_axis)
+    lse = M + jnp.log(jax.lax.psum(l * jnp.exp(m - M), model_axis))
+    # First-max argmax across ranks: highest value wins; ties go to
+    # the SMALLEST global id (the dense argmax convention). Ranks
+    # that saw nothing hold -inf/-1 and lose the pmax.
+    bv_glob = jax.lax.pmax(best_v, model_axis)
+    cand = jnp.where((best_v == bv_glob) & (best_i >= 0), best_i,
+                     INT_BIG)
+    best_i = jax.lax.pmin(cand, model_axis)
+
+    fmask = mask.astype(jnp.float32)
+    ce_sum = (jax.lax.psum(
+        _shard_ce_given_lse(x, w, bias, targets, mask, lse, off,
+                            vocab_size, chunk, label_smoothing,
+                            w_vocab_axis), model_axis)
+        + jnp.sum(lse * fmask))
+    correct = jnp.sum(
+        (best_i == targets.astype(jnp.int32)).astype(jnp.float32)
+        * fmask)
+    return ce_sum, correct, jnp.sum(fmask)
+
+
+# ------------------------------------------------------- dispatcher
 
 def fused_masked_cross_entropy(x: jax.Array, w: jax.Array,
                                bias: Optional[jax.Array],
@@ -221,25 +394,76 @@ def fused_masked_cross_entropy(x: jax.Array, w: jax.Array,
     holds features instead of logits. Returns (loss, accuracy).
 
     ``impl``: "scan" (this module's lax.scan formulation — all shapes,
-    SPMD-transparent) or "kernel" (the Pallas flash-CE triple,
-    ops/fused_ce_kernel.py — logits blocks live only in VMEM). The
-    kernel has no GSPMD partitioning rule, so on a multi-device
-    ``mesh`` it runs inside a shard_map over the batch/seq axes with
-    the loss reductions psummed — the same wrap the flash-attention
-    dispatcher uses (ops/flash_attention.py::attention).
-    """
+    SPMD-transparent, and at mesh.model > 1 the vocab-parallel form
+    with the head sharded over the model axis) or "kernel" (the Pallas
+    flash-CE triple, ops/fused_ce_kernel.py — logits blocks live only
+    in VMEM; single model rank only). Neither kernel nor scan needs a
+    wrap at mesh.model == 1 — XLA partitions the scan transparently;
+    the vocab-parallel and kernel paths run inside a shard_map (the
+    Mosaic kernel because it has no GSPMD rule, the vocab-parallel
+    form because its pmax/psum combine is written against manual
+    axes)."""
     if impl == "kernel":
         ce_sum, correct, n = _kernel_sums(
             x, w, bias, targets, mask, vocab_size, label_smoothing,
             w_vocab_axis, mesh)
     elif impl == "scan":
-        ce_sum, correct, n = fused_ce_sums(
-            x, w, bias, targets, mask, vocab_size, chunk,
-            label_smoothing, w_vocab_axis)
+        from tensorflow_distributed_tpu.parallel.mesh import AXIS_MODEL
+        if mesh is not None and mesh.shape[AXIS_MODEL] > 1:
+            ce_sum, correct, n = _tp_dispatch(
+                x, w, bias, targets, mask, vocab_size, chunk,
+                label_smoothing, w_vocab_axis, mesh)
+        else:
+            ce_sum, correct, n = fused_ce_sums(
+                x, w, bias, targets, mask, vocab_size, chunk,
+                label_smoothing, w_vocab_axis)
     else:
         raise ValueError(f"impl {impl!r}; have ('scan', 'kernel')")
     n = jnp.maximum(n, 1.0)
     return ce_sum / n, correct / n
+
+
+def _tp_dispatch(x, w, bias, targets, mask, vocab_size, chunk,
+                 label_smoothing, w_vocab_axis, mesh):
+    """shard_map wrap for the vocab-parallel form: head rows split
+    over ``model``, tokens over (data, seq), loss pieces psummed to
+    replicated scalars."""
+    from jax.sharding import PartitionSpec as P
+
+    from tensorflow_distributed_tpu.parallel.mesh import (
+        AXIS_DATA, AXIS_MODEL, AXIS_SEQ)
+
+    mp = mesh.shape[AXIS_MODEL]
+    # Pad the head so every rank holds the same row count. Rows past
+    # the real vocab are masked inside the op; their grads are zero
+    # and sliced off here.
+    w_full, b_full, vp = _pad_vocab(w, bias, vocab_size, mp,
+                                    w_vocab_axis)
+    if b_full is None:
+        # Zero bias: None can't carry a partition spec; its grad lands
+        # on this temporary and is discarded.
+        b_full = jnp.zeros((vp,), jnp.float32)
+
+    w_spec = (P(AXIS_MODEL, None) if w_vocab_axis == 0
+              else P(None, AXIS_MODEL))
+    tok = P(AXIS_DATA, AXIS_SEQ)
+
+    def local(x, w, bias, targets, mask):
+        ce, corr, n = vocab_parallel_ce_sums(
+            x, w, bias, targets, mask, vocab_size, chunk,
+            label_smoothing, w_vocab_axis, AXIS_MODEL)
+        # Tokens shard over (data, seq); model ranks end replicated
+        # (the op's combine), other axes hold replicas.
+        return tuple(jax.lax.psum(v, (AXIS_DATA, AXIS_SEQ))
+                     for v in (ce, corr, n))
+
+    out = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(AXIS_DATA, AXIS_SEQ, None), w_spec, P(AXIS_MODEL),
+                  tok, tok),
+        out_specs=(P(), P(), P()), check_vma=False)(
+        x, w_full, b_full, targets, mask)
+    return out
 
 
 def _kernel_sums(x, w, bias, targets, mask, vocab_size, label_smoothing,
