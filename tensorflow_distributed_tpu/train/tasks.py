@@ -285,11 +285,17 @@ def _make_lm_task(cfg: TrainConfig, mesh: Mesh, objective: str,
                                         ce_chunk=cfg.ce_chunk,
                                         ce_impl=cfg.ce_impl, mesh=mesh)),
         # Eval drops the train-only smoothing but keeps the router
-        # terms (they're part of the MoE objective being reported).
-        # The fused head is a train-side memory/bandwidth choice; eval
-        # keeps the dense path (it wants logits-level metrics anyway).
-        eval_loss=(make_moe_loss(cfg.moe_aux_weight, cfg.moe_zloss_weight)
-                   if moe else mlm_loss),
+        # terms (they're part of the MoE objective being reported) —
+        # and keeps the fused head: if ce_chunk is what makes the
+        # train shapes fit, the dense eval logits would OOM at the
+        # same shapes (metrics parity is pinned in tests). Always the
+        # scan formulation: the eval batch is clamped from the val
+        # split, so its per-device token count can fail the Pallas
+        # kernel's shape gate that the train shapes pass.
+        eval_loss=(make_moe_loss(cfg.moe_aux_weight, cfg.moe_zloss_weight,
+                                 ce_chunk=cfg.ce_chunk, mesh=mesh)
+                   if moe else make_mlm_loss(ce_chunk=cfg.ce_chunk,
+                                             mesh=mesh)),
         batch_shardings=mlm_batch_shardings(mesh),
         # Init executes the forward; ring attention's shard_map needs
         # the sample batch divisible by the data axis.
