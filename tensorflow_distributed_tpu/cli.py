@@ -25,7 +25,8 @@ from typing import Optional, Sequence
 
 from tensorflow_distributed_tpu.config import parse_args
 from tensorflow_distributed_tpu.parallel.mesh import is_chief
-from tensorflow_distributed_tpu.train.loop import evaluate_only, train
+from tensorflow_distributed_tpu.train.loop import (
+    evaluate_only, generate_only, train)
 from tensorflow_distributed_tpu.utils.compilecache import (
     enable_persistent_cache)
 
@@ -35,6 +36,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     cfg = parse_args(argv)
     if cfg.mode == "eval":
         evaluate_only(cfg)
+        return 0
+    if cfg.mode == "generate":
+        generate_only(cfg)
         return 0
     result = train(cfg)
     if is_chief():

@@ -328,8 +328,25 @@ class TrainConfig:
     # "eval": restore the latest checkpoint from checkpoint_dir and run
     # only the validation pass (train.loop.evaluate_only) — the
     # reference's validation loop without its mandatory training
-    # prelude. "train" (default) is the full loop.
-    mode: str = "train"  # train | eval
+    # prelude; "generate" restores a checkpoint and continues a prompt
+    # (causal LM families; train/loop.py::generate_only). "train"
+    # (default) is the full loop.
+    mode: str = "train"  # train | eval | generate
+
+    # --- mode=generate ---------------------------------------------------
+    # The prompt: for dataset=text, a string run through the SAME
+    # tokenizer as training (data/lm.py::text_codec); otherwise
+    # comma-separated token ids (synthetic-stream models have no
+    # text vocabulary).
+    prompt: str = ""
+    max_new_tokens: int = 64
+    # 0 = greedy; > 0 samples (optionally truncated by gen_top_k /
+    # nucleus gen_top_p — models/generate.py).
+    gen_temperature: float = 0.0
+    gen_top_k: int = 0
+    gen_top_p: float = 1.0
+    # > 1: beam search (deterministic; excludes gen_temperature > 0).
+    num_beams: int = 1
 
     def validate(self) -> None:
         if self.batch_size < 1:
@@ -528,8 +545,41 @@ class TrainConfig:
                 f"grad_accum_steps {self.grad_accum_steps}")
         if self.resume and not self.checkpoint_dir:
             raise ValueError("resume=True requires checkpoint_dir")
-        if self.mode not in ("train", "eval"):
+        if self.mode not in ("train", "eval", "generate"):
             raise ValueError(f"unknown mode {self.mode!r}")
+        if self.mode == "generate":
+            if self.model not in ("gpt_lm", "moe_lm"):
+                raise ValueError(
+                    f"mode=generate needs a causal LM with the decode "
+                    f"cache (gpt_lm or moe_lm), got {self.model!r}")
+            if not self.checkpoint_dir:
+                raise ValueError("mode=generate requires checkpoint_dir")
+            if not self.prompt:
+                raise ValueError(
+                    "mode=generate requires --prompt (text for "
+                    "dataset=text, else comma-separated token ids)")
+            if self.mesh.seq != 1:
+                raise ValueError(
+                    "mode=generate requires mesh.seq == 1 (single-"
+                    "token decode steps can't be seq-sharded)")
+            if self.num_beams > 1 and (
+                    self.gen_temperature > 0 or self.gen_top_k
+                    or self.gen_top_p != 1.0):
+                raise ValueError(
+                    "num_beams > 1 is deterministic beam search; it "
+                    "excludes the sampling knobs (gen_temperature / "
+                    "gen_top_k / gen_top_p) — pick one")
+        if self.gen_temperature < 0:
+            raise ValueError(
+                f"gen_temperature must be >= 0, got "
+                f"{self.gen_temperature} (negative would sample the "
+                f"inverted distribution)")
+        if self.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {self.max_new_tokens}")
+        if self.num_beams < 1:
+            raise ValueError(
+                f"num_beams must be >= 1, got {self.num_beams}")
         if self.pos_emb not in ("learned", "rope"):
             raise ValueError(f"unknown pos_emb {self.pos_emb!r}")
         if self.rope_theta <= 0:

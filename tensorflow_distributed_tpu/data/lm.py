@@ -150,6 +150,25 @@ def _encode_corpus(path: str, tok) -> np.ndarray:
     return np.frombuffer(ids.tobytes(), dtype=np.uint16).copy()
 
 
+def text_codec(path: str, tokenizer: str = "byte",
+               bpe_vocab_size: int = 8192):
+    """(encode: str -> list[int], decode: ids -> str, vocab_size)
+    applying the SAME tokenization text_clm applies to the corpus at
+    ``path`` — the generation-side counterpart (cli --mode generate
+    encodes the prompt and decodes the continuation with this)."""
+    if tokenizer == "byte":
+        return (lambda s: list(s.encode("utf-8")),
+                lambda ids: bytes(int(i) & 0xFF for i in ids).decode(
+                    "utf-8", errors="replace"),
+                256)
+    if tokenizer == "bpe":
+        tok = train_or_load_bpe(path, bpe_vocab_size)
+        return (lambda s: tok.encode(s).ids,
+                lambda ids: tok.decode([int(i) for i in ids]),
+                tok.get_vocab_size())
+    raise ValueError(f"tokenizer {tokenizer!r}; have ('byte', 'bpe')")
+
+
 def text_clm(path: str, seq_len: int = 128, seed: int = 0,
              val_fraction: float = 0.1, tokenizer: str = "byte",
              bpe_vocab_size: int = 8192) -> tuple:

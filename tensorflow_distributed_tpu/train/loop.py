@@ -188,6 +188,108 @@ def evaluate_only(cfg: TrainConfig,
     return metrics
 
 
+@dataclasses.dataclass
+class _GenTask:
+    """The two Task fields _build_model_and_state reads — enough to
+    size the model for mode=generate without building (and paying, and
+    being gated by) the full training data pipeline."""
+
+    vocab_size: int
+    sample_input: np.ndarray
+
+
+def generate_only(cfg: TrainConfig,
+                  logger: Optional[MetricLogger] = None) -> Dict:
+    """mode=generate: restore a checkpoint and continue a prompt.
+
+    The product surface over models/generate.py: greedy / sampled
+    (gen_temperature, gen_top_k, gen_top_p) or beam search (num_beams),
+    on the EMA weights when the checkpoint tracks them (the same
+    Polyak preference eval applies). For dataset=text the prompt is a
+    string run through the SAME tokenizer as training
+    (data/lm.py::text_codec) and the continuation is decoded back;
+    otherwise the prompt is comma-separated token ids. No reference
+    counterpart (the reference has no sequence models, SURVEY.md §5).
+    """
+    cfg.validate()
+    bootstrap()
+    logger = logger or MetricLogger(enabled=is_chief())
+    mesh = make_mesh(cfg.mesh)
+
+    # Tokenizer/vocab WITHOUT building the training task: make_task
+    # would re-encode and window the whole corpus (and reject one
+    # smaller than batch_size — a training-side check generation has
+    # no use for). The checkpoint pins the model shapes, so the vocab
+    # just has to match what training used.
+    dec = None
+    if cfg.dataset == "text":
+        from tensorflow_distributed_tpu.data.lm import text_codec
+        enc, dec, vocab = text_codec(cfg.data_dir, cfg.text_tokenizer,
+                                     cfg.bpe_vocab_size)
+        ids = enc(cfg.prompt)
+        if not ids:
+            raise ValueError(f"prompt {cfg.prompt!r} encoded to zero "
+                             f"tokens")
+    else:
+        vocab = cfg.synthetic_vocab or 64
+        try:
+            ids = [int(t) for t in cfg.prompt.split(",")]
+        except ValueError:
+            raise ValueError(
+                f"prompt {cfg.prompt!r} is not comma-separated token "
+                f"ids (string prompts need dataset=text, whose "
+                f"tokenizer defines a text vocabulary)") from None
+        bad = [t for t in ids if not 0 <= t < vocab]
+        if bad:
+            # The embedding gather would silently CLAMP these.
+            raise ValueError(
+                f"prompt ids {bad} outside the model vocabulary "
+                f"[0, {vocab})")
+
+    seq = cfg.seq_len or 128
+    shim = _GenTask(vocab_size=vocab, sample_input=np.zeros(
+        (max(2, dict(mesh.shape).get("data", 1)), seq), np.int32))
+    model, state = _build_model_and_state(cfg, mesh, shim)
+    if cfg.param_sync_every > 1:
+        state = ckpt.restore_averaged(cfg.checkpoint_dir, state)
+    else:
+        state = ckpt.restore(cfg.checkpoint_dir, state)
+    params = state.params if state.ema is None else state.ema
+
+    # Replicated global placement: every process holds the same
+    # cfg.prompt, so this is multi-host-safe where a host-local numpy
+    # array into the jitted prefill is not.
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    prompt = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P()), np.asarray(ids, np.int32)[None, :])
+
+    from tensorflow_distributed_tpu.models.generate import (
+        beam_search, generate)
+    if cfg.num_beams > 1:
+        seqs, scores = beam_search(model, params, prompt,
+                                   cfg.max_new_tokens,
+                                   num_beams=cfg.num_beams)
+        out = jax.device_get(seqs)[0, 0]          # best beam
+        score = float(jax.device_get(scores)[0, 0])
+    else:
+        key = (jax.random.key(cfg.seed)
+               if cfg.gen_temperature > 0 else None)
+        out = jax.device_get(generate(
+            model, params, prompt, cfg.max_new_tokens,
+            temperature=cfg.gen_temperature, top_k=cfg.gen_top_k,
+            top_p=cfg.gen_top_p, key=key))[0]
+        score = None
+    new_tokens = [int(i) for i in out]
+    rec = {"event": "generate", "step": int(jax.device_get(state.step)),
+           "prompt": cfg.prompt, "new_tokens": new_tokens}
+    if score is not None:
+        rec["beam_score"] = round(score, 5)
+    if dec is not None:
+        rec["text"] = dec(new_tokens)
+    logger.log_json(rec)
+    return rec
+
+
 def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
           ) -> TrainResult:
     cfg.validate()
