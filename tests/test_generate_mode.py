@@ -70,6 +70,19 @@ def test_generate_text_round_trip(tmp_path):
     assert dec(enc("a0:abc")) == "a0:abc"
 
 
+def test_generate_from_moe_checkpoint(tmp_path):
+    """moe_lm generates too: the router's moe_aux sows are no-ops when
+    the collection isn't mutable, so the decode path is clean."""
+    import dataclasses
+
+    cfg = _train_ckpt(tmp_path, model="moe_lm",
+                      mesh=MeshConfig(data=4, expert=2))
+    gen = dataclasses.replace(cfg, mode="generate", prompt="5,6,7",
+                              max_new_tokens=4)
+    rec = generate_only(gen)
+    assert len(rec["new_tokens"]) == 4
+
+
 def test_generate_mode_validation():
     base = dict(model="gpt_lm", model_size="tiny", mode="generate",
                 checkpoint_dir="/tmp/x", prompt="1,2")
