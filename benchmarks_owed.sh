@@ -54,6 +54,15 @@ timeout 580 python -m tensorflow_distributed_tpu.benchmarks.lm_perf \
     --batch 32 --pipeline-microbatches 4 --pipeline-backward stash \
     --skip-ab --out STASHBENCH_hoisted.json
 
+# 5d. Up the GPT-2 ladder: medium (355M) and large (774M) on the one
+#     chip — what remat + fused CE exist for.
+timeout 580 python -m tensorflow_distributed_tpu.benchmarks.lm_perf \
+    --size medium --batch 8 --remat dots --ce-chunk 8192 --skip-ab \
+    --out LMBENCH_r04_medium.json
+timeout 580 python -m tensorflow_distributed_tpu.benchmarks.lm_perf \
+    --size large --batch 4 --remat dots --ce-chunk 8192 --skip-ab \
+    --out LMBENCH_r04_large.json
+
 # 6. Ring local-compute block-size sweep: the recorded RINGBENCH showed
 #    flash-partial ~parity with einsum at half-block 512 — find where
 #    (if anywhere) the kernel pulls ahead, for the dispatch tuning the

@@ -159,7 +159,8 @@ def _timed_steps(step, state, batch, steps: int):
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--size", default="small",
-                        choices=["small", "tiny"])
+                        choices=["small", "medium", "large", "xl",
+                                 "tiny"])
     parser.add_argument("--batch", type=int, default=8)
     parser.add_argument("--seq-len", type=int, default=1024)
     parser.add_argument("--steps", type=int, default=20)

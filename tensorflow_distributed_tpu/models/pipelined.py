@@ -344,11 +344,12 @@ def pipelined_lm(mesh: Mesh, size: str = "tiny", causal: bool = True,
     if size == "tiny":
         overrides.setdefault("n_layers", 4)  # tiny default (2) < common S
         cfg = tiny_config(**overrides)
-    elif size == "small":
-        from tensorflow_distributed_tpu.models.transformer import (
-            gpt2_small_config)
-        cfg = gpt2_small_config(**overrides)
     else:
-        raise ValueError(
-            f"pipelined_lm size {size!r}; have ('tiny', 'small')")
+        from tensorflow_distributed_tpu.models.transformer import (
+            GPT2_SIZES, gpt2_small_config)
+        if size not in GPT2_SIZES:
+            raise ValueError(
+                f"pipelined_lm size {size!r}; have "
+                f"(tiny, {', '.join(GPT2_SIZES)})")
+        cfg = gpt2_small_config(**{**GPT2_SIZES[size], **overrides})
     return PipelinedLM(cfg, mesh, num_microbatches)

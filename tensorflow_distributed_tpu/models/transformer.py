@@ -636,20 +636,34 @@ def gpt2_small_config(**overrides) -> TransformerConfig:
         **overrides)
 
 
+# The GPT-2 ladder (Radford et al. 2019 table 2): d_ff = 4 * d_model
+# throughout; head dim stays 64. "small" remains the measured flagship
+# (LMBENCH artifacts); the larger rungs are what --remat,
+# --param-partition fsdp/zero1, --ce-chunk and the pipeline exist for.
+GPT2_SIZES = {
+    "small": dict(d_model=768, n_layers=12, n_heads=12, d_ff=3072),
+    "medium": dict(d_model=1024, n_layers=24, n_heads=16, d_ff=4096),
+    "large": dict(d_model=1280, n_layers=36, n_heads=20, d_ff=5120),
+    "xl": dict(d_model=1600, n_layers=48, n_heads=25, d_ff=6400),
+}
+
+
 def gpt_lm(mesh: Optional[Mesh] = None, size: str = "small",
            **overrides) -> CausalLM:
-    """GPT-style decoder-only LM. ``size``: "small" (GPT-2-small-ish:
-    12L x 768d x 12H, learned positions, pre-LN) or "tiny" (test scale).
-    No reference counterpart (the reference has no sequence models,
-    SURVEY.md §5) — designed TPU-first like the rest of this family."""
+    """GPT-style decoder-only LM. ``size``: the GPT-2 ladder
+    ("small" 124M-class / "medium" 355M / "large" 774M / "xl" 1.6B
+    backbone shapes, GPT2_SIZES) or "tiny" (test scale). No reference
+    counterpart (the reference has no sequence models, SURVEY.md §5)
+    — designed TPU-first like the rest of this family."""
     overrides["causal"] = True
     _auto_expert_axis(mesh, overrides)
-    if size == "small":
-        cfg = gpt2_small_config(**overrides)
+    if size in GPT2_SIZES:
+        cfg = gpt2_small_config(**{**GPT2_SIZES[size], **overrides})
     elif size == "tiny":
         cfg = tiny_config(**overrides)
     else:
-        raise ValueError(f"gpt_lm size {size!r}; have ('small', 'tiny')")
+        raise ValueError(f"gpt_lm size {size!r}; have "
+                         f"({', '.join(GPT2_SIZES)}, tiny)")
     return CausalLM(cfg, mesh)
 
 

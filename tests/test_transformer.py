@@ -184,3 +184,21 @@ def test_mesh_equivalence_dp_vs_composite(devices8):
         m = ev(state, shard_batch(mesh, b, seq_axis=1))
         losses[name] = float(jax.device_get(m["loss"]))
     np.testing.assert_allclose(losses["dp"], losses["comp"], rtol=2e-5)
+
+
+def test_gpt2_size_ladder_param_counts():
+    """The medium/large/xl presets must land on the published GPT-2
+    backbone sizes (with tied embeddings, the configuration the
+    124M/355M/774M/1.56B numbers count) — abstractly, no init FLOPs."""
+    from tensorflow_distributed_tpu.models.transformer import gpt_lm
+
+    expected = {"small": 124e6, "medium": 355e6, "large": 774e6,
+                "xl": 1558e6}
+    for size, want in expected.items():
+        model = gpt_lm(size=size, tie_embeddings=True)
+        shapes = jax.eval_shape(
+            model.init, jax.random.PRNGKey(0),
+            np.zeros((1, 8), np.int32))
+        n = sum(int(np.prod(l.shape))
+                for l in jax.tree_util.tree_leaves(shapes))
+        assert 0.95 * want < n < 1.06 * want, (size, n, want)
