@@ -50,6 +50,23 @@ import jax.numpy as jnp
 import numpy as np
 
 INT_BIG = 2 ** 30
+# Large-finite stand-in for -inf in the running-max init (matches
+# ops/fused_ce_kernel.py's NEG_INF). A TRUE -inf init NaNs the online
+# normalizer for a shard whose every column is padding (the
+# vocab-parallel form with vocab_size < rows available to a rank):
+# l*exp(m - new_m) = 0*exp(-inf - (-inf)). With a finite init the
+# degenerate shard cleanly yields (m=NEG_INF, l=0), which the cross-
+# rank combine weights to zero.
+NEG_INF = -1e30
+
+
+def _zeros_cotangent(a):
+    """Symbolic-zero cotangent with the type AD expects for ``a``:
+    float0 for non-inexact primals (bool/int masks — masked_ce_sums
+    accepts them via astype), dense zeros otherwise."""
+    if jnp.issubdtype(jnp.result_type(a), jnp.inexact):
+        return jnp.zeros_like(a)
+    return np.zeros(np.shape(a), jax.dtypes.float0)
 
 
 def _pad_vocab(w: jax.Array, bias: Optional[jax.Array], rows: int,
@@ -134,7 +151,7 @@ def _scan_stats(x, wp, bp, targets, n_chunks, chunk, local_rows,
         best_i = jnp.where(take, cidx, best_i)
         return (new_m, l, gold, lsum, best_v, best_i), None
 
-    init = (jnp.full(bshape, -jnp.inf, jnp.float32),
+    init = (jnp.full(bshape, NEG_INF, jnp.float32),
             jnp.zeros(bshape, jnp.float32),
             jnp.zeros(bshape, jnp.float32),
             jnp.zeros(bshape, jnp.float32),
@@ -266,7 +283,7 @@ def _bwd_pass(vocab_size, chunk, label_smoothing, w_vocab_axis, res, g):
                             x.shape[-1], w_vocab_axis, w.dtype, bias)
     return (dx.astype(x.dtype), dw, db,
             np.zeros(targets.shape, jax.dtypes.float0),
-            jnp.zeros_like(mask))
+            _zeros_cotangent(mask))
 
 
 fused_ce_sums.defvjp(_fwd_pass, _bwd_pass)
@@ -331,7 +348,7 @@ def _shard_ce_bwd(vocab_size, chunk, label_smoothing, w_vocab_axis,
     # rank contributions — exactly the reassembly the math wants.
     return (dx.astype(x.dtype), dw, db,
             np.zeros(targets.shape, jax.dtypes.float0),
-            jnp.zeros_like(mask), jnp.zeros_like(lse),
+            _zeros_cotangent(mask), jnp.zeros_like(lse),
             np.zeros(np.shape(off), jax.dtypes.float0))
 
 

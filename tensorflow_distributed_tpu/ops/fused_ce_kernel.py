@@ -46,6 +46,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from tensorflow_distributed_tpu.ops.fused_ce import _zeros_cotangent
+
 NEG_INF = -1e30  # large-finite; matches ops/flash_attention.py
 INT_BIG = 2 ** 30
 LANES = 8        # replication width for per-token rows (see docstring)
@@ -340,7 +342,7 @@ def _fused_ce_tokens_bwd(vocab_size, bt, bv, label_smoothing,
     db = db[0, :vocab_size].astype(bias.dtype)
     return (dx, dw.astype(w.dtype), db,
             np.zeros(targets.shape, jax.dtypes.float0),
-            jnp.zeros_like(mask))
+            _zeros_cotangent(mask))
 
 
 fused_ce_tokens.defvjp(_fused_ce_tokens_fwd, _fused_ce_tokens_bwd)
