@@ -409,13 +409,36 @@ def restore_averaged(ckpt_dir: str, state: Any,
     path for local-SGD runs, independent of the evaluating mesh's
     data-axis size (train on 8 replicas, validate on 1). Float
     leaves average; integer leaves (step, opt counters) take
-    replica 0 (identical by construction)."""
+    replica 0 (identical by construction). Both backends' layouts are
+    read (native msgpack and orbax OCDBT, auto-detected like
+    restore()) — local SGD and sharded checkpointing compose."""
     step = step if step is not None else latest_step(ckpt_dir)
     if step is None:
         raise FileNotFoundError(f"no checkpoints under {ckpt_dir}")
-    path = os.path.join(_step_dir(ckpt_dir, step), "state.msgpack")
-    with open(path, "rb") as f:
-        raw = serialization.msgpack_restore(f.read())
+    sd = _step_dir(ckpt_dir, step)
+    opath = os.path.join(sd, _ORBAX_DIRNAME)
+    if os.path.exists(os.path.join(sd, _ORBAX_MARKER)):
+        # Orbax OCDBT layout, detected via the COMMIT MARKER exactly
+        # like restore() — a crashed orbax re-save into a dir holding
+        # an intact native state.msgpack must fall through to the
+        # msgpack, not dispatch onto unmarked shard debris.
+        # Template-free restore reads the SAVED (replica-stacked)
+        # tree as host numpy — the shapes come from the checkpoint,
+        # which is the point (the stacked leaves don't match the
+        # plain template until after the mean below). Warning-free
+        # topology safety doesn't apply: host arrays carry no
+        # sharding to mismatch.
+        import warnings
+
+        path = opath
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            raw = jax.tree_util.tree_map(np.asarray, _orbax().restore(
+                opath))
+    else:
+        path = os.path.join(sd, "state.msgpack")
+        with open(path, "rb") as f:
+            raw = serialization.msgpack_restore(f.read())
     if not (isinstance(raw, dict) and isinstance(raw.get("step"),
                                                  np.ndarray)
             and raw["step"].ndim == 1):

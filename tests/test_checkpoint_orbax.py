@@ -84,9 +84,40 @@ def test_orbax_end_to_end_resume_and_prune(tmp_path):
 def test_orbax_validation_walls():
     with pytest.raises(ValueError, match="checkpoint_backend"):
         TrainConfig(checkpoint_backend="s3", batch_size=32).validate()
-    with pytest.raises(ValueError, match="orbax"):
-        TrainConfig(checkpoint_backend="orbax", param_sync_every=2,
-                    batch_size=32).validate()
+    # The r4 wall is gone: local SGD composes with the orbax backend
+    # (restore_averaged auto-detects the OCDBT layout — VERDICT r4
+    # item 7).
+    TrainConfig(checkpoint_backend="orbax", param_sync_every=2,
+                batch_size=32, mesh=MeshConfig(data=8)).validate()
+
+
+def test_orbax_local_sgd_restore_averaged(tmp_path, mesh8):
+    """Local SGD's replica-stacked state round-trips through the orbax
+    backend AND restore_averaged reads the OCDBT layout into a PLAIN
+    template (the two r4 marquee features no longer exclude each
+    other). The averaged restore must equal averaged_view of the live
+    state."""
+    from tensorflow_distributed_tpu.train.local_sgd import (
+        averaged_view, stack_state)
+
+    state = _state(mesh8)
+    stacked = stack_state(state, mesh8)
+    # Make replicas visibly distinct so the mean is a real check.
+    stacked = stacked.replace(params=jax.tree_util.tree_map(
+        lambda p: p + jnp.arange(p.shape[0], dtype=p.dtype).reshape(
+            (-1,) + (1,) * (p.ndim - 1)), stacked.params))
+    ckpt.save(str(tmp_path), stacked, backend="orbax")
+
+    tmpl = _state(mesh8, seed=1)
+    restored = ckpt.restore_averaged(str(tmp_path), tmpl)
+    want = averaged_view(stacked)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-6),
+        jax.device_get(restored.params), jax.device_get(want.params))
+    # Template shardings won: the restored state lives plain.
+    assert jax.tree_util.tree_leaves(restored.params)[0].shape == \
+        jax.tree_util.tree_leaves(tmpl.params)[0].shape
 
 
 def test_unmarked_orbax_dir_never_shadows_previous(tmp_path, mesh8):
