@@ -268,6 +268,14 @@ class TrainConfig:
     # ~4/3 fewer stage FLOPs; costs D=min(2*pipe, M) residual copies
     # per stage). parallel.pipeline.pipeline_value_and_grad.
     pipeline_backward: str = "recompute"
+    # Interleaved (virtual-stage) layout, V > 1: each device owns V
+    # depth chunks of n_layers/(pipe*V) layers (Megatron's interleaved
+    # assignment, [S, V, lps] stacking). Correctness-complete for both
+    # schedules (1f1b: the single-scan interleaved schedule; gpipe/
+    # eval: V chained pipeline passes); the uniform-tick bubble math
+    # is analyzed in parallel.pipeline.bubble_fraction. recompute
+    # backward only.
+    pipeline_virtual_stages: int = 1
 
     # The runnable async-family mode (reference: sync_replicas=False,
     # mnist_python_m.py:208,247-253; SURVEY N6): 1 = synchronous data
@@ -367,11 +375,6 @@ class TrainConfig:
             raise ValueError(
                 f"unknown checkpoint_backend "
                 f"{self.checkpoint_backend!r}")
-        if self.checkpoint_backend == "orbax" and self.param_sync_every > 1:
-            raise ValueError(
-                "checkpoint_backend=orbax does not support local-SGD"
-                " replica-stacked states yet (restore_averaged reads"
-                " the native msgpack layout); use native")
         if self.pipeline_schedule not in ("gpipe", "1f1b"):
             raise ValueError(
                 f"unknown pipeline_schedule {self.pipeline_schedule!r}")
@@ -406,22 +409,51 @@ class TrainConfig:
         if self.param_partition not in ("replicated", "zero1", "fsdp"):
             raise ValueError(
                 f"unknown param_partition {self.param_partition!r}")
-        if (self.param_partition != "replicated"
+        if (self.param_partition == "fsdp"
                 and self.model == "pipelined_lm"):
-            # Pipelined stage params already carry the "pipe" axis and
-            # are consumed stage-sliced inside a manual shard_map — a
-            # second data-axis shard would have to be gathered inside
-            # the schedule by hand, not by GSPMD. Use more pipeline
-            # stages (or TP) for memory instead.
+            # FSDP only: pipelined stage PARAMS carry the "pipe" axis
+            # and are consumed stage-sliced inside a manual shard_map —
+            # a second data-axis shard would have to be gathered inside
+            # the schedule by hand, not by GSPMD. ZeRO-1 composes:
+            # optimizer slots are consumed in tx.update OUTSIDE the
+            # pipe shard_map (train/pipeline_step.py), so sharding
+            # them over "data" never touches the schedule — at
+            # GPT-2-xl replicated Adam slots are ~19 GB f32, the first
+            # OOM the size ladder hits (VERDICT r4 item 2).
             raise ValueError(
-                f"param_partition={self.param_partition} does not "
-                f"compose with model=pipelined_lm (stage params are "
-                f"shard_map-managed); use mesh.pipe/mesh.model for "
-                f"memory")
+                "param_partition=fsdp does not compose with "
+                "model=pipelined_lm (stage params are shard_map-"
+                "managed); use param_partition=zero1 for optimizer-"
+                "slot memory, mesh.pipe/mesh.model for param memory")
         if self.pipeline_microbatches < 1:
             raise ValueError(
                 f"pipeline_microbatches must be >= 1, "
                 f"got {self.pipeline_microbatches}")
+        if self.pipeline_virtual_stages < 1:
+            raise ValueError(
+                f"pipeline_virtual_stages must be >= 1, "
+                f"got {self.pipeline_virtual_stages}")
+        if self.pipeline_virtual_stages > 1:
+            if self.model != "pipelined_lm":
+                raise ValueError(
+                    "pipeline_virtual_stages > 1 applies only to "
+                    "model=pipelined_lm")
+            if self.pipeline_backward != "recompute":
+                raise ValueError(
+                    "pipeline_virtual_stages > 1 supports "
+                    "pipeline_backward='recompute' only (the stash "
+                    "variant's per-chunk residual treedefs are a "
+                    "follow-up; parallel.pipeline."
+                    "interleaved_pipeline_value_and_grad)")
+            if (self.pipeline_schedule == "1f1b"
+                    and self.pipeline_microbatches
+                    < self.mesh.pipe * self.pipeline_virtual_stages):
+                raise ValueError(
+                    f"pipeline_microbatches "
+                    f"{self.pipeline_microbatches} < mesh.pipe x "
+                    f"virtual stages ({self.mesh.pipe} x "
+                    f"{self.pipeline_virtual_stages}): every virtual "
+                    f"stage needs a microbatch in flight")
         if (self.model == "pipelined_lm"
                 and self.batch_size % self.pipeline_microbatches):
             raise ValueError(

@@ -139,6 +139,8 @@ def _build_model_and_state(cfg: TrainConfig, mesh, task):
             size_kw["max_len"] = cfg.seq_len
     if cfg.model == "pipelined_lm":
         size_kw["num_microbatches"] = cfg.pipeline_microbatches
+        if cfg.pipeline_virtual_stages > 1:
+            size_kw["virtual_stages"] = cfg.pipeline_virtual_stages
     model = build_model(
         cfg.model, mesh=mesh, dropout_rate=cfg.dropout_rate,
         init_scheme=cfg.init_scheme,
@@ -239,17 +241,22 @@ def generate_only(cfg: TrainConfig,
                 f"prompt {cfg.prompt!r} is not comma-separated token "
                 f"ids (string prompts need dataset=text, whose "
                 f"tokenizer defines a text vocabulary)") from None
-        bad = [t for t in ids if not 0 <= t < vocab]
-        if bad:
-            # The embedding gather would silently CLAMP these.
-            raise ValueError(
-                f"prompt ids {bad} outside the model vocabulary "
-                f"[0, {vocab})")
+        # Bound-checked below against the BUILT model's vocab — when
+        # synthetic_vocab is unset, _build_model_and_state leaves the
+        # family default (e.g. 50257 for gpt_lm small), so ids in
+        # [synthetic default, family vocab) are legal model inputs.
 
     seq = cfg.seq_len or 128
     shim = _GenTask(vocab_size=vocab, sample_input=np.zeros(
         (max(2, dict(mesh.shape).get("data", 1)), seq), np.int32))
     model, state = _build_model_and_state(cfg, mesh, shim)
+    if cfg.dataset != "text":
+        bad = [t for t in ids if not 0 <= t < model.cfg.vocab_size]
+        if bad:
+            # The embedding gather would silently CLAMP these.
+            raise ValueError(
+                f"prompt ids {bad} outside the model vocabulary "
+                f"[0, {model.cfg.vocab_size})")
     if cfg.param_sync_every > 1:
         state = ckpt.restore_averaged(cfg.checkpoint_dir, state)
     else:
@@ -317,6 +324,13 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
         start_step = ckpt.host_step(state)
         logger.log_json({"event": "resumed", "step": start_step})
 
+    # ZeRO-1 needs new_params constrained back to the params' OWN
+    # state-creation layout after the slot-sharded update — captured
+    # from the live arrays so pipe/TP-sharded params keep those axes
+    # (a blanket "replicated" would clobber them).
+    params_out = (jax.tree_util.tree_map(lambda a: a.sharding,
+                                         state.params)
+                  if cfg.param_partition == "zero1" else None)
     if cfg.model == "pipelined_lm" and cfg.pipeline_schedule == "1f1b":
         from tensorflow_distributed_tpu.train.pipeline_step import (
             make_1f1b_train_step)
@@ -328,7 +342,8 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
                                        label_smoothing=cfg.label_smoothing,
                                        ema_decay=cfg.ema_decay,
                                        backward=cfg.pipeline_backward,
-                                       ce_chunk=cfg.ce_chunk)
+                                       ce_chunk=cfg.ce_chunk,
+                                       params_out_shardings=params_out)
     elif local_sgd:
         from tensorflow_distributed_tpu.train.local_sgd import (
             make_local_sgd_train_step)
@@ -343,7 +358,7 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
             accum_steps=cfg.grad_accum_steps,
             grad_norm_metric=cfg.log_grad_norm,
             ema_decay=cfg.ema_decay,
-            replicate_params_out=cfg.param_partition == "zero1")
+            params_out_shardings=params_out)
     eval_fn = make_eval_step(mesh, loss=task.eval_loss or task.loss,
                              batch_shardings=task.batch_shardings)
     logger.log_json({

@@ -40,7 +40,7 @@ from jax.sharding import Mesh
 from tensorflow_distributed_tpu.models.pipelined import PipelinedLM
 from tensorflow_distributed_tpu.ops.losses import masked_ce_sums
 from tensorflow_distributed_tpu.parallel.pipeline import (
-    pipeline_value_and_grad)
+    interleaved_pipeline_value_and_grad, pipeline_value_and_grad)
 from tensorflow_distributed_tpu.train.state import TrainState, ema_update
 from tensorflow_distributed_tpu.train.tasks import (
     MOE_AUX_WEIGHT, mlm_batch_shardings)
@@ -56,7 +56,8 @@ def make_1f1b_train_step(model: PipelinedLM, mesh: Mesh, seed: int = 0,
                          label_smoothing: float = 0.0,
                          ema_decay: float = 0.0,
                          backward: str = "recompute",
-                         ce_chunk: int = 0
+                         ce_chunk: int = 0,
+                         params_out_shardings: Any = None
                          ) -> Callable[[TrainState, Any],
                                        Tuple[TrainState, Dict]]:
     """Build the jitted 1F1B step for a PipelinedLM.
@@ -79,11 +80,42 @@ def make_1f1b_train_step(model: PipelinedLM, mesh: Mesh, seed: int = 0,
     head vjp the chunked custom-VJP op instead of dense logits, so the
     last stage never materializes [mb, L, V] — it composes because the
     schedule already drives last_fn through an explicit jax.vjp.
+
+    ``params_out_shardings`` (ZeRO-1, param_partition="zero1"): the
+    params' state-creation sharding tree, constrained onto new_params
+    after the optimizer apply. The update itself happens OUTSIDE the
+    pipe shard_map under plain GSPMD, so data-sharded Adam slots
+    compose with the schedule untouched: each device updates its slot
+    slice, and this constraint is the allgather that restores the
+    pipe(/TP)-only param layout — without it the slot sharding
+    propagates into the params and the next step's pipe shard_map
+    pays per-use data-axis gathers (see train.step's twin note).
     """
     if batch_shardings is None:
         batch_shardings = mlm_batch_shardings(mesh)
     use_dropout = bool(model.cfg.dropout_rate)
     moe = model.cfg.moe_experts > 0
+    V = getattr(model, "virtual_stages", 1)
+    if V > 1 and backward != "recompute":
+        # Mirrors config.validate's rejection — the interleaved
+        # schedule implements the recompute backward only (see
+        # interleaved_pipeline_value_and_grad).
+        raise ValueError("pipeline_backward='stash' is not supported "
+                         "with virtual stages; use 'recompute'")
+    # mesh.seq > 1 routes the stage through ring attention, whose
+    # seq-ppermutes cannot live inside the cond-skipped bubble
+    # branches (collectives under per-pipe-rank control flow — see
+    # pipeline_value_and_grad's ``bubble`` note): fall back to
+    # where-masked predication for those meshes.
+    from tensorflow_distributed_tpu.parallel.mesh import AXIS_SEQ
+    bubble = "where" if mesh.shape[AXIS_SEQ] > 1 else "cond"
+
+    def _sched(*args, **kw):
+        if V > 1:
+            kw.pop("backward", None)
+            return interleaved_pipeline_value_and_grad(
+                *args, virtual_stages=V, **kw)
+        return pipeline_value_and_grad(*args, **kw)
 
     def step(state: TrainState, batch: Any) -> Tuple[TrainState, Dict]:
         tokens, targets = batch["tokens"], batch["targets"]
@@ -117,7 +149,8 @@ def make_1f1b_train_step(model: PipelinedLM, mesh: Mesh, seed: int = 0,
                 return ce_sum, {"correct": correct, "mask": n}
 
         kw = dict(rng=dkey if use_dropout else None,
-                  cotangent_scale=1.0 / total, backward=backward)
+                  cotangent_scale=1.0 / total, backward=backward,
+                  bubble=bubble)
         aux_metrics = {}
         if moe:
             # Each (layer, microbatch) sow contributes 1/denom to the
@@ -128,7 +161,7 @@ def make_1f1b_train_step(model: PipelinedLM, mesh: Mesh, seed: int = 0,
                        "z_loss": moe_zloss_weight / denom,
                        "dropped_fraction": 0.0}
             ce_sum, sums, aux_sums, (d_blocks, d_shell_head, d_x) = (
-                pipeline_value_and_grad(
+                _sched(
                     stage_fn, last_fn, blocks, shell, x,
                     (targets, mask), mesh, model.num_microbatches,
                     stage_aux_cotangent=aux_cot, **kw))
@@ -138,7 +171,7 @@ def make_1f1b_train_step(model: PipelinedLM, mesh: Mesh, seed: int = 0,
                                aux_sums["dropped_fraction"] / denom}
         else:
             ce_sum, sums, (d_blocks, d_shell_head, d_x) = (
-                pipeline_value_and_grad(
+                _sched(
                     stage_fn, last_fn, blocks, shell, x,
                     (targets, mask), mesh, model.num_microbatches, **kw))
         (d_shell_embed,) = embed_vjp(d_x.astype(x.dtype))
@@ -151,6 +184,10 @@ def make_1f1b_train_step(model: PipelinedLM, mesh: Mesh, seed: int = 0,
                                            state.params)
         new_params = jax.tree_util.tree_map(
             lambda p, u: p + u.astype(p.dtype), state.params, updates)
+        if params_out_shardings is not None:
+            new_params = jax.tree_util.tree_map(
+                jax.lax.with_sharding_constraint, new_params,
+                params_out_shardings)
         metrics = {"loss": ce_sum / total,
                    "accuracy": sums["correct"] / jnp.maximum(
                        sums["mask"], 1.0), **aux_metrics}

@@ -92,7 +92,7 @@ def make_train_step(mesh: Mesh, seed: int = 0, donate: bool = True,
                     jit: bool = True,
                     grad_norm_metric: bool = False,
                     ema_decay: float = 0.0,
-                    replicate_params_out: bool = False
+                    params_out_shardings: Any = None
                     ) -> Callable[[TrainState, Batch],
                                   Tuple[TrainState, Metrics]]:
     """Build the jitted train step for a mesh.
@@ -183,16 +183,20 @@ def make_train_step(mesh: Mesh, seed: int = 0, donate: bool = True,
         updates, new_opt = state.tx.update(grads, state.opt_state, state.params)
         new_params = jax.tree_util.tree_map(
             lambda p, u: (p + u.astype(p.dtype)), state.params, updates)
-        if replicate_params_out:
+        if params_out_shardings is not None:
             # ZeRO-1's defining invariant: each device computed its
             # SLICE of the update (the slots are data-sharded), and
-            # this constraint is the allgather that re-replicates the
-            # params. Without it GSPMD propagates the slot sharding
-            # into new_params and every later step pays FSDP-style
-            # per-use gathers the zero1 mode exists to avoid.
+            # this constraint is the allgather that restores the
+            # params' own layout — a tree of the params'
+            # state-creation shardings, so legitimately-sharded params
+            # (TP "model" annotations, pipe-stacked blocks) keep those
+            # axes instead of being force-replicated. Without it GSPMD
+            # propagates the slot sharding into new_params and every
+            # later step pays FSDP-style per-use gathers the zero1
+            # mode exists to avoid.
             new_params = jax.tree_util.tree_map(
-                lambda t: jax.lax.with_sharding_constraint(
-                    t, replicated(mesh)), new_params)
+                jax.lax.with_sharding_constraint, new_params,
+                params_out_shardings)
         new_ema = state.ema
         if ema_decay and state.ema is not None:
             new_ema = ema_update(state.ema, new_params, ema_decay,

@@ -99,8 +99,10 @@ def test_zero1_shards_slots_only_with_exact_parity(mesh8):
 
     s_rep = _state(mesh8, fsdp=False)
     step = make_train_step(mesh8, donate=False)
-    step_z1 = make_train_step(mesh8, donate=False,
-                              replicate_params_out=True)
+    step_z1 = make_train_step(
+        mesh8, donate=False,
+        params_out_shardings=jax.tree_util.tree_map(
+            lambda a: a.sharding, s_z1.params))
     for i in range(3):
         batch = shard_batch(mesh8, _batch(seed=i))
         s_rep, m_rep = step(s_rep, batch)
@@ -171,6 +173,81 @@ def test_fsdp_checkpoint_roundtrip(mesh8, tmp_path):
     # Restored leaves keep the FSDP placement of the template.
     assert _shard_fractions(restored.params) == _shard_fractions(
         state.params)
+
+
+def test_zero1_pipelined_1f1b_exact_parity(devices8):
+    """ZeRO-1 composes with the hand-scheduled 1F1B pipeline (VERDICT
+    r4 item 2): optimizer slots are consumed in tx.update OUTSIDE the
+    pipe shard_map, so sharding them over "data" must not change the
+    training run. Pinned: (a) slots data-sharded while params keep the
+    pipe-only layout, (b) exact parity with the replicated layout over
+    3 steps, (c) both layout invariants HOLD THROUGH TRAINING (the
+    params_out_shardings constraint is what stops GSPMD propagating
+    the slot sharding into the params)."""
+    from tensorflow_distributed_tpu.data.lm import synthetic_clm
+    from tensorflow_distributed_tpu.models.pipelined import pipelined_lm
+    from tensorflow_distributed_tpu.train.pipeline_step import (
+        make_1f1b_train_step)
+    from tensorflow_distributed_tpu.train.tasks import mlm_batch_shardings
+
+    mesh = make_mesh(MeshConfig(data=2, pipe=2), devices8[:4])
+    model = pipelined_lm(mesh, num_microbatches=4, n_layers=4,
+                         max_len=16, dropout_rate=0.0, use_flash=False,
+                         compute_dtype=jnp.float32)
+    sample = np.zeros((2, 16), np.int32)
+    s_rep = create_train_state(model, optax.adam(1e-2), sample, mesh,
+                               seed=0)
+    s_z1 = create_train_state(model, optax.adam(1e-2), sample, mesh,
+                              seed=0, opt_fsdp=True, fsdp_min_size=1024)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)), s_rep.params, s_z1.params)
+    # (a) slots sharded over data; params identical placement to rep.
+    assert any(f < 1.0 for f in _shard_fractions(s_z1.opt_state).values())
+    param_layout = _shard_fractions(s_z1.params)
+    assert param_layout == _shard_fractions(s_rep.params)
+
+    ds = synthetic_clm(n=64, seq_len=16, vocab_size=64)
+    pos = jax.tree_util.tree_map(lambda a: a.sharding, s_z1.params)
+    step = make_1f1b_train_step(model, mesh, donate=False,
+                                batch_shardings=mlm_batch_shardings(mesh))
+    step_z1 = make_1f1b_train_step(model, mesh, donate=False,
+                                   batch_shardings=mlm_batch_shardings(mesh),
+                                   params_out_shardings=pos)
+    for i in range(3):
+        batch = shard_batch(mesh, ds.batch(np.arange(i * 16, i * 16 + 16)),
+                            seq_axis=1)
+        s_rep, m_rep = step(s_rep, batch)
+        s_z1, m_z1 = step_z1(s_z1, batch)
+        np.testing.assert_allclose(float(m_rep["loss"]),
+                                   float(m_z1["loss"]), rtol=1e-5)
+    # (b) same params after 3 steps. atol covers Adam's 1/sqrt(v)
+    # amplifying reduction-order float noise: the slot-sharded update
+    # legitimately reassociates the moment math per data slice
+    # (measured max |diff| ~1.2e-5 over 3 steps on the CPU mesh).
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=3e-5),
+        s_rep.params, s_z1.params)
+    # (c) layouts held: params pipe-only, slots still data-sharded.
+    assert _shard_fractions(s_z1.params) == param_layout
+    assert any(f < 1.0 for f in _shard_fractions(s_z1.opt_state).values())
+
+
+def test_zero1_pipelined_cli_end_to_end(devices8):
+    """--param-partition zero1 --model pipelined_lm trains through the
+    full loop (the config wall narrowed to fsdp, VERDICT r4 item 2)."""
+    from tensorflow_distributed_tpu.train.loop import train
+
+    cfg = TrainConfig(model="pipelined_lm", model_size="tiny",
+                      dataset="synthetic", batch_size=16, train_steps=3,
+                      eval_every=0, log_every=0, eval_batch_size=16,
+                      compute_dtype="float32", pipeline_schedule="1f1b",
+                      param_partition="zero1",
+                      mesh=MeshConfig(data=4, pipe=2))
+    cfg.validate()
+    result = train(cfg)
+    assert np.isfinite(result.final_metrics["loss"])
 
 
 def test_config_rejects_fsdp_pipelined():
