@@ -111,9 +111,13 @@ def _naive_shard(seq_size: int, causal: bool):
     (the clamp in _block_attend) — correct but ~2x the minimal causal
     FLOPs and imbalanced (device S-1 is busy every step)."""
 
-    def per_shard(q_blk, k_blk, v_blk):
-        # q_blk etc: [B/dp, L/S, H/tp, D] local blocks.
-        i = jax.lax.axis_index(AXIS_SEQ)
+    def per_shard(q_blk, k_blk, v_blk, ids):
+        # q_blk etc: [B/dp, L/S, H/tp, D] local blocks. ids: [1], this
+        # device's ring position (the seq-sharded iota ring_attention
+        # threads in — NOT lax.axis_index, whose residual re-lowers
+        # with every axis manual under AD inside a nested shard_map
+        # and trips the sdy verifier; see ring_attention).
+        i = ids[0]
         l_loc = q_blk.shape[1]
         rows = jax.lax.broadcasted_iota(jnp.int32, (l_loc, l_loc), 0)
         cols = jax.lax.broadcasted_iota(jnp.int32, (l_loc, l_loc), 1)
@@ -179,9 +183,10 @@ def _zigzag_causal_shard(S: int):
     permA_inv = [(dstA[d], d) for d in range(S)]
     permB_inv = [(dstB[d], d) for d in range(S)]
 
-    def to_zigzag(x):
-        """Local [B, n, H, D] contiguous block -> (g1, g2) halves."""
-        e = jax.lax.axis_index(AXIS_SEQ)
+    def to_zigzag(x, e):
+        """Local [B, n, H, D] contiguous block -> (g1, g2) halves.
+        ``e``: this device's ring position (threaded, not
+        lax.axis_index — see _naive_shard's note)."""
         nh = x.shape[1] // 2
         recvA = jax.lax.ppermute(x[:, :nh], AXIS_SEQ, permA)
         recvB = jax.lax.ppermute(x[:, nh:], AXIS_SEQ, permB)
@@ -192,9 +197,8 @@ def _zigzag_causal_shard(S: int):
         g2 = jnp.where(even, recvB, recvA)
         return g1, g2
 
-    def from_zigzag(o1, o2):
+    def from_zigzag(o1, o2, e):
         """(g1, g2) outputs -> local contiguous [B, n, H, D] block."""
-        e = jax.lax.axis_index(AXIS_SEQ)
         even = (e % 2 == 0)
         sendA = jnp.where(even, o1, o2)   # the half that arrived via A
         sendB = jnp.where(even, o2, o1)
@@ -202,11 +206,11 @@ def _zigzag_causal_shard(S: int):
         second = jax.lax.ppermute(sendB, AXIS_SEQ, permB_inv)
         return jnp.concatenate([first, second], axis=1)
 
-    def per_shard(q_blk, k_blk, v_blk):
-        d = jax.lax.axis_index(AXIS_SEQ)
-        q1, q2 = to_zigzag(q_blk)
-        k1, k2 = to_zigzag(k_blk)
-        v1, v2 = to_zigzag(v_blk)
+    def per_shard(q_blk, k_blk, v_blk, ids):
+        d = ids[0]
+        q1, q2 = to_zigzag(q_blk, d)
+        k1, k2 = to_zigzag(k_blk, d)
+        v1, v2 = to_zigzag(v_blk, d)
         # In-half triangular masking for the two diagonal blocks (global
         # offsets of q and k halves coincide, so offsets cancel) —
         # causal=True in _partial_attend, which dispatches to the Pallas
@@ -247,7 +251,7 @@ def _zigzag_causal_shard(S: int):
             return (o / l.transpose(0, 2, 1)[..., None]).astype(
                 q_blk.dtype)
 
-        return from_zigzag(finish(acc1), finish(acc2))
+        return from_zigzag(finish(acc1), finish(acc2), d)
 
     return per_shard
 
@@ -289,6 +293,31 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                   and (q.shape[1] // seq_size) % 2 == 0)
     per_shard = (_zigzag_causal_shard(seq_size) if use_zigzag
                  else _naive_shard(seq_size, causal))
+    # Ring position as a seq-sharded iota ARGUMENT instead of
+    # lax.axis_index inside per_shard: under AD, axis_index's
+    # device-id arithmetic is re-lowered as a residual computation
+    # with EVERY mesh axis manual, which trips the sdy verifier when
+    # this shard_map nests inside the pipelined family's pipe-manual
+    # region ("operates on axis already bound by a parent") — an
+    # argument slice carries the same value through both schedules'
+    # AD with no axis reference at all.
+    ids = jnp.arange(seq_size, dtype=jnp.int32)
+    ctx = jax.sharding.get_abstract_mesh()
+    if ctx.manual_axes:
+        # Inside an enclosing shard_map (the pipelined family's
+        # pipe-manual region): re-manualizing "pipe" is illegal, so
+        # nest over exactly the remaining auto axes, against the
+        # CONTEXT abstract mesh — the same idiom as the flash
+        # dispatcher (ops.flash_attention.attention). The ring's
+        # ppermutes name only "seq", which is in the remaining set.
+        remaining = set(ctx.axis_names) - set(ctx.manual_axes)
+        from jax.sharding import NamedSharding
+        ids = jax.lax.with_sharding_constraint(
+            ids, NamedSharding(ctx, P(AXIS_SEQ)))
+        return jax.shard_map(per_shard, mesh=ctx,
+                             in_specs=(spec, spec, spec, P(AXIS_SEQ)),
+                             out_specs=spec, axis_names=remaining,
+                             check_vma=False)(q, k, v, ids)
     return jax.shard_map(per_shard, mesh=mesh,
-                         in_specs=(spec, spec, spec),
-                         out_specs=spec, check_vma=False)(q, k, v)
+                         in_specs=(spec, spec, spec, P(AXIS_SEQ)),
+                         out_specs=spec, check_vma=False)(q, k, v, ids)
