@@ -147,31 +147,38 @@ def bubble_fraction(num_microbatches: int, num_stages: int,
     ramp-up/drain bubbles.
 
     On interleaved (virtual-stage) schedules — analyzed across rounds
-    3-4 and deliberately NOT implemented. In this architecture every
-    schedule is a lockstep ``lax.scan`` whose tick runs one fwd + one
-    bwd slot per device between ppermutes, so wall time is
-    ticks x slot time regardless of which devices' slots are
-    cond-skipped. Folding V chunk-columns per device makes the chunk
-    round-robin pipe SV chunks deep with MV chunk-jobs per device:
-    utilization MV/(MV + 2(SV-1)) — STRICTLY WORSE than the plain
-    M/(M + 2(S-1)) for V > 1 (M=8, S=4: 57% plain, 53% at V=2).
-    Megatron's bubble/V win does not come from interleaving alone but
-    from its ASYMMETRIC grouped schedule: warmup ticks run fwd-ONLY
-    chunk bursts (up to S-1+2(V-1) forwards queued per device before
-    the first backward) so ramp chunks overlap useful steady-state
-    work — a schedule a uniform one-fwd-one-bwd tick cannot express.
-    Expressing it here would need per-tick static slot tables driving
-    variable work per tick; the complexity buys nothing measurable on
-    this hardware (single-chip S=1 has no bubble at all — PARITY.md)
-    and is left unimplemented with this note as the record. What DOES
-    pay, and IS implemented, is making bubble half-ticks free:
+    3-4, IMPLEMENTED for correctness in round 5
+    (``interleaved_pipeline_value_and_grad``; the [S, V, lps] layout,
+    [S*V]-deep virtual ring, parity-pinned in
+    tests/test_pipeline_1f1b.py). The analysis stands and the
+    implementation embodies it: every schedule here is a lockstep
+    ``lax.scan`` whose tick runs one fwd + one bwd slot per (device,
+    chunk) between ppermutes, so wall time is ticks x slot time
+    regardless of which devices' slots are cond-skipped. Folding V
+    chunk-columns per device makes the chunk round-robin pipe SV
+    chunks deep with MV chunk-jobs per device: utilization
+    MV/(MV + 2(SV-1)) — STRICTLY WORSE than the plain M/(M + 2(S-1))
+    for V > 1 (M=8, S=4: 57% plain, 53% at V=2). Megatron's bubble/V
+    win does not come from interleaving alone but from its ASYMMETRIC
+    grouped schedule: warmup ticks run fwd-ONLY chunk bursts (up to
+    S-1+2(V-1) forwards queued per device before the first backward)
+    so ramp chunks overlap useful steady-state work — a schedule a
+    uniform one-fwd-one-bwd tick cannot express. Expressing it would
+    need per-tick static slot tables driving variable work per tick;
+    on this hardware (single-chip S=1 — no bubble at all, PARITY.md)
+    the asymmetric form buys nothing measurable, so the uniform-tick
+    implementation is the correctness vehicle and the schedule-level
+    A/B is an owed on-chip measurement. What DOES pay, and IS
+    implemented, is making bubble half-ticks free:
     pipeline_value_and_grad's tick wraps each half in a real
     ``lax.cond`` (possible because its backward is hand-rolled —
     nothing ADs through the cond), skipping ramp/drain garbage compute
     instead of where-masking it. Measured 3.3x per-step at S=4, M=4
     (see module docstring); the reported 2(S-1)/(M+2(S-1)) fraction
     remains the SLOT accounting — the skipped slots now cost ~0 time
-    rather than a full stage pass."""
+    rather than a full stage pass. (Exception: stages carrying seq
+    collectives run where-masked — the ``bubble`` switch — because a
+    collective under per-pipe-rank control flow is not SPMD-legal.)"""
     M, S = num_microbatches, num_stages
     if schedule == "gpipe":
         return (S - 1) / (M + S - 1)
@@ -240,6 +247,14 @@ def merge_by_mask(variant_leaves, const_leaves, mask):
     return out
 
 
+def _select_tree(pred, new, old):
+    """``jnp.where`` over matching pytrees — the single predication
+    primitive for the ``bubble="where"`` paths (one implementation so
+    every select site in both schedules stays in lockstep)."""
+    return jax.tree_util.tree_map(
+        lambda n, o: jnp.where(pred, n, o), new, old)
+
+
 def pipeline_value_and_grad(stage_fn: Callable[..., jax.Array],
                             last_fn: Callable[[Any, jax.Array, Any],
                                               tuple],
@@ -248,7 +263,8 @@ def pipeline_value_and_grad(stage_fn: Callable[..., jax.Array],
                             num_microbatches: int, rng: Any = None,
                             cotangent_scale: Any = 1.0,
                             stage_aux_cotangent: Any = None,
-                            backward: str = "recompute"):
+                            backward: str = "recompute",
+                            bubble: str = "cond"):
     """1F1B pipeline: hand-scheduled forward AND backward in one pass.
 
     GPipe (``pipeline_apply`` + outer AD) must finish every forward
@@ -321,6 +337,22 @@ def pipeline_value_and_grad(stage_fn: Callable[..., jax.Array],
         than recompute on v5e at GPT-2-small shapes (19.9% vs 30.8%
         MFU, PARITY.md) — that measurement predates the hoist and is
         owed a re-run; stash stays opt-in until it's re-measured.
+
+    ``bubble``: how ramp/drain slots are suppressed.
+      "cond" (default) — real ``lax.cond`` branches skip the bubble
+        compute entirely (the measured 3.3x win, module docstring).
+        REQUIRES the stage to contain no cross-device collectives:
+        the predicate varies per pipe rank, and XLA SPMD cannot honor
+        a collective under non-uniform control flow — with ring
+        attention's seq-ppermutes inside the branch this silently
+        computes garbage (measured: wrong loss, NaN under learned
+        pos-emb, on the virtual mesh).
+      "where" — compute every slot and mask the results (the GPipe-
+        style predication this schedule used before round 4): bubble
+        slots cost a full stage pass, but every collective executes
+        unconditionally on every rank. train.pipeline_step selects
+        this automatically when mesh.seq > 1 routes the stage through
+        ring attention.
     """
     S = mesh.shape[AXIS_PIPE]
     M = num_microbatches
@@ -329,6 +361,8 @@ def pipeline_value_and_grad(stage_fn: Callable[..., jax.Array],
         raise ValueError(f"batch {B} not divisible by microbatches {M}")
     if M < S:
         raise ValueError(f"need microbatches >= stages ({M} < {S})")
+    if bubble not in ("cond", "where"):
+        raise ValueError(f"bubble {bubble!r}; have ('cond', 'where')")
     if backward not in ("recompute", "stash"):
         raise ValueError(f"backward {backward!r}; "
                          "have ('recompute', 'stash')")
@@ -468,8 +502,16 @@ def pipeline_value_and_grad(stage_fn: Callable[..., jax.Array],
             def fwd_skip(inp, stash):
                 return jnp.zeros_like(inp), zero_aux, stash
 
-            y, aux_v, stash = jax.lax.cond(mf_valid, fwd_run, fwd_skip,
-                                           inp, stash)
+            if bubble == "cond":
+                y, aux_v, stash = jax.lax.cond(mf_valid, fwd_run,
+                                               fwd_skip, inp, stash)
+            else:
+                # "where": run unconditionally (collectives inside the
+                # stage execute on every rank), select the results.
+                y_r, aux_r, stash_r = fwd_run(inp, stash)
+                y = _select_tree(mf_valid, y_r, jnp.zeros_like(inp))
+                aux_v = _select_tree(mf_valid, aux_r, zero_aux)
+                stash = _select_tree(mf_valid, stash_r, stash)
             # Skipped slots contribute exact zeros — plain adds suffice.
             aux_acc = jax.tree_util.tree_map(
                 lambda a, b: a + b, aux_acc, aux_v)
@@ -486,8 +528,14 @@ def pipeline_value_and_grad(stage_fn: Callable[..., jax.Array],
                 return (jnp.zeros((), jnp.float32), zero_met,
                         zero_dlast, jnp.zeros_like(y))
 
-            hval, hmet, hdlast, hdy = jax.lax.cond(take_head, head_run,
-                                                   head_skip, y)
+            if bubble == "cond":
+                hval, hmet, hdlast, hdy = jax.lax.cond(
+                    take_head, head_run, head_skip, y)
+            else:
+                hval, hmet, hdlast, hdy = _select_tree(
+                    take_head, head_run(y),
+                    (jnp.zeros((), jnp.float32), zero_met, zero_dlast,
+                     jnp.zeros_like(y)))
             val_acc = val_acc + hval
             met_acc = jax.tree_util.tree_map(
                 lambda a, b: a + b.astype(a.dtype), met_acc, hmet)
@@ -520,8 +568,13 @@ def pipeline_value_and_grad(stage_fn: Callable[..., jax.Array],
             def bwd_skip(stash, hdy, bwd_msg):
                 return zero_dp_step, jnp.zeros_like(xm[0])
 
-            dp, dx = jax.lax.cond(b_valid, bwd_run, bwd_skip,
-                                  stash, hdy, bwd_msg)
+            if bubble == "cond":
+                dp, dx = jax.lax.cond(b_valid, bwd_run, bwd_skip,
+                                      stash, hdy, bwd_msg)
+            else:
+                dp, dx = _select_tree(
+                    b_valid, bwd_run(stash, hdy, bwd_msg),
+                    (zero_dp_step, jnp.zeros_like(xm[0])))
             dp_acc = jax.tree_util.tree_map(
                 lambda a, b: a + b.astype(a.dtype), dp_acc, dp)
             take_dx = jnp.logical_and(b_valid, s == 0)
@@ -571,13 +624,311 @@ def pipeline_value_and_grad(stage_fn: Callable[..., jax.Array],
     return val, met, (dp, dlast, dx)
 
 
-def stack_stage_params(layer_params: Any, num_stages: int) -> Any:
-    """[n_layers, ...] stacked layer params -> [S, layers_per_stage, ...]
-    stage-major grouping (stage s owns layers [s*Lps, (s+1)*Lps))."""
+def interleaved_pipeline_value_and_grad(
+        stage_fn: Callable[..., jax.Array],
+        last_fn: Callable[[Any, jax.Array, Any], tuple],
+        stage_params: Any, last_params: Any,
+        x: jax.Array, aux: Any, mesh: Mesh,
+        num_microbatches: int, virtual_stages: int, rng: Any = None,
+        cotangent_scale: Any = 1.0, stage_aux_cotangent: Any = None,
+        bubble: str = "cond"):
+    """Interleaved (virtual-stage) 1F1B: Megatron's chunked layout.
+
+    Each device owns V model CHUNKS instead of one contiguous stage:
+    virtual stage j = v*S + s (chunk v on device s) holds layers
+    [j*lps, (j+1)*lps) with lps = L/(S*V) — stage_params leaves are
+    [S, V, lps, ...] (stack_stage_params with ``virtual``). A
+    microbatch crosses the ring V times; because consecutive virtual
+    stages j, j+1 sit on consecutive devices (j+1 lives on
+    (s+1) mod S), every hop is still the one-position-down ppermute —
+    the V in-flight activations ride as ONE stacked [V, ...] message,
+    and the ring wrap (device S-1 -> 0) shifts chunk slot v -> v+1
+    (``jnp.roll`` on the chunk dim, device-0 side).
+
+    Schedule: the uniform one-chunk-fwd + one-chunk-bwd-per-slot tick
+    over T = M + 2(S*V - 1) ticks; at tick t virtual stage j runs
+    forward for microbatch t - j and backward for t - 2(S*V-1) + j,
+    each slot a real ``lax.cond`` (the V slots per device are
+    compile-time unrolled — V is small and static). The loss head
+    fires at j = S*V - 1 (chunk V-1, device S-1), seeding the same
+    tick's backward exactly like the plain schedule. Utilization of
+    this uniform tick form is MV/(MV + 2(SV-1)) — STRICTLY WORSE than
+    plain 1F1B's M/(M + 2(S-1)) for V > 1 (bubble_fraction's analysis,
+    measured assumptions unchanged); what V buys in Megatron is the
+    asymmetric fwd-burst warmup this lockstep scan cannot express.
+    This implementation exists for CORRECTNESS of the [S, V, lps]
+    regrouping — schedule-level wins stay an explicitly-owed
+    measurement (PARITY.md). Backward is "recompute" only (the stash
+    variant's per-chunk residual treedefs are a follow-up; recompute
+    is the measured-on-chip default).
+
+    Same contract as pipeline_value_and_grad otherwise (including the
+    ``bubble`` cond/where predication switch — "where" when the stage
+    carries seq collectives); d_stage_params comes back
+    [S, V, lps, ...] like stage_params.
+    """
+    S = mesh.shape[AXIS_PIPE]
+    V = virtual_stages
+    Sv = S * V
+    M = num_microbatches
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by microbatches {M}")
+    if M < Sv:
+        raise ValueError(f"need microbatches >= virtual stages "
+                         f"({M} < {Sv} = {S} stages x {V} chunks)")
+    if bubble not in ("cond", "where"):
+        raise ValueError(f"bubble {bubble!r}; have ('cond', 'where')")
+    mb = B // M
+    D = min(2 * Sv, M)  # stash depth per chunk >= max in-flight
+
+    def per_pipe(params, last_p, x, aux, scale):
+        params = jax.tree_util.tree_map(lambda p: p[0], params)  # [V,...]
+        s = jax.lax.axis_index(AXIS_PIPE)
+        xm = x.reshape(M, mb, *x.shape[1:])
+        auxm = jax.tree_util.tree_map(
+            lambda a: a.reshape(M, mb, *a.shape[1:]), aux)
+        down = [(i, (i + 1) % S) for i in range(S)]
+        up = [((i + 1) % S, i) for i in range(S)]
+        is_last = s == S - 1
+
+        aux_on = stage_aux_cotangent is not None
+
+        def chunk_params(v):
+            return jax.tree_util.tree_map(lambda p: p[v], params)
+
+        def with_key(v, m):
+            # Keys fold over (microbatch, VIRTUAL stage) so no two
+            # (mb, chunk) pairs share dropout masks; at V=1 the virtual
+            # index j = s matches the plain schedule's fold exactly.
+            if rng is None:
+                fn = lambda p, xx: stage_fn(p, xx)  # noqa: E731
+            else:
+                j = v * S + s
+                key = jax.random.fold_in(jax.random.fold_in(rng, m), j)
+                fn = lambda p, xx: stage_fn(p, xx, key)  # noqa: E731
+            return fn if aux_on else (lambda p, xx: (fn(p, xx), ()))
+
+        def head(m, y):
+            aux_mb = jax.tree_util.tree_map(
+                lambda a: jax.lax.dynamic_index_in_dim(
+                    a, m, 0, keepdims=False), auxm)
+            val, vjp_fn, met = jax.vjp(
+                lambda lp, yy: last_fn(lp, yy, aux_mb), last_p, y,
+                has_aux=True)
+            dlast, dy = vjp_fn(jnp.asarray(scale, val.dtype))
+            return val, met, dlast, dy
+
+        zero_dp = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        zero_dlast = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), last_p)
+        if aux_on:
+            aux_abs = jax.eval_shape(
+                lambda: with_key(0, 0)(chunk_params(0), xm[0])[1])
+            zero_aux = jax.tree_util.tree_map(
+                lambda a: jnp.zeros(a.shape, a.dtype), aux_abs)
+            aux_seed = jax.tree_util.tree_map(
+                lambda w, a: jnp.asarray(w, a.dtype),
+                stage_aux_cotangent, zero_aux)
+        else:
+            zero_aux, aux_seed = (), ()
+        met_abs = jax.eval_shape(
+            lambda lp, yy, am: last_fn(lp, yy, am)[1], last_p, xm[0],
+            jax.tree_util.tree_map(lambda a: a[0], auxm))
+        zero_met = jax.tree_util.tree_map(
+            lambda a: jnp.zeros(a.shape, a.dtype), met_abs)
+
+        def tick(carry, t):
+            (fwd_msgs, bwd_msgs, stash, dp_acc, dlast_acc, dx_buf,
+             val_acc, met_acc, aux_acc) = carry
+
+            # ---- forward slots: chunk v runs microbatch t - (v*S+s).
+            y_stack = jnp.zeros_like(fwd_msgs)
+            head_dy = jnp.zeros_like(xm[0])
+            for v in range(V):
+                mf = t - (v * S + s)
+                mf_valid = jnp.logical_and(mf >= 0, mf < M)
+                mf_c = jnp.clip(mf, 0, M - 1)
+                # Virtual stage 0 (chunk 0, device 0) ingests fresh
+                # microbatches; every other virtual stage eats the
+                # message its predecessor pushed last tick.
+                inp = fwd_msgs[v]
+                if v == 0:
+                    feed = jax.lax.dynamic_index_in_dim(
+                        xm, mf_c, 0, keepdims=False)
+                    inp = jnp.where(s == 0, feed, inp)
+                cp = chunk_params(v)
+
+                def fwd_run(inp, stash, v=v, mf_c=mf_c, cp=cp):
+                    slot = jnp.mod(mf_c, D)
+                    y, aux_v = with_key(v, mf_c)(cp, inp)
+                    st = jax.lax.dynamic_update_index_in_dim(
+                        stash[v], inp, slot, 0)
+                    return y, aux_v, st
+
+                def fwd_skip(inp, stash, v=v):
+                    return jnp.zeros_like(inp), zero_aux, stash[v]
+
+                if bubble == "cond":
+                    y, aux_v, st_v = jax.lax.cond(mf_valid, fwd_run,
+                                                  fwd_skip, inp, stash)
+                else:
+                    y_r, aux_r, st_r = fwd_run(inp, stash)
+                    y = _select_tree(mf_valid, y_r,
+                                     jnp.zeros_like(inp))
+                    aux_v = _select_tree(mf_valid, aux_r, zero_aux)
+                    st_v = _select_tree(mf_valid, st_r, stash[v])
+                stash = stash.at[v].set(st_v)
+                y_stack = y_stack.at[v].set(y)
+                aux_acc = jax.tree_util.tree_map(
+                    lambda a, b: a + b, aux_acc, aux_v)
+
+                if v == V - 1:
+                    # Loss head at the final virtual stage; its dy
+                    # seeds the SAME tick's chunk-(V-1) backward.
+                    take_head = jnp.logical_and(is_last, mf_valid)
+
+                    def head_run(y, mf_c=mf_c):
+                        return head(mf_c, y)
+
+                    def head_skip(y):
+                        return (jnp.zeros((), jnp.float32), zero_met,
+                                zero_dlast, jnp.zeros_like(y))
+
+                    if bubble == "cond":
+                        hval, hmet, hdlast, hdy = jax.lax.cond(
+                            take_head, head_run, head_skip, y)
+                    else:
+                        hval, hmet, hdlast, hdy = _select_tree(
+                            take_head, head_run(y),
+                            (jnp.zeros((), jnp.float32), zero_met,
+                             zero_dlast, jnp.zeros_like(y)))
+                    val_acc = val_acc + hval
+                    met_acc = jax.tree_util.tree_map(
+                        lambda a, b: a + b.astype(a.dtype), met_acc,
+                        hmet)
+                    dlast_acc = jax.tree_util.tree_map(
+                        lambda a, b: a + b.astype(a.dtype), dlast_acc,
+                        hdlast)
+                    head_dy = hdy
+
+            # ---- backward slots: chunk v runs t - 2(Sv-1) + (v*S+s).
+            dx_stack = jnp.zeros_like(bwd_msgs)
+            for v in range(V):
+                j = v * S + s
+                mbk = t - 2 * (Sv - 1) + j
+                b_valid = jnp.logical_and(mbk >= 0, mbk < M)
+                mb_c = jnp.clip(mbk, 0, M - 1)
+                cot_in = bwd_msgs[v]
+                if v == V - 1:
+                    cot_in = jnp.where(is_last, head_dy, cot_in)
+                cp = chunk_params(v)
+
+                def bwd_run(stash, cot, v=v, mb_c=mb_c, cp=cp):
+                    slot = jnp.mod(mb_c, D)
+                    x_saved = jax.lax.dynamic_index_in_dim(
+                        stash[v], slot, 0, keepdims=False)
+                    _, vjp_fn = jax.vjp(with_key(v, mb_c), cp, x_saved)
+                    return vjp_fn((cot.astype(x_saved.dtype), aux_seed))
+
+                def bwd_skip(stash, cot, v=v):
+                    return (jax.tree_util.tree_map(
+                        lambda p: jnp.zeros(p.shape, p.dtype),
+                        chunk_params(v)), jnp.zeros_like(xm[0]))
+
+                if bubble == "cond":
+                    dp, dx = jax.lax.cond(b_valid, bwd_run, bwd_skip,
+                                          stash, cot_in)
+                else:
+                    dp_r, dx_r = bwd_run(stash, cot_in)
+                    dp = _select_tree(
+                        b_valid, dp_r,
+                        jax.tree_util.tree_map(jnp.zeros_like, dp_r))
+                    dx = _select_tree(b_valid, dx_r,
+                                      jnp.zeros_like(xm[0]))
+                dp_acc = jax.tree_util.tree_map(
+                    lambda a, b, v=v: a.at[v].add(b.astype(a.dtype)),
+                    dp_acc, dp)
+                dx_stack = dx_stack.at[v].set(dx)
+                if v == 0:
+                    take_dx = jnp.logical_and(b_valid, s == 0)
+                    prev_dx = jax.lax.dynamic_index_in_dim(
+                        dx_buf, mb_c, 0, keepdims=False)
+                    dx_buf = jax.lax.dynamic_update_index_in_dim(
+                        dx_buf, jnp.where(take_dx,
+                                          dx.astype(dx_buf.dtype),
+                                          prev_dx), mb_c, 0)
+
+            # ---- ring hops: the stacked activations go down, the
+            # stacked cotangents up; the wrap shifts chunk slots
+            # (j -> j+1 crosses S-1 -> 0 into the NEXT chunk; the
+            # reverse for cotangents).
+            if S > 1:
+                recv = jax.lax.ppermute(y_stack, AXIS_PIPE, down)
+                fwd_msgs = jnp.where(s == 0, jnp.roll(recv, 1, axis=0),
+                                     recv)
+                recv_up = jax.lax.ppermute(dx_stack, AXIS_PIPE, up)
+                bwd_msgs = jnp.where(s == S - 1,
+                                     jnp.roll(recv_up, -1, axis=0),
+                                     recv_up)
+            else:
+                # S == 1: every hop is the intra-device chunk handoff.
+                fwd_msgs = jnp.roll(y_stack, 1, axis=0)
+                bwd_msgs = jnp.roll(dx_stack, -1, axis=0)
+            return (fwd_msgs, bwd_msgs, stash, dp_acc, dlast_acc,
+                    dx_buf, val_acc, met_acc, aux_acc), None
+
+        zero_msgs = jnp.zeros((V,) + xm[0].shape, xm.dtype)
+        stash0 = jnp.zeros((V, D) + xm[0].shape, xm.dtype)
+        carry0 = (zero_msgs, zero_msgs, stash0, zero_dp, zero_dlast,
+                  jnp.zeros((M,) + xm[0].shape, x.dtype),
+                  jnp.zeros((), jnp.float32), zero_met, zero_aux)
+        T = M + 2 * (Sv - 1)
+        (_, _, _, dp_acc, dlast_acc, dx_buf, val_acc, met_acc,
+         aux_acc), _ = jax.lax.scan(tick, carry0, jnp.arange(T))
+
+        dlast_acc = jax.lax.psum(dlast_acc, AXIS_PIPE)
+        dx_out = jax.lax.psum(dx_buf, AXIS_PIPE).reshape(B, *x.shape[1:])
+        val_acc = jax.lax.psum(val_acc, AXIS_PIPE)
+        met_acc = jax.lax.psum(met_acc, AXIS_PIPE)
+        aux_out = jax.tree_util.tree_map(
+            lambda a: jax.lax.psum(a, AXIS_PIPE), aux_acc)
+        dp_out = jax.tree_util.tree_map(lambda g: g[None], dp_acc)
+        return dp_out, dlast_acc, dx_out, val_acc, met_acc, aux_out
+
+    dp, dlast, dx, val, met, aux_sums = jax.shard_map(
+        per_pipe, mesh=mesh, axis_names={AXIS_PIPE},
+        in_specs=(P(AXIS_PIPE), P(), P(), P(), P()),
+        out_specs=(P(AXIS_PIPE), P(), P(), P(), P(), P()),
+        check_vma=False)(stage_params, last_params, x, aux,
+                         cotangent_scale)
+    if stage_aux_cotangent is not None:
+        return val, met, aux_sums, (dp, dlast, dx)
+    return val, met, (dp, dlast, dx)
+
+
+def stack_stage_params(layer_params: Any, num_stages: int,
+                       virtual: int = 1) -> Any:
+    """[n_layers, ...] stacked layer params -> stage-major grouping.
+
+    ``virtual == 1``: [S, layers_per_stage, ...] — stage s owns layers
+    [s*Lps, (s+1)*Lps). ``virtual > 1`` (interleaved 1F1B): [S, V,
+    Lps, ...] — virtual stage j = v*S + s owns layers [j*Lps,
+    (j+1)*Lps), i.e. device s holds V non-contiguous depth chunks
+    (Megatron's interleaved assignment). The v-major-in-j order makes
+    the [S*V] -> [V, S] reshape direct; the transpose puts the
+    device-sharded S dim first."""
     def regroup(p):
         n = p.shape[0]
-        if n % num_stages:
+        if n % (num_stages * virtual):
             raise ValueError(
-                f"{n} layers not divisible by {num_stages} stages")
-        return p.reshape(num_stages, n // num_stages, *p.shape[1:])
+                f"{n} layers not divisible by {num_stages} stages"
+                + (f" x {virtual} virtual chunks" if virtual > 1
+                   else ""))
+        lps = n // (num_stages * virtual)
+        if virtual == 1:
+            return p.reshape(num_stages, lps, *p.shape[1:])
+        return p.reshape(virtual, num_stages, lps,
+                         *p.shape[1:]).swapaxes(0, 1)
     return jax.tree_util.tree_map(regroup, layer_params)

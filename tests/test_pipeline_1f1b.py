@@ -254,6 +254,138 @@ def test_1f1b_composes_with_tp(devices8):
     np.testing.assert_allclose(losses[0], losses[1], rtol=1e-5)
 
 
+def _layer_major(blocks, V):
+    """Stage-stacked block leaves -> layer-major [n_layers, ...] so
+    plain ([S, lps]) and interleaved ([S, V, lps], virtual stage
+    j = v*S + s) layouts compare directly."""
+    def one(p):
+        if V == 1:
+            return p.reshape(p.shape[0] * p.shape[1], *p.shape[2:])
+        q = jnp.swapaxes(p, 0, 1)  # [V, S, lps, ...]; [v, s] = j=v*S+s
+        return q.reshape(q.shape[0] * q.shape[1] * q.shape[2],
+                         *q.shape[3:])
+    return jax.tree_util.tree_map(one, blocks)
+
+
+def test_interleaved_1f1b_matches_plain(devices8):
+    """Interleaved virtual stages (VERDICT r4 item 4): the [S, V, lps]
+    regrouping is a LAYOUT, not a math change. With the same per-layer
+    weights (same init keys — regrouping happens after the per-layer
+    vmap), the V=2 single-scan interleaved schedule must reproduce the
+    plain 1F1B step: loss, accuracy, grad norm, and updated params
+    (compared layer-major)."""
+    mesh = make_mesh(MeshConfig(data=2, pipe=2), devices8[:4])
+    kw = dict(n_layers=4, max_len=16, dropout_rate=0.0,
+              compute_dtype=jnp.float32, use_flash=False)
+    m_p = pipelined_lm(mesh, num_microbatches=8, **kw)
+    m_i = pipelined_lm(mesh, num_microbatches=8, virtual_stages=2, **kw)
+    sample = np.zeros((2, 16), np.int32)
+    s_p = create_train_state(m_p, optax.adam(1e-2), sample, mesh)
+    s_i = create_train_state(m_i, optax.adam(1e-2), sample, mesh)
+    # Identical underlying layer weights despite different stackings.
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)),
+        _layer_major(s_p.params["blocks"], 1),
+        _layer_major(s_i.params["blocks"], 2))
+
+    ds = synthetic_clm(n=32, seq_len=16, vocab_size=64)
+    batch = shard_batch(mesh, ds.batch(np.arange(16)), seq_axis=1)
+
+    # Forward parity too (the GPipe/eval path chains V pipeline
+    # passes over the chunk groups).
+    lp = jax.jit(lambda v, t: m_p.apply(v, t))(
+        {"params": s_p.params}, batch["tokens"])
+    li = jax.jit(lambda v, t: m_i.apply(v, t))(
+        {"params": s_i.params}, batch["tokens"])
+    np.testing.assert_allclose(np.asarray(li), np.asarray(lp),
+                               atol=2e-5, rtol=2e-4)
+
+    step_p = make_1f1b_train_step(m_p, mesh, donate=False,
+                                  grad_norm_metric=True)
+    step_i = make_1f1b_train_step(m_i, mesh, donate=False,
+                                  grad_norm_metric=True)
+    st_p, met_p = step_p(s_p, batch)
+    st_i, met_i = step_i(s_i, batch)
+    np.testing.assert_allclose(float(met_i["loss"]),
+                               float(met_p["loss"]), rtol=1e-5)
+    np.testing.assert_allclose(float(met_i["accuracy"]),
+                               float(met_p["accuracy"]), rtol=1e-6)
+    np.testing.assert_allclose(float(met_i["grad_norm"]),
+                               float(met_p["grad_norm"]), rtol=1e-4)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-6, rtol=1e-4),
+        _layer_major(st_p.params["blocks"], 1),
+        _layer_major(st_i.params["blocks"], 2))
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-6, rtol=1e-4),
+        st_p.params["shell"], st_i.params["shell"])
+
+
+@pytest.mark.slow
+def test_interleaved_ring_matches_plain(devices8):
+    """The full composition stack: interleaved virtual stages x ring
+    attention (pipe=2 x seq=2 x V=2) — the interleaved schedule's
+    where-masked bubble mode (seq collectives can't live under
+    cond-skipped branches) must reproduce plain 1F1B on the same
+    mesh."""
+    mesh = make_mesh(MeshConfig(pipe=2, seq=2), devices8[:4])
+    kw = dict(n_layers=4, max_len=16, dropout_rate=0.0,
+              compute_dtype=jnp.float32, use_flash=False,
+              pos_emb="rope")
+    m_p = pipelined_lm(mesh, num_microbatches=4, **kw)
+    m_i = pipelined_lm(mesh, num_microbatches=4, virtual_stages=2, **kw)
+    sample = np.zeros((2, 16), np.int32)
+    s_p = create_train_state(m_p, optax.adam(1e-2), sample, mesh)
+    s_i = create_train_state(m_i, optax.adam(1e-2), sample, mesh)
+    ds = synthetic_clm(n=32, seq_len=16, vocab_size=64)
+    batch = shard_batch(mesh, ds.batch(np.arange(16)), seq_axis=1)
+    step_p = make_1f1b_train_step(m_p, mesh, donate=False)
+    step_i = make_1f1b_train_step(m_i, mesh, donate=False)
+    _, met_p = step_p(s_p, batch)
+    _, met_i = step_i(s_i, batch)
+    np.testing.assert_allclose(float(met_i["loss"]),
+                               float(met_p["loss"]), rtol=1e-5)
+    np.testing.assert_allclose(float(met_i["accuracy"]),
+                               float(met_p["accuracy"]), rtol=1e-6)
+
+
+def test_interleaved_cli_end_to_end(devices8):
+    """--pipeline-virtual-stages 2 trains through the full loop."""
+    from tensorflow_distributed_tpu.train.loop import train
+
+    cfg = TrainConfig(model="pipelined_lm", model_size="tiny",
+                      dataset="synthetic", batch_size=16, train_steps=3,
+                      eval_every=0, log_every=0, eval_batch_size=16,
+                      compute_dtype="float32", pipeline_schedule="1f1b",
+                      pipeline_virtual_stages=2,
+                      pipeline_microbatches=4,
+                      mesh=MeshConfig(data=4, pipe=2))
+    cfg.validate()
+    result = train(cfg)
+    assert np.isfinite(result.final_metrics["loss"])
+
+
+def test_interleaved_config_walls():
+    """virtual stages: rejected off-family, with stash backward, and
+    with too few microbatches."""
+    with pytest.raises(ValueError, match="pipelined_lm"):
+        TrainConfig(model="gpt_lm",
+                    pipeline_virtual_stages=2).validate()
+    with pytest.raises(ValueError, match="recompute"):
+        TrainConfig(model="pipelined_lm", pipeline_schedule="1f1b",
+                    pipeline_virtual_stages=2,
+                    pipeline_backward="stash",
+                    mesh=MeshConfig(pipe=2)).validate()
+    with pytest.raises(ValueError, match="virtual"):
+        TrainConfig(model="pipelined_lm", pipeline_schedule="1f1b",
+                    pipeline_virtual_stages=4,
+                    pipeline_microbatches=4, batch_size=32,
+                    mesh=MeshConfig(pipe=2)).validate()
+
+
 @pytest.mark.slow
 def test_1f1b_trains_end_to_end(devices8):
     """The full loop with pipeline_schedule=1f1b learns the synthetic
