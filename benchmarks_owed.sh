@@ -6,6 +6,13 @@
 set -x
 cd "$(dirname "$0")"
 
+# 0. Settle the BENCH_r04 (43,183) vs BENCH_r02 (49,976 img/s/chip)
+#    regression: three back-to-back runs so the spread distinguishes
+#    tunnel variance from a code regression (VERDICT r4 item 1).
+for i in 1 2 3; do
+  timeout 580 python bench.py > "BENCH_r05_run${i}.json" 2>/dev/null
+done
+
 # 1. Scatter-dispatch MoE A/B (dense dispatch einsums measured at ~25%
 #    of step FLOPs — the scatter path skips them entirely).
 timeout 580 python -m tensorflow_distributed_tpu.benchmarks.moebench \

@@ -12,11 +12,11 @@ import jax, jax.numpy as jnp
 x = jnp.ones((256, 256), jnp.float32)
 assert float(jax.jit(lambda a: (a @ a).sum())(x)) == 256.0 * 256 * 256
 " >/dev/null 2>&1; then
-    echo "$ts probe_ok (jit matmul + readback)" >> TPU_PROBES_r04.log
+    echo "$ts probe_ok (jit matmul + readback)" >> TPU_PROBES_r05.log
     bash benchmarks_owed.sh > owed_run.log 2>&1
-    echo "$(date -u +%FT%TZ) owed_run_done rc=$?" >> TPU_PROBES_r04.log
+    echo "$(date -u +%FT%TZ) owed_run_done rc=$?" >> TPU_PROBES_r05.log
     exit 0
   fi
-  echo "$ts probe_fail (120s, no compute readback)" >> TPU_PROBES_r04.log
+  echo "$ts probe_fail (120s, no compute readback)" >> TPU_PROBES_r05.log
   sleep 600
 done
