@@ -137,6 +137,80 @@ def run_fusedce_scenario(fetch):
             "fusedce_checksum": checksum}
 
 
+def run_r5_scenarios(fetch):
+    """Round-5 composition scenarios across the process boundary —
+    shared worker/oracle definition (same pattern as
+    run_xaxes_scenarios).
+
+    ring-in-pipe: data=1/pipe=4/seq=2 — with 2 processes x 4 devices
+    the PIPE axis crosses the boundary, so the 1F1B schedule's
+    per-tick ppermutes (where-masked bubbles: the stage carries the
+    ring's seq collectives) hop DCN while the nested ring's seq
+    ppermutes run inside each process.
+
+    zero1-pipe: data=2/pipe=4 — the DATA axis crosses the boundary,
+    so the ZeRO-1 slot shards and the update's restore-layout
+    allgather span processes while the schedule runs intra-process.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tensorflow_distributed_tpu.config import MeshConfig
+    from tensorflow_distributed_tpu.data.lm import synthetic_clm
+    from tensorflow_distributed_tpu.models.pipelined import pipelined_lm
+    from tensorflow_distributed_tpu.parallel.mesh import make_mesh
+    from tensorflow_distributed_tpu.parallel.sharding import shard_batch
+    from tensorflow_distributed_tpu.train.pipeline_step import (
+        make_1f1b_train_step)
+    from tensorflow_distributed_tpu.train.state import create_train_state
+
+    ds = synthetic_clm(n=64, seq_len=16, vocab_size=64, seed=0)
+
+    def checksum(params):
+        return float(sum(abs(x).sum()
+                         for x in jax.tree_util.tree_leaves(fetch(params))))
+
+    def run(mesh, model, step):
+        state = create_train_state(model, optax.adam(1e-3),
+                                   np.zeros((2, 16), np.int32), mesh)
+        for i in range(3):
+            state, m = step(state, shard_batch(
+                mesh, ds.batch(np.arange(16 * i, 16 * (i + 1))),
+                seq_axis=1))
+        return float(jax.device_get(m["loss"])), checksum(state.params)
+
+    mesh_rs = make_mesh(MeshConfig(data=1, pipe=4, seq=2))
+    model_rs = pipelined_lm(mesh_rs, num_microbatches=4, n_layers=4,
+                            max_len=16, use_flash=False, pos_emb="rope",
+                            compute_dtype=jnp.float32, dropout_rate=0.0)
+    ring_loss, ring_sum = run(
+        mesh_rs, model_rs, make_1f1b_train_step(model_rs, mesh_rs,
+                                                donate=False))
+
+    mesh_z = make_mesh(MeshConfig(data=2, pipe=4))
+    model_z = pipelined_lm(mesh_z, num_microbatches=4, n_layers=4,
+                           max_len=16, use_flash=False,
+                           compute_dtype=jnp.float32, dropout_rate=0.0)
+    state_z = create_train_state(model_z, optax.adam(1e-3),
+                                 np.zeros((2, 16), np.int32), mesh_z,
+                                 opt_fsdp=True, fsdp_min_size=1024)
+    pos_z = jax.tree_util.tree_map(lambda a: a.sharding, state_z.params)
+    step_z = make_1f1b_train_step(model_z, mesh_z, donate=False,
+                                  params_out_shardings=pos_z)
+    for i in range(3):
+        state_z, m_z = step_z(state_z, shard_batch(
+            mesh_z, ds.batch(np.arange(16 * i, 16 * (i + 1))),
+            seq_axis=1))
+    zero1_loss = float(jax.device_get(m_z["loss"]))
+    zero1_sum = checksum(state_z.params)
+
+    return {"ring_pipe_loss": ring_loss, "ring_pipe_checksum": ring_sum,
+            "zero1_pipe_loss": zero1_loss,
+            "zero1_pipe_checksum": zero1_sum}
+
+
 def main() -> None:
     out_path = sys.argv[1]
     import jax
@@ -168,6 +242,14 @@ def main() -> None:
         bootstrap()
         with open(out_path, "w") as f:
             json.dump(run_fusedce_scenario(_fetch_host), f)
+        return
+    if phase == "r5":
+        from tensorflow_distributed_tpu.parallel.mesh import bootstrap
+        from tensorflow_distributed_tpu.train.checkpoint import _fetch_host
+
+        bootstrap()
+        with open(out_path, "w") as f:
+            json.dump(run_r5_scenarios(_fetch_host), f)
         return
     if phase == "orbax":
         # Orbax checkpointing with FSDP params sharded ACROSS the

@@ -311,6 +311,33 @@ def test_pipeline_and_expert_axes_across_processes(tmp_path_factory):
                                    err_msg=key)
 
 
+def test_r5_compositions_across_processes(tmp_path_factory):
+    """Round-5 compositions with their new collectives spanning the
+    process boundary: ring-inside-the-pipeline (pipe hops cross DCN
+    while the nested ring runs per-process) and ZeRO-1 x 1F1B (slot
+    shards + the restore-layout allgather cross processes). Must match
+    the single-process oracle running THE SAME scenario definition."""
+    tmp = tmp_path_factory.mktemp("multihost_r5")
+    results, _ = _launch_cluster(tmp, tmp / "ckpt", "r5",
+                                 extra_env={"MH_PHASE": "r5"})
+    a, b = results
+    assert a == b  # SPMD: both processes computed identical results
+
+    import importlib.util
+
+    import jax
+
+    spec = importlib.util.spec_from_file_location(
+        "multihost_worker",
+        os.path.join(REPO, "tests", "multihost_worker.py"))
+    worker_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(worker_mod)
+    oracle = worker_mod.run_r5_scenarios(jax.device_get)
+    for key, got in a.items():
+        np.testing.assert_allclose(got, oracle[key], rtol=1e-4,
+                                   err_msg=key)
+
+
 def test_fused_ce_kernel_across_processes(tmp_path_factory):
     """The fused-CE Pallas path with its loss reductions spanning the
     process boundary: the dispatcher's shard_map psums ce/correct/mask
