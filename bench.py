@@ -13,6 +13,7 @@ multi-chip run and exactly apples-to-oranges-free on one chip.
 
 from __future__ import annotations
 
+import argparse
 import json
 import time
 
@@ -21,7 +22,13 @@ import numpy as np
 REFERENCE_AGG_IMAGES_PER_SEC = 52.0  # BASELINE.md "derived throughput"
 
 
-def main() -> None:
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--out", default="",
+                        help="also write the record to this JSONL file "
+                        "(observe.registry format; summarizable "
+                        "artifacts, not scraped stdout)")
+    args = parser.parse_args(argv)
     import jax
     import optax
 
@@ -104,12 +111,16 @@ def main() -> None:
     steps = dispatches * K
     images_per_sec = steps * global_batch / dt
     per_chip = images_per_sec / n_dev
-    print(json.dumps({
+    record = {
         "metric": "mnist_cnn_train_images_per_sec_per_chip",
         "value": round(per_chip, 1),
         "unit": "images/sec/chip",
         "vs_baseline": round(per_chip / REFERENCE_AGG_IMAGES_PER_SEC, 2),
-    }))
+    }
+    print(json.dumps(record))
+    if args.out:
+        from tensorflow_distributed_tpu.observe.registry import write_jsonl
+        write_jsonl(args.out, [record])
 
 
 if __name__ == "__main__":

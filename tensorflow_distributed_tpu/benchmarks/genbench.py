@@ -130,13 +130,13 @@ def main(argv=None) -> None:
             lines += bench("_gqa_kvq", n_kv_heads=args.n_kv_heads,
                            kv_cache_quant=args.kv_cache_quant)
 
-    out = "\n".join(json.dumps(ln) for ln in lines)
-    print(out)
+    print("\n".join(json.dumps(ln) for ln in lines))
     if args.out:
         # Overwrite like the sibling benchmarks: reruns replace, never
-        # silently accumulate stale lines.
-        with open(args.out, "w") as f:
-            f.write(out + "\n")
+        # silently accumulate stale lines (observe.registry owns the
+        # JSONL format).
+        from tensorflow_distributed_tpu.observe.registry import write_jsonl
+        write_jsonl(args.out, lines)
 
 
 if __name__ == "__main__":

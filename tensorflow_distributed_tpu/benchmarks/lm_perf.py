@@ -37,52 +37,12 @@ import argparse
 import json
 import time
 
-# Chip bf16 peaks for MFU. Only kinds we can meet in this environment;
-# unknown kinds report mfu as None rather than a made-up number.
-PEAK_BF16_FLOPS = {
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,   # v5e
-    "TPU v5e": 197e12,
-    "TPU v5": 459e12,        # v5p
-    "TPU v6 lite": 918e12,   # v6e / Trillium
-}
-
-
-def matmul_params(params) -> int:
-    """Parameters that participate in matmuls: every kernel of ndim >= 2
-    except the embedding tables (lookups, not matmuls)."""
-    import jax
-
-    total = 0
-    for path, leaf in jax.tree_util.tree_leaves_with_path(params):
-        name = jax.tree_util.keystr(path)
-        if leaf.ndim >= 2 and "emb" not in name:
-            total += leaf.size
-    return total
-
-
-def attn_flops_per_token_fwd(cfg) -> float:
-    """QK^T + PV FLOPs per token, one forward: 4 * d_model * (average
-    attended length) per layer. Full bidirectional attends L; causal
-    ~L/2 (the kernel skips masked blocks); sliding-window attends
-    min(W, pos+1) — the windowed kernel skips out-of-band blocks, so
-    MFU keeps counting only useful work."""
-    L = cfg.max_len
-    per_len = 4.0 * cfg.d_model * cfg.n_layers
-    if not cfg.causal:
-        return per_len * L
-    W = getattr(cfg, "attn_window", 0) or 0
-    if W and W < L:
-        avg = (W * (W + 1) / 2.0 + (L - W) * W) / L
-    else:
-        avg = L / 2.0
-    return per_len * avg
-
-
-def flops_per_token(params, cfg) -> float:
-    """Model FLOPs per trained token, fwd+bwd (see module docstring)."""
-    n = matmul_params(params)
-    return 3.0 * (2.0 * n + attn_flops_per_token_fwd(cfg))
+# FLOP accounting and chip peaks live in observe.mfu (the unified
+# observability subsystem) — re-exported here so the historical
+# benchmark import surface keeps working.
+from tensorflow_distributed_tpu.observe.mfu import (  # noqa: F401
+    PEAK_BF16_FLOPS, attn_flops_per_token_fwd, flops_per_token,
+    matmul_params, pipelined_hw_flops_per_token)
 
 
 def _build(size: str, seq_len: int, use_flash: bool, remat: str,
@@ -274,9 +234,7 @@ def main(argv=None) -> None:
         # hardware utilization too so the schedule's remat trade isn't
         # misread as MXU inefficiency; model MFU stays the headline
         # (useful work per second).
-        blocks_n = matmul_params(state.params["blocks"])
-        hw_fpt = fpt + 2.0 * blocks_n + attn_flops_per_token_fwd(
-            model.cfg)
+        hw_fpt = pipelined_hw_flops_per_token(state.params, model.cfg)
         hw_mfu = tok_s * hw_fpt / (peak * n_dev)
         lines.append({"metric": "lm_train_hw_mfu",
                       "value": round(100 * hw_mfu, 2), "unit": "%",
@@ -308,11 +266,10 @@ def main(argv=None) -> None:
             "value": round(dt_x / dt, 3), "unit": "x",
             "xla_tokens_per_sec": round(tokens / dt_x, 1), **meta})
 
-    out = "\n".join(json.dumps(l) for l in lines)
-    print(out)
+    print("\n".join(json.dumps(l) for l in lines))
     if args.out:
-        with open(args.out, "w") as f:
-            f.write(out + "\n")
+        from tensorflow_distributed_tpu.observe.registry import write_jsonl
+        write_jsonl(args.out, lines)
 
 
 if __name__ == "__main__":

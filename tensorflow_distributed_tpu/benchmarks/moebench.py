@@ -30,26 +30,17 @@ import argparse
 import json
 import math
 
-from tensorflow_distributed_tpu.benchmarks.lm_perf import (
-    PEAK_BF16_FLOPS, _timed_steps, attn_flops_per_token_fwd)
+from tensorflow_distributed_tpu.benchmarks.lm_perf import _timed_steps
+from tensorflow_distributed_tpu.observe.mfu import (
+    PEAK_BF16_FLOPS, flops_per_token)
 
 
 def moe_active_flops_per_token(params, cfg) -> float:
     """fwd+bwd FLOPs per token with expert matmuls charged at K/E
-    (each token visits top_k of num_experts experts)."""
-    import jax
-
-    scale_frac = cfg.moe_top_k / cfg.moe_experts
-    total = 0.0
-    for path, leaf in jax.tree_util.tree_leaves_with_path(params):
-        name = jax.tree_util.keystr(path)
-        if leaf.ndim < 2 or "emb" in name:
-            continue
-        if "moe_mlp" in name and ("wi" in name or "wo" in name):
-            total += leaf.size * scale_frac
-        else:
-            total += leaf.size
-    return 3.0 * (2.0 * total + attn_flops_per_token_fwd(cfg))
+    (each token visits top_k of num_experts experts). Thin alias over
+    observe.mfu.flops_per_token, which owns the MoE active-FLOPs
+    accounting (cfg carries moe_experts/moe_top_k)."""
+    return flops_per_token(params, cfg)
 
 
 def dispatch_bytes(seq: int, experts: int, top_k: int,

@@ -47,6 +47,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         table = result.logger.performance_table(cfg.learning_rate)
         if table.count("\n"):
             print(table)
+        # Point at the observe/ artifacts this run produced.
+        if cfg.observe.metrics_jsonl:
+            print(f"[observe] metrics: {cfg.observe.metrics_jsonl} "
+                  f"(summarize: python -m "
+                  f"tensorflow_distributed_tpu.observe.report "
+                  f"{cfg.observe.metrics_jsonl})")
+        if cfg.observe.trace:
+            print(f"[observe] host trace: {cfg.observe.trace} "
+                  f"(open at https://ui.perfetto.dev)")
     return 0
 
 

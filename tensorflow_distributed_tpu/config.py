@@ -65,6 +65,50 @@ class MeshConfig:
 
 
 @dataclasses.dataclass
+class ObserveConfig:
+    """The observe/ subsystem's knobs (see observe package docs and the
+    README "Observability" section). All off by default — the loop pays
+    nothing unless a sink or trace path is configured."""
+
+    # JSONL metrics sink: one JSON object per event (step records carry
+    # the step-time breakdown and throughput/MFU fields). The durable
+    # artifact format; summarize with
+    # ``python -m tensorflow_distributed_tpu.observe.report <path>``.
+    metrics_jsonl: str = ""
+    # CSV sink: step records only, buffered and written on exit with a
+    # union-of-keys header (late columns like mfu still get a column).
+    # Convenience format — JSONL is the lossless, crash-durable one.
+    metrics_csv: str = ""
+    # Chrome-trace (Perfetto-compatible) JSON of HOST phases — data
+    # wait, dispatch, device wait, eval, checkpoint, restore, drain.
+    # Pure Python: works even when jax.profiler / the TPU tunnel is
+    # down. Open at https://ui.perfetto.dev or chrome://tracing.
+    trace: str = ""
+    # Per-device peak TFLOP/s for MFU. 0 = auto-detect for known TPU
+    # generations (observe.mfu.PEAK_BF16_FLOPS); unknown devices omit
+    # MFU rather than invent a number.
+    peak_tflops: float = 0.0
+    # Rolling window (steps) for the p50/p95 step-time stats.
+    window: int = 200
+    # In-memory record ring-buffer cap (registry + MetricLogger) so
+    # multi-million-step runs don't grow host memory unboundedly.
+    max_records: int = 100_000
+
+    def validate(self) -> None:
+        if self.window < 1:
+            raise ValueError(
+                f"observe.window must be >= 1, got {self.window}")
+        if self.max_records < 1:
+            raise ValueError(
+                f"observe.max_records must be >= 1, "
+                f"got {self.max_records}")
+        if self.peak_tflops < 0:
+            raise ValueError(
+                f"observe.peak_tflops must be >= 0, "
+                f"got {self.peak_tflops}")
+
+
+@dataclasses.dataclass
 class TrainConfig:
     """Everything needed to run one training job, any model, any mesh."""
 
@@ -330,6 +374,12 @@ class TrainConfig:
     profile_dir: str = ""
     profile_start_step: int = 10
     profile_num_steps: int = 5
+
+    # --- observability ---------------------------------------------------
+    # Structured metrics/trace/goodput (observe/ package). CLI flags:
+    # --observe.metrics-jsonl, --observe.trace, --observe.peak-tflops...
+    observe: ObserveConfig = dataclasses.field(
+        default_factory=ObserveConfig)
 
     # --- misc ------------------------------------------------------------
     seed: int = 0
@@ -706,6 +756,7 @@ class TrainConfig:
         if self.mode == "eval" and not self.checkpoint_dir:
             raise ValueError("mode=eval requires checkpoint_dir")
         self.mesh.validate()
+        self.observe.validate()
 
 
 def _add_dataclass_args(parser: argparse.ArgumentParser, cls, prefix: str = "") -> None:

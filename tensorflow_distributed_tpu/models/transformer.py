@@ -202,6 +202,21 @@ def _auto_expert_axis(mesh, overrides) -> None:
         overrides.setdefault("moe_expert_axis", "expert")
 
 
+def _auto_tp_partitioning(mesh, overrides) -> None:
+    """Default TP metadata OFF when the mesh has no model axis to shard
+    over: the annotations are meaningless at mesh.model == 1 (the
+    pipelined variant already disables them for the same reason) and
+    flax-version skew can make the boxed with_sharding_constraint
+    reject them outright at init. shard_vocab keeps them (its vocab-
+    parallel embedding requires the metadata), as does an explicit
+    tp_partitioning override, and a mesh-less build keeps the factory
+    default (pure metadata, nothing constrains it)."""
+    if overrides.get("shard_vocab"):
+        return
+    if mesh is not None and dict(mesh.shape).get("model", 1) == 1:
+        overrides.setdefault("tp_partitioning", False)
+
+
 
 def _maybe_partitioned(cfg, names):
     """kernel_init with TP metadata, or plain when tp_partitioning=False
@@ -612,6 +627,7 @@ def bert_base_mlm(mesh: Optional[Mesh] = None, size: str = "base",
     """Factory for the registry. ``size``: "base" (BERT-base) or "tiny"
     (test scale); ``overrides`` are TransformerConfig fields."""
     _auto_expert_axis(mesh, overrides)
+    _auto_tp_partitioning(mesh, overrides)
     if size == "base":
         cfg = bert_base_config(**overrides)
     elif size == "tiny":
@@ -657,6 +673,7 @@ def gpt_lm(mesh: Optional[Mesh] = None, size: str = "small",
     — designed TPU-first like the rest of this family."""
     overrides["causal"] = True
     _auto_expert_axis(mesh, overrides)
+    _auto_tp_partitioning(mesh, overrides)
     if size in GPT2_SIZES:
         cfg = gpt2_small_config(**{**GPT2_SIZES[size], **overrides})
     elif size == "tiny":

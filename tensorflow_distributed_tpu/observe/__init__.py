@@ -1,0 +1,34 @@
+"""Unified observability: metrics registry, step-time breakdown, MFU
+accounting, Chrome-trace spans, and goodput.
+
+The reference repo's only observability was bare ``print()``
+timestamps and a hand-maintained 6-line ``performance`` file; this
+package turns every run into structured, comparable data:
+
+- :mod:`observe.registry` — one emission path, pluggable sinks
+  (stdout pretty-printer, JSONL, CSV), chief-only emission, host tags;
+- :mod:`observe.steptime` — per-step data-wait / dispatch / device
+  breakdown with rolling p50/p95;
+- :mod:`observe.mfu` — model-FLOPs estimates per family and
+  tokens/s / imgs/s / MFU accounting (the benchmarks import from here);
+- :mod:`observe.trace` — pure-Python Chrome-trace (Perfetto) spans for
+  host phases, no TPU runtime required;
+- :mod:`observe.goodput` — productive vs. restore/drain/blocked time;
+- :mod:`observe.hub` — the :class:`Observatory` the train loop drives;
+- :mod:`observe.report` — ``python -m ...observe.report metrics.jsonl``
+  summarizer.
+"""
+
+from tensorflow_distributed_tpu.observe.goodput import (  # noqa: F401
+    GoodputCounter)
+from tensorflow_distributed_tpu.observe.hub import Observatory  # noqa: F401
+from tensorflow_distributed_tpu.observe.mfu import (  # noqa: F401
+    PEAK_BF16_FLOPS, ThroughputAccountant, device_peak_flops,
+    flops_per_item, flops_per_token)
+from tensorflow_distributed_tpu.observe.registry import (  # noqa: F401
+    CsvSink, JsonlSink, MetricsRegistry, StdoutSink, config_hash,
+    host_tags, write_jsonl)
+from tensorflow_distributed_tpu.observe.steptime import (  # noqa: F401
+    StepTimeBreakdown)
+from tensorflow_distributed_tpu.observe.trace import (  # noqa: F401
+    ChromeTracer, load_trace)

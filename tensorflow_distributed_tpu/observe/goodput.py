@@ -1,0 +1,143 @@
+"""Goodput accounting: productive step time vs. everything else.
+
+A preemptible-TPU fleet's real throughput isn't steps/sec inside the
+steady loop — it's the fraction of wall time spent making forward
+progress once restores, checkpoint stalls, eval passes, and preemption
+drains are charged. This module keeps that ledger.
+
+Overhead accrues into named categories via ``account(category)``
+context managers. Nested accounting charges the OUTERMOST category
+only (a checkpoint taken inside a preemption drain is drain time, not
+double-counted), per thread. Background checkpoint writer threads are
+deliberately NOT accounted — overlapped IO costs no goodput; only the
+main thread's blocked time does (train/checkpoint.py wraps exactly
+those portions).
+
+The loop-facing object is :class:`GoodputCounter`; ``train.checkpoint``
+and ``train.preemption`` reach the live one through the module-level
+``set_active``/``account`` indirection so they stay importable (and
+free) outside a training run.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict, Iterator, Optional
+
+
+class GoodputCounter:
+    def __init__(self, clock=time.perf_counter):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self.overhead: Dict[str, float] = {}
+        self._t0 = clock()
+
+    def add(self, category: str, seconds: float) -> None:
+        with self._lock:
+            self.overhead[category] = (
+                self.overhead.get(category, 0.0) + seconds)
+
+    @contextlib.contextmanager
+    def account(self, category: str) -> Iterator[None]:
+        """Charge the block's wall time to ``category`` unless already
+        inside another accounted block on this thread."""
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        start = self._clock()
+        stack.append((category, start))
+        try:
+            yield
+        finally:
+            stack.pop()
+            if not stack:
+                self.add(category, self._clock() - start)
+
+    def charged(self) -> float:
+        """Total overhead seconds INCLUDING the elapsed portion of an
+        open outermost block on the calling thread (account() only
+        accrues at exit). Lets two snapshots bracket a window and
+        difference to exactly the overhead charged within it — the
+        preemption drain accounting (train.preemption) relies on this
+        when the notice lands mid-eval or mid-checkpoint.
+
+        DELIBERATELY LOCK-FREE: the preemption SIGTERM handler calls
+        this on the main thread, which may have been interrupted while
+        holding self._lock (e.g. mid-add) — acquiring the non-
+        reentrant lock there would deadlock. All accounting happens on
+        the main thread (background writer IO is unaccounted by
+        design), so a bare read between bytecodes is consistent under
+        the GIL."""
+        total = sum(self.overhead.values())
+        stack = getattr(self._local, "stack", None)
+        if stack:
+            total += self._clock() - stack[0][1]
+        return total
+
+    def summary(self, total_seconds: Optional[float] = None
+                ) -> Dict[str, float]:
+        """Goodput fraction over ``total_seconds`` (default: since the
+        counter was created): productive = total - accounted overhead."""
+        total = (total_seconds if total_seconds is not None
+                 else self._clock() - self._t0)
+        with self._lock:
+            overhead = dict(self.overhead)
+        spent = sum(overhead.values())
+        productive = max(total - spent, 0.0)
+        out = {f"{k}_seconds": round(v, 4) for k, v in overhead.items()}
+        out["total_seconds"] = round(total, 4)
+        out["productive_seconds"] = round(productive, 4)
+        out["goodput"] = round(productive / total, 4) if total > 0 else 0.0
+        return out
+
+
+# --- module-level indirection (train.checkpoint / train.preemption) -----
+
+_active: Optional[GoodputCounter] = None
+
+
+def set_active(counter: Optional[GoodputCounter]) -> None:
+    """Install the run's counter (the train loop does; tests may)."""
+    global _active
+    _active = counter
+
+
+def get_active() -> Optional[GoodputCounter]:
+    return _active
+
+
+@contextlib.contextmanager
+def account(category: str) -> Iterator[None]:
+    """Charge to the active counter; no-op when none is installed."""
+    counter = _active
+    if counter is None:
+        yield
+        return
+    with counter.account(category):
+        yield
+
+
+def add(category: str, seconds: float) -> None:
+    counter = _active
+    if counter is not None and seconds > 0:
+        counter.add(category, seconds)
+
+
+def accounted(category: str):
+    """Decorator form of :func:`account` — charges the wrapped call's
+    wall time to ``category`` on the active counter (no-op without
+    one). train.checkpoint uses it on its main-thread blocking entry
+    points."""
+    import functools
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with account(category):
+                return fn(*args, **kwargs)
+        return wrapper
+
+    return deco

@@ -1,0 +1,220 @@
+"""The Observatory: one object wiring the observe/ instruments into a run.
+
+The training loop (train/loop.py) drives it at four well-defined
+points per step — data fetch, async dispatch, blocking on the oldest
+in-flight step, cadence host work — and at the phase boundaries (eval,
+checkpoint, restore, preemption drain). Everything else (registry
+fan-out, Chrome-trace spans, rolling step-time stats, throughput/MFU
+windows, goodput ledger) happens here so the loop body stays thin.
+
+Fully inert when no sink, trace path, or CSV is configured: every
+method returns a null context or no-ops, so the loop calls them
+unconditionally.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Dict, Iterator, Optional
+
+from tensorflow_distributed_tpu.observe import goodput as goodput_mod
+from tensorflow_distributed_tpu.observe import mfu as mfu_mod
+from tensorflow_distributed_tpu.observe.goodput import GoodputCounter
+from tensorflow_distributed_tpu.observe.registry import (
+    CsvSink, JsonlSink, MetricsRegistry, host_tags)
+from tensorflow_distributed_tpu.observe.steptime import StepTimeBreakdown
+from tensorflow_distributed_tpu.observe.trace import ChromeTracer
+
+
+class Observatory:
+    """Run-scoped observability hub; build with :meth:`for_training`."""
+
+    def __init__(self, ocfg=None, *, chief: bool = True,
+                 tags: Optional[Dict[str, Any]] = None,
+                 accountant: Optional[mfu_mod.ThroughputAccountant] = None,
+                 items_per_step: float = 0.0,
+                 process_index: int = 0,
+                 append: bool = False,
+                 clock=time.perf_counter):
+        sinks = []
+        window, max_records, trace_path = 200, 100_000, ""
+        if ocfg is not None:
+            if ocfg.metrics_jsonl:
+                sinks.append(JsonlSink(ocfg.metrics_jsonl,
+                                       append=append))
+            if ocfg.metrics_csv:
+                sinks.append(CsvSink(ocfg.metrics_csv,
+                                     max_rows=ocfg.max_records))
+            window, max_records = ocfg.window, ocfg.max_records
+            trace_path = ocfg.trace
+        self.registry = MetricsRegistry(sinks, enabled=chief,
+                                        tags=tags or {},
+                                        max_records=max_records)
+        self.tracer = ChromeTracer(trace_path, pid=process_index,
+                                   enabled=chief,
+                                   process_name="tfd-train-host",
+                                   clock=clock)
+        # Active only when something consumes the output — the loop
+        # calls every hook unconditionally and relies on this gate.
+        self.active = bool(sinks) or self.tracer.enabled
+        self.steptime = StepTimeBreakdown(window=window, clock=clock)
+        self.goodput = GoodputCounter(clock=clock)
+        self.accountant = accountant or mfu_mod.ThroughputAccountant()
+        self.items_per_step = items_per_step
+        self._clock = clock
+        self._last_log: Optional[tuple] = None  # (step, clock)
+        if self.active:
+            goodput_mod.set_active(self.goodput)
+
+    # -- construction -----------------------------------------------------
+    @classmethod
+    def for_training(cls, cfg, mesh, task=None, model=None, params=None,
+                     chief: bool = True) -> "Observatory":
+        """Build from a TrainConfig + live mesh/task/model/params."""
+        import jax
+
+        seq = None
+        if task is not None and task.seq_axis is not None:
+            seq = int(task.sample_input.shape[task.seq_axis])
+        model_cfg = getattr(model, "cfg", None)
+        fpi, unit = mfu_mod.flops_per_item(cfg.model, params, model_cfg,
+                                           seq_len=seq)
+        peak_dev = (cfg.observe.peak_tflops * 1e12
+                    if cfg.observe.peak_tflops > 0
+                    else mfu_mod.device_peak_flops())
+        peak_total = peak_dev * len(jax.devices()) if peak_dev else None
+        accountant = mfu_mod.ThroughputAccountant(
+            flops_per_item=fpi, unit=unit, peak_flops_total=peak_total)
+        # A resumed (preempt-restart) run APPENDS to the prior leg's
+        # JSONL instead of truncating it — the pre-preemption records
+        # are the artifact's point. Keyed to an ACTUAL restore (the
+        # same condition train.loop restores under), not the flag
+        # alone: schedulers pass --resume on every leg, and the first
+        # leg of a fresh run must still replace a stale file.
+        append = False
+        if cfg.resume and cfg.checkpoint_dir:
+            from tensorflow_distributed_tpu.train.checkpoint import (
+                latest_step)
+            append = latest_step(cfg.checkpoint_dir) is not None
+        obs = cls(cfg.observe, chief=chief,
+                  tags=host_tags(mesh, cfg), accountant=accountant,
+                  items_per_step=float(cfg.batch_size) * (seq or 1),
+                  process_index=jax.process_index(), append=append)
+        obs.seq_len = seq
+        return obs
+
+    def note_step_fn(self, step_fn, params=None, model_cfg=None) -> None:
+        """Inspect the built step function for observability metadata:
+        a 1F1B step whose ``observe_hw_recompute`` attribute is set
+        (train.pipeline_step) executes ~4x-forward for the block stack,
+        so hw-MFU is reported alongside model MFU."""
+        if (getattr(step_fn, "observe_hw_recompute", False)
+                and self.accountant.flops_per_item
+                and params is not None and "blocks" in params):
+            self.accountant.hw_flops_per_item = (
+                mfu_mod.pipelined_hw_flops_per_token(
+                    params, model_cfg,
+                    seq_len=getattr(self, "seq_len", None)))
+
+    # -- per-step phase hooks (the loop's hot path) -----------------------
+    @contextlib.contextmanager
+    def data(self) -> Iterator[None]:
+        if not self.active:
+            yield
+            return
+        self.steptime.data_start()
+        with self.tracer.span("data"):
+            yield
+        self.steptime.data_end()
+
+    @contextlib.contextmanager
+    def dispatch(self) -> Iterator[None]:
+        if not self.active:
+            yield
+            return
+        with self.tracer.span("dispatch"):
+            yield
+        self.steptime.dispatch_end()
+
+    @contextlib.contextmanager
+    def device_wait(self) -> Iterator[None]:
+        if not self.active:
+            yield
+            return
+        with self.tracer.span("device_wait"):
+            yield
+        self.steptime.device_end()
+
+    def step_end(self) -> None:
+        if self.active:
+            self.steptime.step_end()
+
+    # -- phase spans ------------------------------------------------------
+    def phase(self, name: str):
+        """Trace span + goodput charge for non-step phases the loop
+        enters (eval, checkpoint, restore, drain). Goodput's nested-
+        suppression keeps the inner train.checkpoint hooks from
+        double-charging."""
+        if not self.active:
+            return contextlib.nullcontext()
+        stack = contextlib.ExitStack()
+        stack.enter_context(self.tracer.span(name))
+        stack.enter_context(self.goodput.account(name))
+        return stack
+
+    def instant(self, name: str, **args: Any) -> None:
+        self.tracer.instant(name, **args)
+
+    # -- emission ---------------------------------------------------------
+    def emit(self, event: str, **fields: Any) -> None:
+        if self.active:
+            self.registry.emit(event, **fields)
+
+    def log_step(self, step: int, metrics: Dict[str, float]) -> None:
+        """Per-cadence record: task metrics + rolling step-time
+        breakdown + throughput/MFU over the window since the previous
+        cadence log."""
+        if not self.active:
+            return
+        now = self._clock()
+        fields: Dict[str, Any] = {"step": step}
+        fields.update({k: float(v) for k, v in metrics.items()})
+        fields.update(self.steptime.summary())
+        if self._last_log is not None:
+            last_step, last_t = self._last_log
+            rates = self.accountant.rates(
+                (step - last_step) * self.items_per_step, now - last_t)
+            fields.update(rates)
+            if "mfu" in rates:
+                self.tracer.counter("mfu", mfu=rates["mfu"])
+            key = f"{self.accountant.unit}s_per_sec"
+            if key in rates:
+                self.tracer.counter("throughput", **{key: rates[key]})
+        self._last_log = (step, now)
+        self.registry.emit("step", **fields)
+
+    def summarize(self, total_seconds: Optional[float] = None,
+                  **fields: Any) -> None:
+        """Final 'summary' record: rolling stats + goodput ledger +
+        caller-supplied run totals."""
+        if not self.active:
+            return
+        # Plain dict merge (caller fields win): the goodput ledger may
+        # carry categories whose "<cat>_seconds" keys the caller also
+        # reports (e.g. compile_seconds from the loop's Timer).
+        rec = {**self.steptime.summary(),
+               **self.goodput.summary(total_seconds), **fields}
+        self.registry.emit("summary", **rec)
+
+    # -- lifecycle --------------------------------------------------------
+    def flush(self) -> None:
+        """Durable partial artifacts (the loop's exception path)."""
+        if self.active:
+            self.tracer.flush()
+
+    def close(self) -> None:
+        if goodput_mod.get_active() is self.goodput:
+            goodput_mod.set_active(None)
+        self.tracer.close()
+        self.registry.close()

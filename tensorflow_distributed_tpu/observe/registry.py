@@ -1,0 +1,199 @@
+"""Metrics registry: one emission path, pluggable sinks.
+
+The reference's observability was bare ``print()`` timestamps and a
+hand-maintained 6-line ``performance`` file; our first reproduction of
+it (utils/logging.py) kept the print but structured the rows. This
+module is the next step: every run event flows through ONE registry as
+a flat dict record, tagged with host identity (``process_index``, mesh
+shape, config hash), and fans out to whichever sinks the run
+configured — pretty stdout, append-per-record JSONL (the durable
+artifact format every bench/report tool consumes), or CSV.
+
+Emission is chief-only by construction (``enabled=False`` on non-chief
+processes silences the sinks) but the in-memory ring buffer fills on
+every process, so library callers can still inspect what WOULD have
+been written. The buffer is bounded (``max_records``) so multi-million
+step runs don't grow host memory without bound.
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import json
+import sys
+import time
+from typing import Any, Dict, Iterable, Mapping, Optional, TextIO
+
+
+def config_hash(cfg: Any) -> str:
+    """Short stable hash of a config dataclass (or any JSON-able
+    mapping) — lets two JSONL files be compared run-to-run without
+    carrying the whole config in every record."""
+    import dataclasses
+
+    if dataclasses.is_dataclass(cfg) and not isinstance(cfg, type):
+        cfg = dataclasses.asdict(cfg)
+    blob = json.dumps(cfg, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:10]
+
+
+def host_tags(mesh: Any = None, cfg: Any = None) -> Dict[str, Any]:
+    """Standard record tags: process identity, mesh shape, config hash.
+
+    ``mesh`` may be a jax Mesh (its ``.shape`` mapping is rendered
+    compactly, e.g. ``"data=8"``) or None.
+    """
+    import jax
+
+    tags: Dict[str, Any] = {"process_index": jax.process_index()}
+    if mesh is not None:
+        shape = dict(mesh.shape)
+        tags["mesh"] = ",".join(f"{k}={v}" for k, v in shape.items()
+                                if v > 1) or "data=1"
+    if cfg is not None:
+        tags["config_hash"] = config_hash(cfg)
+    return tags
+
+
+class Sink:
+    """A metrics sink consumes flat dict records, one per emit."""
+
+    def emit(self, record: Mapping[str, Any]) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class StdoutSink(Sink):
+    """The human-facing pretty printer (the MetricLogger format —
+    ``[step N] t=...s k=v``) for step records; other events print as
+    one JSON line."""
+
+    def __init__(self, stream: Optional[TextIO] = None):
+        self.stream = stream if stream is not None else sys.stdout
+
+    def emit(self, record: Mapping[str, Any]) -> None:
+        if record.get("event") == "step" and "step" in record:
+            skip = {"event", "step", "t", "process_index", "mesh",
+                    "config_hash"}
+            parts = " ".join(
+                f"{k}={v:.6g}" for k, v in record.items()
+                if k not in skip and isinstance(v, (int, float)))
+            print(f"[step {record['step']:>6}] t={record['t']:8.2f}s "
+                  f"{parts}", file=self.stream, flush=True)
+        else:
+            print(json.dumps(dict(record)), file=self.stream, flush=True)
+
+
+class JsonlSink(Sink):
+    """One JSON object per record — the durable artifact format.
+
+    Opens lazily on first emit (a configured-but-never-used sink leaves
+    no file). Fresh runs TRUNCATE any previous file (the repo-wide
+    rule: reruns replace, never silently accumulate stale lines — a
+    mixed file would skew observe.report's aggregates); a RESUMED run
+    passes ``append=True`` so the pre-preemption records the per-record
+    flushing preserved stay in the artifact (observe.hub wires this to
+    ``cfg.resume``). Flushes per record either way, so a killed run's
+    JSONL is complete up to the last emission.
+    """
+
+    def __init__(self, path: str, append: bool = False):
+        self.path = path
+        self.append = append
+        self._f: Optional[TextIO] = None
+
+    def emit(self, record: Mapping[str, Any]) -> None:
+        if self._f is None:
+            self._f = open(self.path, "a" if self.append else "w")
+        self._f.write(json.dumps(dict(record)) + "\n")
+        self._f.flush()
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+def write_jsonl(path: str, records: Iterable[Mapping[str, Any]]) -> None:
+    """One-shot JSONL writer for benchmark outputs (overwrites — reruns
+    replace, never silently accumulate stale lines)."""
+    with open(path, "w") as f:
+        for rec in records:
+            f.write(json.dumps(dict(rec)) + "\n")
+
+
+class CsvSink(Sink):
+    """Buffered CSV: rows collect in memory and the file is written on
+    ``close()`` with the UNION of all keys as the header (sorted,
+    missing cells empty) — late-appearing columns like mfu (which needs
+    one throughput window first) still get a column. Convenience
+    format; the per-record-flushed JSONL sink is the crash-durable one.
+
+    ``events`` restricts which event types land in the table (default
+    ``("step",)`` — a clean per-step spreadsheet); ``events=None``
+    takes everything. ``max_rows`` bounds the buffer like the
+    registry's ``max_records`` (oldest rows drop first), keeping host
+    memory bounded on multi-million-step runs.
+    """
+
+    def __init__(self, path: str, events: Optional[tuple] = ("step",),
+                 max_rows: int = 100_000):
+        self.path = path
+        self.events = events
+        self._rows: collections.deque = collections.deque(
+            maxlen=max_rows)
+
+    def emit(self, record: Mapping[str, Any]) -> None:
+        if self.events is None or record.get("event") in self.events:
+            self._rows.append(dict(record))
+
+    def close(self) -> None:
+        import csv
+
+        if not self._rows:
+            return
+        fields = sorted({k for row in self._rows for k in row})
+        with open(self.path, "w", newline="") as f:
+            writer = csv.DictWriter(f, fields, restval="")
+            writer.writeheader()
+            writer.writerows(self._rows)
+        self._rows.clear()
+
+
+class MetricsRegistry:
+    """Collects records, tags them, and fans out to sinks.
+
+    ``enabled=False`` (non-chief processes) keeps the ring buffer but
+    silences every sink — chief-only emission with library-level
+    inspectability everywhere.
+    """
+
+    def __init__(self, sinks: Iterable[Sink] = (), enabled: bool = True,
+                 tags: Optional[Mapping[str, Any]] = None,
+                 max_records: int = 100_000, clock=time.time):
+        self.sinks = list(sinks)
+        self.enabled = enabled
+        self.tags = dict(tags or {})
+        self.records: collections.deque = collections.deque(
+            maxlen=max_records)
+        self._clock = clock
+        self._t0 = clock()
+
+    def emit(self, event: str, **fields: Any) -> Dict[str, Any]:
+        rec: Dict[str, Any] = {
+            "event": event,
+            "t": round(self._clock() - self._t0, 6),
+            **self.tags, **fields,
+        }
+        self.records.append(rec)
+        if self.enabled:
+            for sink in self.sinks:
+                sink.emit(rec)
+        return rec
+
+    def close(self) -> None:
+        for sink in self.sinks:
+            sink.close()

@@ -1,0 +1,109 @@
+"""Summarize a metrics JSONL: ``python -m tensorflow_distributed_tpu.observe.report <metrics.jsonl>``.
+
+Regenerates the headline numbers a BENCH artifact wants — p50/p95 step
+time, mean throughput and MFU, goodput % — from the raw JSONL the
+:mod:`observe.registry` JSONL sink wrote, so bench records can always
+be re-derived from (and audited against) the primary artifact.
+
+``--json`` prints one machine-readable JSON object instead of the
+human table.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List
+
+
+def load_records(path: str) -> List[Dict[str, Any]]:
+    records = []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                raise ValueError(f"{path}:{i}: not JSON ({e})") from None
+    return records
+
+
+def _mean(values: List[float]) -> float:
+    return sum(values) / len(values)
+
+
+def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Aggregate step/summary events into the report dict."""
+    steps = [r for r in records if r.get("event") == "step"]
+    summaries = [r for r in records if r.get("event") == "summary"]
+    out: Dict[str, Any] = {"records": len(records),
+                           "step_records": len(steps)}
+    if steps:
+        out["last_step"] = max(int(r.get("step", 0)) for r in steps)
+        # The freshest rolling-window stats (each step record carries
+        # the window's p50/p95 at that point; the last one covers the
+        # run's tail — the steady state).
+        for key in ("step_ms_p50", "step_ms_p95", "data_ms",
+                    "dispatch_ms", "device_ms"):
+            vals = [r[key] for r in steps if key in r]
+            if vals:
+                out[key] = round(vals[-1], 3)
+        for key in ("tokens_per_sec", "images_per_sec", "items_per_sec",
+                    "model_tflops", "mfu", "hw_mfu"):
+            vals = [float(r[key]) for r in steps
+                    if isinstance(r.get(key), (int, float))]
+            if vals:
+                out[f"mean_{key}"] = round(_mean(vals), 4)
+        losses = [float(r["loss"]) for r in steps
+                  if isinstance(r.get("loss"), (int, float))]
+        if losses:
+            out["first_loss"], out["last_loss"] = (round(losses[0], 5),
+                                                   round(losses[-1], 5))
+    if summaries:
+        final = summaries[-1]
+        for key, val in final.items():
+            if key.endswith("_seconds") or key == "goodput":
+                out[key] = val
+    return out
+
+
+def render(summary: Dict[str, Any]) -> str:
+    lines = ["observe.report"]
+    order = ("records", "step_records", "last_step", "step_ms_p50",
+             "step_ms_p95", "data_ms", "dispatch_ms", "device_ms",
+             "mean_tokens_per_sec", "mean_images_per_sec",
+             "mean_items_per_sec", "mean_model_tflops", "mean_mfu",
+             "mean_hw_mfu", "first_loss", "last_loss", "goodput")
+    for key in order:
+        if key in summary:
+            lines.append(f"  {key:<22} {summary[key]}")
+    extras = [k for k in sorted(summary) if k not in order]
+    for key in extras:
+        lines.append(f"  {key:<22} {summary[key]}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tensorflow_distributed_tpu.observe.report",
+        description=__doc__)
+    parser.add_argument("jsonl", help="metrics JSONL written by the "
+                        "observe JSONL sink")
+    parser.add_argument("--json", action="store_true",
+                        help="print one JSON object instead of text")
+    args = parser.parse_args(argv)
+    try:
+        records = load_records(args.jsonl)
+    except (OSError, ValueError) as e:
+        print(f"observe.report: {e}", file=sys.stderr)
+        return 1
+    summary = summarize(records)
+    print(json.dumps(summary) if args.json else render(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

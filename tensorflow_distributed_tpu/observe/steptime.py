@@ -1,0 +1,110 @@
+"""Step-time breakdown: where each training step's wall time goes.
+
+The loop dispatches steps asynchronously, so a bare per-step delta
+(the reference's only timing, mnist_single.py:102-134) conflates three
+very different stalls:
+
+- **data wait** — the host blocked in ``next(it)`` because the
+  prefetcher ran dry (input pipeline bound);
+- **dispatch** — the host issuing the jitted step (tracing/dispatch
+  overhead; normally microseconds after compile);
+- **device wait** — the host blocked on the oldest in-flight step's
+  results (device compute bound — the healthy regime).
+
+This instrument timestamps the loop's phase boundaries with an
+injectable clock (tests drive it with a fake), keeps a rolling window
+of per-step durations, and reports p50/p95 totals plus per-phase
+means. Anything not covered by the three phases (cadence host work:
+metric fetch, eval, checkpoint snapshot) lands in ``host``.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Dict, List, Optional, Sequence
+
+
+def percentile(values: Sequence[float], q: float) -> float:
+    """Nearest-rank percentile (q in [0, 100]) — no numpy needed, exact
+    on the small rolling windows this module keeps."""
+    if not values:
+        raise ValueError("percentile of empty sequence")
+    ordered = sorted(values)
+    rank = max(0, min(len(ordered) - 1,
+                      int(round(q / 100.0 * (len(ordered) - 1)))))
+    return ordered[rank]
+
+
+class StepTimeBreakdown:
+    """Phase-mark API driven by the training loop, one cycle per step::
+
+        st.data_start(); batch = next(it); st.data_end()
+        state, m = step_fn(state, batch); st.dispatch_end()
+        block_on_oldest();                st.device_end()
+        ... cadence host work ...;       st.step_end()
+
+    ``device_end`` is optional (the loop only blocks once the dispatch
+    window fills). Missing phases count as zero.
+    """
+
+    PHASES = ("data", "dispatch", "device", "host")
+
+    def __init__(self, window: int = 200, clock=time.perf_counter):
+        self._clock = clock
+        self._win: Dict[str, collections.deque] = {
+            p: collections.deque(maxlen=window) for p in self.PHASES}
+        self._totals: collections.deque = collections.deque(maxlen=window)
+        self._marks: Dict[str, Optional[float]] = {}
+        self.steps = 0
+
+    # -- phase marks ------------------------------------------------------
+    def data_start(self) -> None:
+        self._marks = {"start": self._clock()}
+
+    def data_end(self) -> None:
+        self._marks["data"] = self._clock()
+
+    def dispatch_end(self) -> None:
+        self._marks["dispatch"] = self._clock()
+
+    def device_end(self) -> None:
+        self._marks["device"] = self._clock()
+
+    def step_end(self) -> Dict[str, float]:
+        """Close the cycle; returns this step's breakdown in seconds."""
+        m = self._marks
+        start = m.get("start")
+        if start is None:  # marks never opened (disabled caller)
+            return {}
+        end = self._clock()
+        t_data = m.get("data", start)
+        t_disp = m.get("dispatch", t_data)
+        t_dev = m.get("device", t_disp)
+        rec = {
+            "data": t_data - start,
+            "dispatch": t_disp - t_data,
+            "device": t_dev - t_disp,
+            "host": end - t_dev,
+            "total": end - start,
+        }
+        for p in self.PHASES:
+            self._win[p].append(rec[p])
+        self._totals.append(rec["total"])
+        self.steps += 1
+        self._marks = {}
+        return rec
+
+    # -- aggregates -------------------------------------------------------
+    def summary(self) -> Dict[str, float]:
+        """Rolling-window stats in milliseconds: per-phase means plus
+        p50/p95 of the step total. Empty dict before the first step."""
+        if not self._totals:
+            return {}
+        out = {f"{p}_ms": 1e3 * sum(w) / len(w)
+               for p, w in self._win.items() if len(w)}
+        totals: List[float] = list(self._totals)
+        out["step_ms"] = 1e3 * sum(totals) / len(totals)
+        out["step_ms_p50"] = 1e3 * percentile(totals, 50)
+        out["step_ms_p95"] = 1e3 * percentile(totals, 95)
+        return {k: round(v, 4) for k, v in out.items()}

@@ -1,0 +1,143 @@
+"""Chrome-trace (Perfetto-compatible) span emitter for HOST phases.
+
+``utils/profiling.py`` captures the XLA device timeline via
+``jax.profiler`` — rich, but it needs a live TPU runtime and a
+TensorBoard/XPlane toolchain to open. This module is its pure-Python
+complement: JSON trace events for the host-side phases the training
+loop actually spends wall time in (data wait, dispatch, eval,
+checkpoint, preemption drain), written in the Trace Event Format that
+chrome://tracing and https://ui.perfetto.dev open directly. It works
+even when the TPU tunnel is down — the exact situation where you most
+want to see what the host was doing.
+
+Events carry the standard keys: ``ph`` (phase: "X" complete span,
+"i" instant, "C" counter, "M" metadata), ``ts``/``dur`` in
+microseconds, ``name``, ``pid``/``tid``. The file is written
+tmp+rename on ``flush()``/``close()`` (idempotent), and flushed
+periodically so a killed run still leaves an openable trace.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+_FLUSH_INTERVAL_S = 5.0  # min seconds between incremental rewrites:
+                         # each flush rewrites the whole accumulated
+                         # buffer, so an event-count trigger would go
+                         # O(n^2) in IO on long runs; a pure time
+                         # trigger bounds IO to runtime/5 rewrites AND
+                         # keeps a killed run's trace at most ~5s
+                         # stale regardless of event rate (close()
+                         # always writes everything).
+
+
+class ChromeTracer:
+    """Span/instant/counter recorder -> one Chrome-trace JSON file.
+
+    ``enabled=False`` (or an empty path) makes every method a no-op so
+    call sites need no guards. The clock is injectable for tests.
+    """
+
+    def __init__(self, path: str = "", pid: int = 0, enabled: bool = True,
+                 process_name: str = "", clock=time.perf_counter,
+                 max_events: int = 200_000):
+        self.path = path
+        self.enabled = bool(enabled and path)
+        self.pid = pid
+        self._clock = clock
+        self._t0 = clock()
+        self._events: List[Dict[str, Any]] = []
+        self._last_flush = clock()
+        # Bound host memory (and the rewrite-on-flush cost) like the
+        # registry's max_records: past the cap, new events are counted
+        # but dropped, and the written trace carries one marker event
+        # saying how many. ~3 spans/step, so the default covers ~65k
+        # traced steps — far past what a human opens in Perfetto.
+        self.max_events = max_events
+        self.dropped = 0
+        if self.enabled and process_name:
+            self._events.append({
+                "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+                "args": {"name": process_name}})
+
+    def _ts(self) -> float:
+        return (self._clock() - self._t0) * 1e6  # microseconds
+
+    def _tid(self) -> int:
+        return threading.get_ident() & 0xFFFF
+
+    def _add(self, event: Dict[str, Any]) -> None:
+        if len(self._events) >= self.max_events:
+            self.dropped += 1
+            return
+        self._events.append(event)
+        if self._clock() - self._last_flush >= _FLUSH_INTERVAL_S:
+            self.flush()
+
+    @contextlib.contextmanager
+    def span(self, name: str, cat: str = "host",
+             **args: Any) -> Iterator[None]:
+        """Complete ("X") event wrapping the with-block."""
+        if not self.enabled:
+            yield
+            return
+        start = self._ts()
+        try:
+            yield
+        finally:
+            ev: Dict[str, Any] = {
+                "ph": "X", "name": name, "cat": cat, "pid": self.pid,
+                "tid": self._tid(), "ts": round(start, 3),
+                "dur": round(self._ts() - start, 3)}
+            if args:
+                ev["args"] = args
+            self._add(ev)
+
+    def instant(self, name: str, cat: str = "host", **args: Any) -> None:
+        if not self.enabled:
+            return
+        ev: Dict[str, Any] = {
+            "ph": "i", "name": name, "cat": cat, "pid": self.pid,
+            "tid": self._tid(), "ts": round(self._ts(), 3), "s": "p"}
+        if args:
+            ev["args"] = args
+        self._add(ev)
+
+    def counter(self, name: str, **values: float) -> None:
+        """Counter ("C") track, e.g. ``tracer.counter("mfu", mfu=0.41)``."""
+        if not self.enabled:
+            return
+        self._add({"ph": "C", "name": name, "pid": self.pid, "tid": 0,
+                   "ts": round(self._ts(), 3), "args": dict(values)})
+
+    def flush(self) -> None:
+        """Write everything recorded so far (tmp+rename, idempotent)."""
+        if not self.enabled:
+            return
+        events = self._events
+        if self.dropped:
+            events = events + [{
+                "ph": "i", "name": f"{self.dropped} events dropped "
+                f"(max_events={self.max_events})", "cat": "host",
+                "pid": self.pid, "tid": 0,
+                "ts": round(self._ts(), 3), "s": "p"}]
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"traceEvents": events,
+                       "displayTimeUnit": "ms"}, f)
+        os.replace(tmp, self.path)
+        self._last_flush = self._clock()
+
+    def close(self) -> None:
+        self.flush()
+
+
+def load_trace(path: str) -> List[Dict[str, Any]]:
+    """Read back a trace file's event list (tests, tooling)."""
+    with open(path) as f:
+        return json.load(f)["traceEvents"]

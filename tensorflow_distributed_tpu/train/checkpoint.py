@@ -44,6 +44,7 @@ import jax
 import numpy as np
 from flax import serialization
 
+from tensorflow_distributed_tpu.observe import goodput as _goodput
 from tensorflow_distributed_tpu.parallel.mesh import is_chief
 
 _STEP_PREFIX = "step_"
@@ -302,9 +303,16 @@ def _write(ckpt_dir: str, step: int, host_state: Any, keep: int) -> str:
     return final
 
 
+@_goodput.accounted("checkpoint")
 def save(ckpt_dir: str, state: Any, keep: int = 3,
          background: bool = False, backend: str = "native") -> str:
     """Write state at its current step; prune to the newest ``keep``.
+
+    Goodput: the MAIN-THREAD time spent here (device->host snapshot,
+    sync writes, background-queue backpressure) is charged to the
+    "checkpoint" category on the active observe.goodput counter; the
+    background writer thread's IO overlaps training and is deliberately
+    not charged.
 
     Collective under multi-host (every process must call it; only the
     chief writes bytes): cross-process-partitioned leaves are fetched
@@ -365,6 +373,7 @@ def save(ckpt_dir: str, state: Any, keep: int = 3,
     return final
 
 
+@_goodput.accounted("checkpoint")
 def wait() -> None:
     """Block until outstanding background saves land (both the
     native writer thread and orbax's internal async write);
@@ -402,6 +411,7 @@ def wait() -> None:
             multihost_utils.sync_global_devices("tfd_ckpt_flush")
 
 
+@_goodput.accounted("restore")
 def restore_averaged(ckpt_dir: str, state: Any,
                      step: Optional[int] = None) -> Any:
     """Restore a REPLICA-STACKED (local SGD) checkpoint into a PLAIN
@@ -459,6 +469,7 @@ def restore_averaged(ckpt_dir: str, state: Any,
     return _restore_from_raw(raw, state)
 
 
+@_goodput.accounted("restore")
 def restore(ckpt_dir: str, state: Any, step: Optional[int] = None) -> Any:
     """Restore into the structure/shardings of ``state`` (a freshly
     created template). ``step=None`` means latest."""

@@ -19,6 +19,8 @@ import numpy as np
 from tensorflow_distributed_tpu.config import TrainConfig
 from tensorflow_distributed_tpu.data import prefetch_to_mesh
 from tensorflow_distributed_tpu.models import build_model
+from tensorflow_distributed_tpu.observe import Observatory
+from tensorflow_distributed_tpu.observe.registry import host_tags
 from tensorflow_distributed_tpu.parallel import make_mesh
 from tensorflow_distributed_tpu.parallel.mesh import bootstrap, is_chief
 from tensorflow_distributed_tpu.parallel.sharding import (
@@ -165,28 +167,49 @@ def evaluate_only(cfg: TrainConfig,
     """
     cfg.validate()  # enforces checkpoint_dir for mode="eval"
     bootstrap()
-    logger = logger or MetricLogger(enabled=is_chief())
+    logger = logger or MetricLogger(enabled=is_chief(),
+                                max_records=cfg.observe.max_records)
     mesh = make_mesh(cfg.mesh)
     task = make_task(cfg, mesh)
     _, state = _build_model_and_state(cfg, mesh, task)
-    if cfg.param_sync_every > 1:
-        # Local-SGD checkpoints persist the replica stack; average
-        # it ON HOST into the plain template, so validation works on
-        # ANY mesh shape regardless of the training replica count
-        # (the documented eval-on-a-different-mesh capability).
-        state = ckpt.restore_averaged(cfg.checkpoint_dir, state)
-    else:
-        state = ckpt.restore(cfg.checkpoint_dir, state)
-    step = int(jax.device_get(state.step))
-    eval_fn = make_eval_step(mesh, loss=task.eval_loss or task.loss,
-                             batch_shardings=task.batch_shardings)
-    with Timer() as eval_t:
-        metrics = evaluate(state, eval_fn, task, mesh, cfg.eval_batch_size)
-    logger.log_json({
-        "event": "eval", "step": step,
-        "eval_seconds": round(eval_t.elapsed, 3),
-        **{f"val_{k}": round(v, 5) for k, v in metrics.items()},
-    })
+    # mode=eval usually re-validates an EXISTING run: when the JSONL
+    # already holds that run's records, append the eval record to the
+    # artifact instead of truncating the training history away. A
+    # fresh path still gets created (and reruns onto it replace).
+    import os
+    obs = Observatory(cfg.observe, chief=is_chief(),
+                      tags=host_tags(mesh, cfg),
+                      process_index=jax.process_index(),
+                      append=bool(cfg.observe.metrics_jsonl
+                                  and os.path.exists(
+                                      cfg.observe.metrics_jsonl)))
+    try:
+        if cfg.param_sync_every > 1:
+            # Local-SGD checkpoints persist the replica stack; average
+            # it ON HOST into the plain template, so validation works on
+            # ANY mesh shape regardless of the training replica count
+            # (the documented eval-on-a-different-mesh capability).
+            with obs.phase("restore"):
+                state = ckpt.restore_averaged(cfg.checkpoint_dir, state)
+        else:
+            with obs.phase("restore"):
+                state = ckpt.restore(cfg.checkpoint_dir, state)
+        step = int(jax.device_get(state.step))
+        eval_fn = make_eval_step(mesh, loss=task.eval_loss or task.loss,
+                                 batch_shardings=task.batch_shardings)
+        with obs.phase("eval"), Timer() as eval_t:
+            metrics = evaluate(state, eval_fn, task, mesh,
+                               cfg.eval_batch_size)
+        logger.log_json({
+            "event": "eval", "step": step,
+            "eval_seconds": round(eval_t.elapsed, 3),
+            **{f"val_{k}": round(v, 5) for k, v in metrics.items()},
+        })
+        obs.emit("eval", step=step,
+                 eval_seconds=round(eval_t.elapsed, 3),
+                 **{f"val_{k}": round(v, 5) for k, v in metrics.items()})
+    finally:
+        obs.close()
     return metrics
 
 
@@ -215,7 +238,8 @@ def generate_only(cfg: TrainConfig,
     """
     cfg.validate()
     bootstrap()
-    logger = logger or MetricLogger(enabled=is_chief())
+    logger = logger or MetricLogger(enabled=is_chief(),
+                                max_records=cfg.observe.max_records)
     mesh = make_mesh(cfg.mesh)
 
     # Tokenizer/vocab WITHOUT building the training task: make_task
@@ -301,198 +325,264 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
           ) -> TrainResult:
     cfg.validate()
     bootstrap()
-    logger = logger or MetricLogger(enabled=is_chief())
+    logger = logger or MetricLogger(enabled=is_chief(),
+                                max_records=cfg.observe.max_records)
     mesh = make_mesh(cfg.mesh)
     task = make_task(cfg, mesh)
     model, state = _build_model_and_state(cfg, mesh, task)
     n_params = param_count(state.params)  # before replica stacking
-    local_sgd = cfg.param_sync_every > 1
-    if local_sgd:
-        from tensorflow_distributed_tpu.train.local_sgd import (
-            averaged_view, stack_state)
-        # Replica-stacked state from here on; checkpoints persist
-        # the stack (exact divergence survives resume), evals and
-        # the returned result use the averaged view.
-        state = stack_state(state, mesh)
-        view = averaged_view
-    else:
-        view = lambda s: s  # noqa: E731
-
-    start_step = 0
-    if cfg.resume and ckpt.latest_step(cfg.checkpoint_dir) is not None:
-        state = ckpt.restore(cfg.checkpoint_dir, state)
-        start_step = ckpt.host_step(state)
-        logger.log_json({"event": "resumed", "step": start_step})
-
-    # ZeRO-1 needs new_params constrained back to the params' OWN
-    # state-creation layout after the slot-sharded update — captured
-    # from the live arrays so pipe/TP-sharded params keep those axes
-    # (a blanket "replicated" would clobber them).
-    params_out = (jax.tree_util.tree_map(lambda a: a.sharding,
-                                         state.params)
-                  if cfg.param_partition == "zero1" else None)
-    if cfg.model == "pipelined_lm" and cfg.pipeline_schedule == "1f1b":
-        from tensorflow_distributed_tpu.train.pipeline_step import (
-            make_1f1b_train_step)
-        step_fn = make_1f1b_train_step(model, mesh, cfg.seed,
-                                       batch_shardings=task.batch_shardings,
-                                       moe_aux_weight=cfg.moe_aux_weight,
-                                       moe_zloss_weight=cfg.moe_zloss_weight,
-                                       grad_norm_metric=cfg.log_grad_norm,
-                                       label_smoothing=cfg.label_smoothing,
-                                       ema_decay=cfg.ema_decay,
-                                       backward=cfg.pipeline_backward,
-                                       ce_chunk=cfg.ce_chunk,
-                                       params_out_shardings=params_out)
-    elif local_sgd:
-        from tensorflow_distributed_tpu.train.local_sgd import (
-            make_local_sgd_train_step)
-        step_fn = make_local_sgd_train_step(
-            mesh, cfg.param_sync_every, cfg.seed, loss=task.loss,
-            batch_shardings=task.batch_shardings,
-            grad_norm_metric=cfg.log_grad_norm)
-    else:
-        step_fn = make_train_step(
-            mesh, cfg.seed, loss=task.loss,
-            batch_shardings=task.batch_shardings,
-            accum_steps=cfg.grad_accum_steps,
-            grad_norm_metric=cfg.log_grad_norm,
-            ema_decay=cfg.ema_decay,
-            params_out_shardings=params_out)
-    eval_fn = make_eval_step(mesh, loss=task.eval_loss or task.loss,
-                             batch_shardings=task.batch_shardings)
-    logger.log_json({
-        "event": "start", "model": cfg.model, "task": task.name,
-        "params": n_params, "mesh": dict(mesh.shape),
-        "global_batch": cfg.batch_size, "start_step": start_step,
-    })
-
-    it = prefetch_to_mesh(task.train_stream(start_step), mesh,
-                          seq_axis=task.seq_axis)
-
-    def cadence(step_now: int, state: TrainState, metrics) -> None:
-        """Periodic log/eval/checkpoint — applied to EVERY step
-        including the warm-up compile step."""
-        if cfg.log_every and step_now % cfg.log_every == 0:
-            host_metrics = jax.device_get(metrics)
-            logger.log(step_now, **host_metrics)
-            if cfg.halt_on_nonfinite and not np.isfinite(
-                    float(host_metrics["loss"])):
-                # Flush queued async saves first so the named resume
-                # point is the TRUE latest (metrics are replicated, so
-                # every process raises here and reaches wait()'s
-                # barrier).
-                ckpt.wait()
-                raise FloatingPointError(
-                    f"non-finite loss {host_metrics['loss']} at step "
-                    f"{step_now} (halt_on_nonfinite=true); last durable "
-                    f"checkpoint: "
-                    f"{ckpt.latest_step(cfg.checkpoint_dir) if cfg.checkpoint_dir else None}")
-        if cfg.eval_every and step_now % cfg.eval_every == 0:
-            em = evaluate(view(state), eval_fn, task, mesh,
-                          cfg.eval_batch_size)
-            logger.log(step_now, **{f"val_{k}": v for k, v in em.items()})
-        if (cfg.checkpoint_dir and cfg.checkpoint_every
-                and step_now % cfg.checkpoint_every == 0):
-            ckpt.save(cfg.checkpoint_dir, state, cfg.keep_checkpoints,
-                      background=cfg.checkpoint_async,
-                      backend=cfg.checkpoint_backend)
-
-    # Warm-up compile outside the timed steady-state span (the
-    # reference's timings conflated graph setup with steps; ours don't).
-    metrics = None
-    with Timer() as compile_t:
-        if cfg.train_steps > start_step:
-            state, metrics = step_fn(state, next(it))
-            jax.block_until_ready(metrics)
-            cadence(start_step + 1, state, metrics)
-    steps_done = 1 if cfg.train_steps > start_step else 0
-
-    # Bounded async dispatch: block on the oldest pending step once more
-    # than 2 ride in the deque, so at most 2 unconfirmed steps trail the
-    # current dispatch (3 in flight at the dispatch instant). Unbounded
-    # dispatch can queue dozens of SPMD programs whose collectives then
-    # compete for the same worker threads (on oversubscribed hosts the
-    # XLA:CPU rendezvous aborts after 40s); a shallow window preserves
-    # the host/device overlap that hides dispatch latency.
-    inflight = collections.deque()
-    profiler = StepProfiler(
-        log_dir=cfg.profile_dir if is_chief() else "",
-        start_step=cfg.profile_start_step,
-        num_steps=cfg.profile_num_steps)
-
-    # SIGTERM (preemption notice) -> stop at a coordinated safe step,
-    # fall through to the final durable save below, exit 0 for the
-    # scheduler to restart with --resume. Only armed when there is a
-    # checkpoint dir to save into.
-    guard = PreemptionGuard(enabled=bool(cfg.checkpoint_dir))
+    # The run's observability hub: metrics registry (JSONL/CSV sinks),
+    # host-phase Chrome trace, step-time breakdown, throughput/MFU
+    # accounting, goodput ledger. Inert unless cfg.observe configures
+    # an output. Constructing it installs the goodput counter that
+    # train.checkpoint / train.preemption charge blocked time to.
+    # Built BEFORE local-SGD replica stacking so the FLOPs estimate
+    # counts the model once, not once per replica.
+    obs = Observatory.for_training(cfg, mesh, task=task, model=model,
+                                   params=state.params,
+                                   chief=is_chief())
+    # Everything below runs under the Observatory: close() must
+    # fire on EVERY exit (normal, preemption, halt_on_nonfinite,
+    # eval failure) so sinks flush (the CSV only writes on close),
+    # file handles drop, and the process-global goodput counter is
+    # uninstalled rather than left charging a dead run.
     try:
-        with Timer() as train_t:
-            for i in range(start_step + steps_done, cfg.train_steps):
-                if guard.should_stop(i):
-                    logger.log_json({"event": "preempted", "step": i})
-                    break
-                profiler.observe(i + 1, pending=metrics)
-                state, metrics = step_fn(state, next(it))
-                inflight.append(metrics)
-                if len(inflight) > 2:
-                    jax.block_until_ready(inflight.popleft())
-                cadence(i + 1, state, metrics)
-            jax.block_until_ready(state.params)
+        local_sgd = cfg.param_sync_every > 1
+        if local_sgd:
+            from tensorflow_distributed_tpu.train.local_sgd import (
+                averaged_view, stack_state)
+            # Replica-stacked state from here on; checkpoints persist
+            # the stack (exact divergence survives resume), evals and
+            # the returned result use the averaged view.
+            state = stack_state(state, mesh)
+            view = averaged_view
+        else:
+            view = lambda s: s  # noqa: E731
+
+        start_step = 0
+        if cfg.resume and ckpt.latest_step(cfg.checkpoint_dir) is not None:
+            with obs.phase("restore"):
+                state = ckpt.restore(cfg.checkpoint_dir, state)
+            start_step = ckpt.host_step(state)
+            logger.log_json({"event": "resumed", "step": start_step})
+            obs.emit("resumed", step=start_step)
+
+        # ZeRO-1 needs new_params constrained back to the params' OWN
+        # state-creation layout after the slot-sharded update — captured
+        # from the live arrays so pipe/TP-sharded params keep those axes
+        # (a blanket "replicated" would clobber them).
+        params_out = (jax.tree_util.tree_map(lambda a: a.sharding,
+                                             state.params)
+                      if cfg.param_partition == "zero1" else None)
+        if cfg.model == "pipelined_lm" and cfg.pipeline_schedule == "1f1b":
+            from tensorflow_distributed_tpu.train.pipeline_step import (
+                make_1f1b_train_step)
+            step_fn = make_1f1b_train_step(model, mesh, cfg.seed,
+                                           batch_shardings=task.batch_shardings,
+                                           moe_aux_weight=cfg.moe_aux_weight,
+                                           moe_zloss_weight=cfg.moe_zloss_weight,
+                                           grad_norm_metric=cfg.log_grad_norm,
+                                           label_smoothing=cfg.label_smoothing,
+                                           ema_decay=cfg.ema_decay,
+                                           backward=cfg.pipeline_backward,
+                                           ce_chunk=cfg.ce_chunk,
+                                           params_out_shardings=params_out)
+        elif local_sgd:
+            from tensorflow_distributed_tpu.train.local_sgd import (
+                make_local_sgd_train_step)
+            step_fn = make_local_sgd_train_step(
+                mesh, cfg.param_sync_every, cfg.seed, loss=task.loss,
+                batch_shardings=task.batch_shardings,
+                grad_norm_metric=cfg.log_grad_norm)
+        else:
+            step_fn = make_train_step(
+                mesh, cfg.seed, loss=task.loss,
+                batch_shardings=task.batch_shardings,
+                accum_steps=cfg.grad_accum_steps,
+                grad_norm_metric=cfg.log_grad_norm,
+                ema_decay=cfg.ema_decay,
+                params_out_shardings=params_out)
+        eval_fn = make_eval_step(mesh, loss=task.eval_loss or task.loss,
+                                 batch_shardings=task.batch_shardings)
+        # 1F1B-recompute steps advertise their extra executed FLOPs
+        # (hw-MFU next to model MFU — train.pipeline_step).
+        obs.note_step_fn(step_fn, params=state.params,
+                         model_cfg=getattr(model, "cfg", None))
+        logger.log_json({
+            "event": "start", "model": cfg.model, "task": task.name,
+            "params": n_params, "mesh": dict(mesh.shape),
+            "global_batch": cfg.batch_size, "start_step": start_step,
+        })
+        # Lifecycle events go to BOTH outputs on purpose: logger owns
+        # the human stdout stream (and needs no observe config), obs
+        # owns the tagged file sinks (mesh/config_hash ride its tags).
+        obs.emit("start", model=cfg.model, task=task.name, params=n_params,
+                 global_batch=cfg.batch_size, start_step=start_step)
+
+        it = prefetch_to_mesh(task.train_stream(start_step), mesh,
+                              seq_axis=task.seq_axis)
+
+        def cadence(step_now: int, state: TrainState, metrics) -> None:
+            """Periodic log/eval/checkpoint — applied to EVERY step
+            including the warm-up compile step."""
+            if cfg.log_every and step_now % cfg.log_every == 0:
+                host_metrics = jax.device_get(metrics)
+                logger.log(step_now, **host_metrics)
+                obs.log_step(step_now, host_metrics)
+                if cfg.halt_on_nonfinite and not np.isfinite(
+                        float(host_metrics["loss"])):
+                    # Flush queued async saves first so the named resume
+                    # point is the TRUE latest (metrics are replicated, so
+                    # every process raises here and reaches wait()'s
+                    # barrier).
+                    ckpt.wait()
+                    raise FloatingPointError(
+                        f"non-finite loss {host_metrics['loss']} at step "
+                        f"{step_now} (halt_on_nonfinite=true); last durable "
+                        f"checkpoint: "
+                        f"{ckpt.latest_step(cfg.checkpoint_dir) if cfg.checkpoint_dir else None}")
+            if cfg.eval_every and step_now % cfg.eval_every == 0:
+                with obs.phase("eval"):
+                    em = evaluate(view(state), eval_fn, task, mesh,
+                                  cfg.eval_batch_size)
+                logger.log(step_now, **{f"val_{k}": v for k, v in em.items()})
+                obs.emit("eval", step=step_now,
+                         **{f"val_{k}": float(v) for k, v in em.items()})
+            if (cfg.checkpoint_dir and cfg.checkpoint_every
+                    and step_now % cfg.checkpoint_every == 0):
+                with obs.phase("checkpoint"):
+                    ckpt.save(cfg.checkpoint_dir, state, cfg.keep_checkpoints,
+                              background=cfg.checkpoint_async,
+                              backend=cfg.checkpoint_backend)
+
+        # Warm-up compile outside the timed steady-state span (the
+        # reference's timings conflated graph setup with steps; ours don't).
+        # Goodput charges it as "compile" — setup, not forward progress.
+        metrics = None
+        with Timer() as compile_t:
+            if cfg.train_steps > start_step:
+                with obs.phase("compile"):
+                    state, metrics = step_fn(state, next(it))
+                    jax.block_until_ready(metrics)
+                cadence(start_step + 1, state, metrics)
+        steps_done = 1 if cfg.train_steps > start_step else 0
+
+        # Bounded async dispatch: block on the oldest pending step once more
+        # than 2 ride in the deque, so at most 2 unconfirmed steps trail the
+        # current dispatch (3 in flight at the dispatch instant). Unbounded
+        # dispatch can queue dozens of SPMD programs whose collectives then
+        # compete for the same worker threads (on oversubscribed hosts the
+        # XLA:CPU rendezvous aborts after 40s); a shallow window preserves
+        # the host/device overlap that hides dispatch latency.
+        inflight = collections.deque()
+        profiler = StepProfiler(
+            log_dir=cfg.profile_dir if is_chief() else "",
+            start_step=cfg.profile_start_step,
+            num_steps=cfg.profile_num_steps)
+
+        # SIGTERM (preemption notice) -> stop at a coordinated safe step,
+        # fall through to the final durable save below, exit 0 for the
+        # scheduler to restart with --resume. Only armed when there is a
+        # checkpoint dir to save into.
+        guard = PreemptionGuard(enabled=bool(cfg.checkpoint_dir))
+        try:
+            with Timer() as train_t:
+                for i in range(start_step + steps_done, cfg.train_steps):
+                    if guard.should_stop(i):
+                        logger.log_json({"event": "preempted", "step": i})
+                        obs.instant("preempted", step=i)
+                        obs.emit("preempted", step=i)
+                        break
+                    profiler.observe(i + 1, pending=metrics)
+                    with obs.data():
+                        batch = next(it)
+                    with obs.dispatch():
+                        state, metrics = step_fn(state, batch)
+                    inflight.append(metrics)
+                    if len(inflight) > 2:
+                        with obs.device_wait():
+                            jax.block_until_ready(inflight.popleft())
+                    cadence(i + 1, state, metrics)
+                    obs.step_end()
+                jax.block_until_ready(state.params)
+        finally:
+            # Always restore the prior SIGTERM disposition — an exception
+            # escaping the loop must not leave a handler that absorbs
+            # future SIGTERMs into an Event nobody reads. The profiler
+            # likewise: an open trace window must be finalized even when
+            # the loop raises (halt_on_nonfinite fires mid-cadence — the
+            # diverging run's trace is exactly the one worth keeping), and
+            # the host-phase Chrome trace is flushed durable for the same
+            # reason (the JSONL sink already flushes per record).
+            guard.close()
+            profiler.stop(pending=metrics)
+            obs.flush()
+
+        preempted = guard.fired is not None
+        if preempted and cfg.checkpoint_dir:
+            # The eviction grace window exists for THIS save: take it
+            # before eval, which on a real validation split could outlive
+            # the grace period and void the whole feature. Goodput charges
+            # the whole preempted flush as "drain" (the nested checkpoint
+            # accounting suppresses itself inside an outer category).
+            with obs.phase("drain"):
+                ckpt.save(cfg.checkpoint_dir, state, cfg.keep_checkpoints,
+                          background=cfg.checkpoint_async,
+                          backend=cfg.checkpoint_backend)
+                ckpt.wait()
+        state_out = view(state)
+        with Timer() as eval_t:
+            if preempted:
+                final = {}
+            else:
+                with obs.phase("eval"):
+                    final = evaluate(state_out, eval_fn, task, mesh,
+                                     cfg.eval_batch_size)
+        if cfg.checkpoint_dir and not preempted:
+            # The final save rides the SAME path as cadence saves: under
+            # checkpoint_async a cadence save of this very step may still
+            # sit in the writer queue, and the single writer serializes
+            # them; a synchronous bypass here would race it on the tmp
+            # dir. wait() then flushes the queue and barriers so
+            # latest_step is coherent on return.
+            with obs.phase("checkpoint"):
+                ckpt.save(cfg.checkpoint_dir, state, cfg.keep_checkpoints,
+                          background=cfg.checkpoint_async,
+                          backend=cfg.checkpoint_backend)
+                ckpt.wait()
+
+        # Steps ACTUALLY executed in the timed span (a preemption break
+        # runs fewer than the configured horizon; reporting the horizon
+        # would inflate throughput).
+        steady_steps = max(
+            int(jax.device_get(state_out.step)) - start_step - steps_done, 0)
+        sps = steady_steps / train_t.elapsed if train_t.elapsed > 0 else 0.0
+        result = TrainResult(
+            state=state_out,
+            train_seconds=compile_t.elapsed + train_t.elapsed,
+            eval_seconds=eval_t.elapsed, final_metrics=final,
+            steps_per_sec=sps, images_per_sec=sps * cfg.batch_size,
+            logger=logger)
+        logger.log_json({
+            "event": "done", "steps": int(jax.device_get(state_out.step)),
+            "train_seconds": round(result.train_seconds, 3),
+            "compile_seconds": round(compile_t.elapsed, 3),
+            "steps_per_sec": round(sps, 3),
+            "images_per_sec": round(result.images_per_sec, 1),
+            **{f"val_{k}": round(v, 5) for k, v in final.items()},
+        })
+        # Final rollup: rolling step-time stats + goodput ledger (counted
+        # since the Observatory was built — restores, compile, eval and
+        # checkpoint stalls all charged) + steady-state throughput/MFU.
+        obs.summarize(
+            steps=int(jax.device_get(state_out.step)),
+            preempted=preempted,
+            train_seconds=round(result.train_seconds, 3),
+            compile_seconds=round(compile_t.elapsed, 3),
+            steps_per_sec=round(sps, 3),
+            **obs.accountant.rates(steady_steps * obs.items_per_step,
+                                   train_t.elapsed),
+            **{f"val_{k}": round(v, 5) for k, v in final.items()})
+        return result
     finally:
-        # Always restore the prior SIGTERM disposition — an exception
-        # escaping the loop must not leave a handler that absorbs
-        # future SIGTERMs into an Event nobody reads. The profiler
-        # likewise: an open trace window must be finalized even when
-        # the loop raises (halt_on_nonfinite fires mid-cadence — the
-        # diverging run's trace is exactly the one worth keeping).
-        guard.close()
-        profiler.stop(pending=metrics)
-
-    preempted = guard.fired is not None
-    if preempted and cfg.checkpoint_dir:
-        # The eviction grace window exists for THIS save: take it
-        # before eval, which on a real validation split could outlive
-        # the grace period and void the whole feature.
-        ckpt.save(cfg.checkpoint_dir, state, cfg.keep_checkpoints,
-                  background=cfg.checkpoint_async,
-                  backend=cfg.checkpoint_backend)
-        ckpt.wait()
-    state_out = view(state)
-    with Timer() as eval_t:
-        final = ({} if preempted else
-                 evaluate(state_out, eval_fn, task, mesh,
-                          cfg.eval_batch_size))
-    if cfg.checkpoint_dir and not preempted:
-        # The final save rides the SAME path as cadence saves: under
-        # checkpoint_async a cadence save of this very step may still
-        # sit in the writer queue, and the single writer serializes
-        # them; a synchronous bypass here would race it on the tmp
-        # dir. wait() then flushes the queue and barriers so
-        # latest_step is coherent on return.
-        ckpt.save(cfg.checkpoint_dir, state, cfg.keep_checkpoints,
-                  background=cfg.checkpoint_async,
-                  backend=cfg.checkpoint_backend)
-        ckpt.wait()
-
-    # Steps ACTUALLY executed in the timed span (a preemption break
-    # runs fewer than the configured horizon; reporting the horizon
-    # would inflate throughput).
-    steady_steps = max(
-        int(jax.device_get(state_out.step)) - start_step - steps_done, 0)
-    sps = steady_steps / train_t.elapsed if train_t.elapsed > 0 else 0.0
-    result = TrainResult(
-        state=state_out,
-        train_seconds=compile_t.elapsed + train_t.elapsed,
-        eval_seconds=eval_t.elapsed, final_metrics=final,
-        steps_per_sec=sps, images_per_sec=sps * cfg.batch_size,
-        logger=logger)
-    logger.log_json({
-        "event": "done", "steps": int(jax.device_get(state_out.step)),
-        "train_seconds": round(result.train_seconds, 3),
-        "compile_seconds": round(compile_t.elapsed, 3),
-        "steps_per_sec": round(sps, 3),
-        "images_per_sec": round(result.images_per_sec, 1),
-        **{f"val_{k}": round(v, 5) for k, v in final.items()},
-    })
-    return result
+        obs.close()

@@ -202,10 +202,17 @@ def make_1f1b_train_step(model: PipelinedLM, mesh: Mesh, seed: int = 0,
         return new_state, metrics
 
     if not jit:
+        step.observe_hw_recompute = (backward == "recompute")
         return step
     with mesh:
-        return jax.jit(
+        jitted = jax.jit(
             step,
             in_shardings=(None, batch_shardings),
             donate_argnums=(0,) if donate else (),
         )
+    # Observability metadata: the recompute backward EXECUTES ~4x-forward
+    # for the block stack while model-FLOPs accounting credits 3x;
+    # observe.hub reads this to report hw_mfu alongside model MFU
+    # (observe.mfu.pipelined_hw_flops_per_token).
+    jitted.observe_hw_recompute = (backward == "recompute")
+    return jitted

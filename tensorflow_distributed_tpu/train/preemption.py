@@ -25,9 +25,12 @@ from __future__ import annotations
 
 import signal
 import threading
+import time
 from typing import Optional
 
 import jax
+
+from tensorflow_distributed_tpu.observe import goodput as _goodput
 
 
 class PreemptionGuard:
@@ -51,6 +54,7 @@ class PreemptionGuard:
         self._prev: dict = {}
         self._enabled = enabled
         self.fired: Optional[int] = None  # step at which we stopped
+        self._notice_time: Optional[float] = None  # SIGTERM arrival
         if not enabled:
             return
         if jax.process_count() > 1:
@@ -71,6 +75,16 @@ class PreemptionGuard:
                 pass
 
     def _on_signal(self, signum, frame):
+        if self._notice_time is None:
+            self._notice_time = time.perf_counter()
+            # Snapshot overhead charged so far — charged() includes the
+            # elapsed part of an in-flight eval/checkpoint block (the
+            # handler runs on the main thread, same thread as the
+            # block) — so the drain charge in should_stop can exclude
+            # exactly the overhead accrued INSIDE the notice window.
+            counter = _goodput.get_active()
+            self._notice_overhead = (counter.charged()
+                                     if counter else 0.0)
         self._flag.set()
 
     def should_stop(self, step_id: int) -> bool:
@@ -101,6 +115,19 @@ class PreemptionGuard:
             return stop
         if self._flag.is_set():
             self.fired = step_id
+            if self._notice_time is not None:
+                # Goodput: the notice->coordinated-safe-step interval is
+                # preemption DRAIN time (the eviction grace window spent
+                # finishing in-flight steps, not making new progress) —
+                # minus whatever eval/checkpoint overhead was already
+                # charged inside that same interval.
+                drain = time.perf_counter() - self._notice_time
+                counter = _goodput.get_active()
+                if counter is not None:
+                    drain -= (counter.charged()
+                              - getattr(self, "_notice_overhead", 0.0))
+                _goodput.add("drain", max(drain, 0.0))
+                self._notice_time = None
             return True
         return False
 

@@ -3,17 +3,28 @@
 The reference's observability was bare ``print()`` (timestamps + steps at
 mnist_python_m.py:297-299, loss every 10 steps at mnist_single.py:113-116,
 including one malformed print at mnist_python_m.py:316) and a
-hand-maintained 6-line ``performance`` file. This module logs structured
-rows and can regenerate that exact table automatically.
+hand-maintained 6-line ``performance`` file.
+
+``MetricLogger`` is now a thin COMPATIBILITY SHIM over the observe/
+subsystem (observe.registry owns formatting and sink dispatch; this
+class keeps the historical ``log``/``log_json``/``performance_table``
+surface and the in-memory ``records`` list the table renders from).
+New code should use :class:`observe.registry.MetricsRegistry` (or the
+train loop's :class:`observe.hub.Observatory`) directly. The records
+list is a bounded ring buffer (``max_records``) so multi-million-step
+runs don't grow host memory unboundedly.
 """
 
 from __future__ import annotations
 
-import json
+import collections
 import sys
 import time
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, TextIO
+from typing import Any, Dict, Optional, TextIO
+
+from tensorflow_distributed_tpu.observe.registry import (
+    MetricsRegistry, StdoutSink)
 
 
 @dataclass
@@ -23,27 +34,42 @@ class StepRecord:
     metrics: Dict[str, float]
 
 
-@dataclass
 class MetricLogger:
-    """Collects per-step metrics; one process (the chief) prints them."""
+    """Collects per-step metrics; one process (the chief) prints them.
 
-    enabled: bool = True
-    stream: TextIO = sys.stdout
-    records: List[StepRecord] = field(default_factory=list)
-    _t0: float = field(default_factory=time.time)
+    Compatibility shim: emission flows through a MetricsRegistry with a
+    StdoutSink (observe.registry). ``records`` keeps the StepRecord
+    view ``performance_table`` and callers expect, capped at
+    ``max_records`` (ring buffer — oldest rows drop first).
+    """
+
+    def __init__(self, enabled: bool = True,
+                 stream: TextIO = sys.stdout,
+                 max_records: int = 100_000,
+                 registry: Optional[MetricsRegistry] = None):
+        self.enabled = enabled
+        self.stream = stream
+        self.records: collections.deque = collections.deque(
+            maxlen=max_records)
+        # The shim keeps its own StepRecord buffer (performance_table's
+        # input); the internal registry is emission-only, so its ring
+        # buffer stays at 1 — no double-buffering of every record.
+        self._registry = registry or MetricsRegistry(
+            [StdoutSink(stream)], enabled=enabled, max_records=1)
+        self._t0 = time.time()
 
     def log(self, step: int, **metrics: float) -> None:
         rec = StepRecord(step=step, wall_time=time.time() - self._t0,
                          metrics={k: float(v) for k, v in metrics.items()})
         self.records.append(rec)
-        if self.enabled:
-            parts = " ".join(f"{k}={v:.6g}" for k, v in rec.metrics.items())
-            print(f"[step {step:>6}] t={rec.wall_time:8.2f}s {parts}",
-                  file=self.stream, flush=True)
+        self._registry.emit("step", step=step, t=rec.wall_time,
+                            **rec.metrics)
 
     def log_json(self, payload: Dict[str, Any]) -> None:
         if self.enabled:
-            print(json.dumps(payload), file=self.stream, flush=True)
+            event = payload.get("event", "log")
+            fields = {k: v for k, v in payload.items() if k != "event"}
+            self._registry.emit(event, **fields)
 
     def performance_table(self, learning_rate: float) -> str:
         """Render EVAL records (val_accuracy rows only — per-step training
@@ -73,4 +99,8 @@ class Timer:
         return self
 
     def __exit__(self, *exc) -> None:
+        if self._start is None:
+            # __exit__ without __enter__ (manually driven context):
+            # keep elapsed at 0.0 instead of TypeError-ing on None.
+            return
         self.elapsed = time.time() - self._start

@@ -60,11 +60,17 @@ class StepProfiler:
             self.stop(pending)
 
     def stop(self, pending=None) -> None:
+        """Finalize an open trace window. Safe to call when no window is
+        open; the drain is try/finally-wrapped so a failing device_get
+        (e.g. the very exception that ended training) still closes the
+        trace instead of leaving it running into interpreter exit."""
         if self._running:
-            if pending is not None:
-                jax.device_get(pending)  # drain in-flight traced steps
-            jax.profiler.stop_trace()
-            self._running = False
+            try:
+                if pending is not None:
+                    jax.device_get(pending)  # drain in-flight traced steps
+            finally:
+                jax.profiler.stop_trace()
+                self._running = False
 
 
 @contextlib.contextmanager

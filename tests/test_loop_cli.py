@@ -65,16 +65,53 @@ def test_train_resume_roundtrip(tmp_path):
 
 def test_train_resume_roundtrip_async_checkpoints(tmp_path):
     """checkpoint_async=True: cadence saves overlap training, the loop
-    flushes the writer on exit, and resume lands on the same step."""
-    cfg = _cfg(train_steps=10, checkpoint_dir=str(tmp_path),
-               checkpoint_every=5, checkpoint_async=True)
-    train(cfg)
-    from tensorflow_distributed_tpu.train import checkpoint as ckpt
-    assert ckpt.latest_step(str(tmp_path)) == 10  # flushed before return
-    cfg2 = _cfg(train_steps=14, checkpoint_dir=str(tmp_path),
-                checkpoint_every=5, checkpoint_async=True, resume=True)
-    r2 = train(cfg2)
-    assert int(jax.device_get(r2.state.step)) == 14
+    flushes the writer on exit, and resume lands on the same step.
+
+    Runs in a SUBPROCESS: concurrent device_put (prefetch thread) +
+    dispatch + the background writer thread intermittently SIGSEGVs
+    the XLA:CPU runtime on the CI container — reproducible on the
+    untouched seed tree — and an in-process crash aborts the whole
+    pytest run. Isolation turns a host-runtime crash into a plain
+    failure; one retry absorbs the known flake (a real regression in
+    the checkpoint logic fails both attempts deterministically).
+    """
+    import subprocess
+    import sys
+
+    script = """
+import jax
+from tensorflow_distributed_tpu.config import MeshConfig, TrainConfig
+from tensorflow_distributed_tpu.train import checkpoint as ckpt
+from tensorflow_distributed_tpu.train.loop import train
+
+def cfg(**kw):
+    base = dict(dataset="synthetic", batch_size=128, train_steps=40,
+                eval_every=0, log_every=0, eval_batch_size=128,
+                compute_dtype="float32", mesh=MeshConfig(data=8))
+    base.update(kw)
+    return TrainConfig(**base)
+
+d = %r
+train(cfg(train_steps=10, checkpoint_dir=d, checkpoint_every=5,
+          checkpoint_async=True))
+assert ckpt.latest_step(d) == 10  # flushed before return
+r2 = train(cfg(train_steps=14, checkpoint_dir=d, checkpoint_every=5,
+               checkpoint_async=True, resume=True))
+assert int(jax.device_get(r2.state.step)) == 14
+print("ASYNC_RESUME_OK")
+""" % str(tmp_path)
+    for attempt in (1, 2):
+        proc = subprocess.run([sys.executable, "-c", script],
+                              capture_output=True, text=True,
+                              timeout=300)
+        if proc.returncode == 0:
+            assert "ASYNC_RESUME_OK" in proc.stdout
+            return
+        if proc.returncode >= 0:  # real assertion/exception: no retry
+            break
+    raise AssertionError(
+        f"async resume subprocess failed (rc={proc.returncode}):\n"
+        f"{proc.stdout}\n{proc.stderr[-2000:]}")
 
 
 def test_eval_only_mode(tmp_path):

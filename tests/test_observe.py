@@ -1,0 +1,446 @@
+"""The observe/ subsystem: registry + sinks, step-time breakdown on a
+fake clock, MFU accounting for known configs, Chrome-trace validity,
+goodput ledger, the report tool, and the CPU-only end-to-end run the
+acceptance criteria name. All tier-1 fast."""
+
+import io
+import json
+
+import numpy as np
+import pytest
+
+from tensorflow_distributed_tpu.observe.goodput import GoodputCounter
+from tensorflow_distributed_tpu.observe.mfu import (
+    ThroughputAccountant, attn_flops_per_token_fwd, flops_per_item,
+    flops_per_token, matmul_params)
+from tensorflow_distributed_tpu.observe.registry import (
+    CsvSink, JsonlSink, MetricsRegistry, StdoutSink, config_hash)
+from tensorflow_distributed_tpu.observe.steptime import (
+    StepTimeBreakdown, percentile)
+from tensorflow_distributed_tpu.observe.trace import ChromeTracer, load_trace
+
+
+class FakeClock:
+    """Deterministic clock: advance() by hand, call like time.*()."""
+
+    def __init__(self, start=100.0):
+        self.now = start
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, dt):
+        self.now += dt
+
+
+# --- registry + sinks ----------------------------------------------------
+
+def test_jsonl_sink_round_trip(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    reg = MetricsRegistry([JsonlSink(path)], tags={"process_index": 0})
+    emitted = [
+        reg.emit("start", model="gpt_lm", params=25408),
+        reg.emit("step", step=10, loss=3.25, mfu=0.41),
+        reg.emit("summary", goodput=0.97),
+    ]
+    reg.close()
+    read = [json.loads(line) for line in open(path)]
+    assert read == emitted
+    assert all(r["process_index"] == 0 for r in read)
+    assert read[1]["loss"] == 3.25
+
+
+def test_registry_chief_only_and_ring_buffer(tmp_path):
+    path = str(tmp_path / "quiet.jsonl")
+    reg = MetricsRegistry([JsonlSink(path)], enabled=False,
+                          max_records=5)
+    for i in range(12):
+        reg.emit("step", step=i)
+    reg.close()
+    # Non-chief: no sink output, but the bounded buffer still fills.
+    assert not (tmp_path / "quiet.jsonl").exists()
+    assert len(reg.records) == 5
+    assert reg.records[0]["step"] == 7  # oldest rows dropped first
+
+
+def test_csv_sink_union_header(tmp_path):
+    path = str(tmp_path / "m.csv")
+    sink = CsvSink(path)
+    reg = MetricsRegistry([sink])
+    reg.emit("start", model="x")            # filtered out (not a step)
+    reg.emit("step", step=1, loss=2.0)
+    reg.emit("step", step=2, loss=1.5, mfu=0.4)  # late column
+    reg.close()
+    rows = list(open(path))
+    header = rows[0].strip().split(",")
+    assert "mfu" in header and "loss" in header
+    assert len(rows) == 3  # header + 2 step rows, start dropped
+
+
+def test_stdout_sink_step_format():
+    buf = io.StringIO()
+    reg = MetricsRegistry([StdoutSink(buf)])
+    reg.emit("step", step=7, loss=1.25)
+    reg.emit("done", steps=7)
+    out = buf.getvalue().splitlines()
+    assert out[0].startswith("[step      7] t=")
+    assert "loss=1.25" in out[0]
+    assert json.loads(out[1])["event"] == "done"
+
+
+def test_config_hash_stable_and_order_free():
+    assert config_hash({"a": 1, "b": 2}) == config_hash({"b": 2, "a": 1})
+    assert config_hash({"a": 1}) != config_hash({"a": 2})
+
+
+def test_jsonl_sink_replaces_previous_run(tmp_path):
+    """Reruns replace (the repo-wide artifact rule): a second run's
+    first emit truncates the previous run's file so observe.report
+    never aggregates across runs."""
+    path = str(tmp_path / "m.jsonl")
+    r1 = MetricsRegistry([JsonlSink(path)])
+    r1.emit("step", step=1)
+    r1.emit("step", step=2)
+    r1.close()
+    r2 = MetricsRegistry([JsonlSink(path)])
+    r2.emit("step", step=99)
+    r2.close()
+    rows = [json.loads(line) for line in open(path)]
+    assert [r["step"] for r in rows] == [99]
+
+
+# --- step-time breakdown -------------------------------------------------
+
+def test_steptime_breakdown_fake_clock():
+    clk = FakeClock()
+    st = StepTimeBreakdown(window=10, clock=clk)
+    for _ in range(4):
+        st.data_start()
+        clk.advance(0.010)   # data wait
+        st.data_end()
+        clk.advance(0.002)   # dispatch
+        st.dispatch_end()
+        clk.advance(0.030)   # device
+        st.device_end()
+        clk.advance(0.001)   # cadence host work
+        rec = st.step_end()
+    assert rec["data"] == pytest.approx(0.010)
+    assert rec["dispatch"] == pytest.approx(0.002)
+    assert rec["device"] == pytest.approx(0.030)
+    assert rec["host"] == pytest.approx(0.001)
+    assert rec["total"] == pytest.approx(0.043)
+    s = st.summary()
+    assert s["data_ms"] == pytest.approx(10.0)
+    assert s["step_ms_p50"] == pytest.approx(43.0)
+    assert s["step_ms_p95"] == pytest.approx(43.0)
+    assert st.steps == 4
+
+
+def test_steptime_missing_phases_count_zero():
+    clk = FakeClock()
+    st = StepTimeBreakdown(clock=clk)
+    st.data_start()
+    clk.advance(0.005)
+    st.data_end()
+    clk.advance(0.001)
+    rec = st.step_end()  # no dispatch/device marks
+    assert rec["dispatch"] == 0.0 and rec["device"] == 0.0
+    assert rec["total"] == pytest.approx(0.006)
+
+
+def test_percentile_nearest_rank():
+    vals = [1.0, 2.0, 3.0, 4.0, 5.0]
+    assert percentile(vals, 50) == 3.0
+    assert percentile(vals, 95) == 5.0
+    assert percentile(vals, 0) == 1.0
+    with pytest.raises(ValueError):
+        percentile([], 50)
+
+
+# --- MFU accounting ------------------------------------------------------
+
+def test_matmul_params_skips_embeddings_and_scales_moe():
+    params = {
+        "layer_0": {"mlp": {"w": np.zeros((32, 64))}},           # 2048
+        "tok_emb": {"embedding": np.zeros((64, 32))},            # skipped
+        "moe_mlp": {"wi": np.zeros((4, 32, 64))},                # 8192
+        "bias": {"b": np.zeros((64,))},                          # ndim 1
+    }
+    assert matmul_params(params) == 2048 + 8192  # no MoE hints: full
+    # top_k=2 of 4 experts -> half the expert weights per token.
+    assert matmul_params(params, moe_experts=4, moe_top_k=2) == (
+        2048 + 8192 / 2)
+
+
+def test_flops_per_token_known_tiny_config():
+    from tensorflow_distributed_tpu.models.transformer import tiny_config
+
+    cfg = tiny_config(causal=True, max_len=32)  # d_model=32, n_layers=2
+    params = {"w": np.zeros((32, 64))}  # N = 2048
+    # attention fwd/token: 4 * d_model * n_layers * (L/2) = 4*32*2*16
+    assert attn_flops_per_token_fwd(cfg) == 4096.0
+    assert flops_per_token(params, cfg) == 3.0 * (2.0 * 2048 + 4096)
+    # seq_len override shrinks the attended length.
+    assert attn_flops_per_token_fwd(cfg, seq_len=16) == 2048.0
+
+
+def test_flops_per_item_families():
+    flops, unit = flops_per_item("mnist_cnn")
+    assert unit == "image"
+    # conv1 + conv2 + dense1 + dense2 MACs, x2 per MAC, x3 train.
+    assert flops == 3.0 * 2.0 * (5*5*1*32*28*28 + 5*5*32*64*14*14
+                                 + 3136*1024 + 1024*10)
+    none_flops, unit = flops_per_item("resnet20")
+    assert none_flops is None and unit == "image"  # honest: no estimate
+
+
+def test_throughput_accountant_rates():
+    acc = ThroughputAccountant(flops_per_item=1e9, unit="token",
+                               peak_flops_total=1e12)
+    r = acc.rates(items=1000, seconds=2.0)
+    assert r["tokens_per_sec"] == 500.0
+    assert r["model_tflops"] == pytest.approx(0.5)
+    assert r["mfu"] == pytest.approx(0.5)
+    assert acc.rates(0, 1.0) == {}  # empty window -> no rates
+    # No peak -> throughput + tflops only, no invented MFU.
+    r2 = ThroughputAccountant(flops_per_item=1e9, unit="token").rates(
+        1000, 2.0)
+    assert "mfu" not in r2 and r2["model_tflops"] == pytest.approx(0.5)
+
+
+def test_note_step_fn_enables_hw_mfu():
+    """A step function advertising observe_hw_recompute (the 1F1B
+    recompute schedule, train.pipeline_step) switches the accountant to
+    also report hw-MFU; ordinary steps don't."""
+    from tensorflow_distributed_tpu.models.transformer import tiny_config
+    from tensorflow_distributed_tpu.observe.hub import Observatory
+
+    cfg = tiny_config(causal=True, max_len=32)
+    params = {"blocks": {"w": np.zeros((32, 64))},
+              "tok_emb": {"embedding": np.zeros((64, 32))}}
+    obs = Observatory(accountant=ThroughputAccountant(
+        flops_per_item=1.0, unit="token", peak_flops_total=1e12))
+    obs.seq_len = 32
+
+    def plain_step(state, batch):
+        return state, {}
+
+    obs.note_step_fn(plain_step, params=params, model_cfg=cfg)
+    assert obs.accountant.hw_flops_per_item is None
+    plain_step.observe_hw_recompute = True
+    obs.note_step_fn(plain_step, params=params, model_cfg=cfg)
+    # model 3x-fwd + one extra block forward (2N_blocks + attn).
+    assert obs.accountant.hw_flops_per_item == (
+        3.0 * (2.0 * 2048 + 4096) + 2.0 * 2048 + 4096)
+    obs.close()
+
+
+# --- Chrome trace --------------------------------------------------------
+
+def test_chrome_trace_valid_and_complete(tmp_path):
+    path = str(tmp_path / "trace.json")
+    clk = FakeClock()
+    tr = ChromeTracer(path, pid=3, process_name="test", clock=clk)
+    with tr.span("data"):
+        clk.advance(0.002)
+    with tr.span("dispatch", step=4):
+        clk.advance(0.001)
+    tr.instant("preempted", step=9)
+    tr.counter("mfu", mfu=0.41)
+    tr.close()
+    events = load_trace(path)  # json.loads validity via the loader
+    assert all("ph" in e and "name" in e for e in events)
+    spans = [e for e in events if e["ph"] == "X"]
+    assert {s["name"] for s in spans} == {"data", "dispatch"}
+    assert all("ts" in s and s["dur"] > 0 for s in spans)
+    assert spans[0]["dur"] == pytest.approx(2000.0)  # microseconds
+    assert [e for e in events if e["ph"] == "i"][0]["args"]["step"] == 9
+    assert [e for e in events if e["ph"] == "C"][0]["args"]["mfu"] == 0.41
+
+
+def test_chrome_trace_caps_events(tmp_path):
+    """Host memory stays bounded on long traced runs: past max_events
+    new events drop (counted) and the written file carries a marker."""
+    path = str(tmp_path / "trace.json")
+    tr = ChromeTracer(path, max_events=5, clock=FakeClock())
+    for i in range(12):
+        tr.instant(f"e{i}")
+    tr.close()
+    assert tr.dropped == 7
+    events = load_trace(path)
+    assert len(events) == 6  # 5 kept + the dropped-events marker
+    assert "dropped" in events[-1]["name"]
+
+
+def test_chrome_trace_disabled_writes_nothing(tmp_path):
+    tr = ChromeTracer("", enabled=True)
+    with tr.span("x"):
+        pass
+    tr.close()
+    assert not list(tmp_path.iterdir())
+
+
+# --- goodput -------------------------------------------------------------
+
+def test_goodput_outermost_category_wins():
+    clk = FakeClock()
+    c = GoodputCounter(clock=clk)
+    with c.account("drain"):
+        with c.account("checkpoint"):  # nested: suppressed
+            clk.advance(3.0)
+        clk.advance(1.0)
+    with c.account("eval"):
+        clk.advance(2.0)
+    clk.advance(4.0)  # productive time
+    s = c.summary()
+    assert c.overhead == {"drain": pytest.approx(4.0),
+                          "eval": pytest.approx(2.0)}
+    assert "checkpoint_seconds" not in s
+    assert s["total_seconds"] == pytest.approx(10.0)
+    assert s["productive_seconds"] == pytest.approx(4.0)
+    assert s["goodput"] == pytest.approx(0.4)
+
+
+def test_goodput_charged_includes_in_flight_block():
+    """charged() counts the elapsed part of an open outermost block —
+    what lets preemption drain accounting bracket a window exactly
+    even when the SIGTERM lands mid-eval."""
+    clk = FakeClock()
+    c = GoodputCounter(clock=clk)
+    with c.account("eval"):
+        clk.advance(30.0)
+        snap = c.charged()        # mid-block: 30s in flight
+        assert snap == pytest.approx(30.0)
+        clk.advance(30.0)
+    assert c.charged() == pytest.approx(60.0)
+    # Window [snap, now] overhead = difference of snapshots.
+    assert c.charged() - snap == pytest.approx(30.0)
+
+
+def test_goodput_module_hooks_are_noop_without_active():
+    from tensorflow_distributed_tpu.observe import goodput
+
+    assert goodput.get_active() is None
+    with goodput.account("checkpoint"):
+        pass  # must not raise
+    goodput.add("restore", 1.0)  # must not raise
+
+
+def test_checkpoint_save_charges_goodput(tmp_path):
+    """train.checkpoint's save/wait/restore are accounted on the active
+    counter (the tentpole's preemption/checkpoint hook)."""
+    import optax
+
+    from tensorflow_distributed_tpu.models.cnn import MnistCNN
+    from tensorflow_distributed_tpu.observe import goodput
+    from tensorflow_distributed_tpu.parallel.mesh import make_mesh
+    from tensorflow_distributed_tpu.config import MeshConfig
+    from tensorflow_distributed_tpu.train import checkpoint as ckpt
+    from tensorflow_distributed_tpu.train.state import create_train_state
+
+    mesh = make_mesh(MeshConfig())  # data = all local devices
+    state = create_train_state(
+        MnistCNN(), optax.adam(1e-3),
+        np.zeros((2, 28, 28, 1), np.float32), mesh)
+    counter = GoodputCounter()
+    goodput.set_active(counter)
+    try:
+        ckpt.save(str(tmp_path), state)
+        ckpt.restore(str(tmp_path), state)
+    finally:
+        goodput.set_active(None)
+    assert counter.overhead["checkpoint"] > 0
+    assert counter.overhead["restore"] > 0
+
+
+# --- satellites ----------------------------------------------------------
+
+def test_timer_exit_without_enter_is_safe():
+    from tensorflow_distributed_tpu.utils.logging import Timer
+
+    t = Timer()
+    t.__exit__(None, None, None)  # regression: used to TypeError
+    assert t.elapsed == 0.0
+
+
+def test_metric_logger_ring_buffer_cap():
+    from tensorflow_distributed_tpu.utils.logging import MetricLogger
+
+    logger = MetricLogger(enabled=False, max_records=5)
+    for i in range(12):
+        logger.log(i, loss=float(i))
+    assert len(logger.records) == 5
+    assert logger.records[0].step == 7
+
+
+def test_metric_logger_shim_emits_through_registry():
+    buf = io.StringIO()
+    from tensorflow_distributed_tpu.utils.logging import MetricLogger
+
+    logger = MetricLogger(enabled=True, stream=buf)
+    logger.log(3, loss=2.5)
+    logger.log_json({"event": "done", "steps": 3})
+    lines = buf.getvalue().splitlines()
+    assert lines[0].startswith("[step      3]") and "loss=2.5" in lines[0]
+    assert '"event": "done"' in lines[1]
+
+
+# --- report tool ---------------------------------------------------------
+
+def test_report_summarizes_jsonl(tmp_path, capsys):
+    from tensorflow_distributed_tpu.observe import report
+
+    path = str(tmp_path / "m.jsonl")
+    reg = MetricsRegistry([JsonlSink(path)])
+    reg.emit("start", model="gpt_lm")
+    reg.emit("step", step=10, loss=3.0, step_ms_p50=21.0,
+             step_ms_p95=30.0, tokens_per_sec=9000.0, mfu=0.41)
+    reg.emit("step", step=20, loss=2.5, step_ms_p50=20.0,
+             step_ms_p95=29.0, tokens_per_sec=11000.0, mfu=0.43)
+    reg.emit("summary", goodput=0.93, checkpoint_seconds=1.5)
+    reg.close()
+
+    assert report.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "step_ms_p50" in out and "goodput" in out
+
+    s = report.summarize(report.load_records(path))
+    assert s["step_records"] == 2 and s["last_step"] == 20
+    assert s["step_ms_p50"] == 20.0      # freshest rolling window
+    assert s["mean_mfu"] == pytest.approx(0.42)
+    assert s["mean_tokens_per_sec"] == pytest.approx(10000.0)
+    assert s["goodput"] == 0.93
+    assert s["first_loss"] == 3.0 and s["last_loss"] == 2.5
+
+
+def test_report_bad_file_exits_nonzero(tmp_path, capsys):
+    from tensorflow_distributed_tpu.observe import report
+
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text("{not json\n")
+    assert report.main([str(bad)]) == 1
+    assert report.main([str(tmp_path / "missing.jsonl")]) == 1
+
+
+# --- config surface ------------------------------------------------------
+
+def test_observe_config_validation():
+    from tensorflow_distributed_tpu.config import ObserveConfig, TrainConfig
+
+    with pytest.raises(ValueError, match="observe.window"):
+        TrainConfig(observe=ObserveConfig(window=0)).validate()
+    with pytest.raises(ValueError, match="max_records"):
+        TrainConfig(observe=ObserveConfig(max_records=0)).validate()
+    with pytest.raises(ValueError, match="peak_tflops"):
+        TrainConfig(observe=ObserveConfig(peak_tflops=-1)).validate()
+
+
+def test_observe_cli_flags():
+    from tensorflow_distributed_tpu.config import parse_args
+
+    cfg = parse_args(["--observe.metrics-jsonl", "/tmp/m.jsonl",
+                      "--observe.trace", "/tmp/t.json",
+                      "--observe.peak-tflops", "275"])
+    assert cfg.observe.metrics_jsonl == "/tmp/m.jsonl"
+    assert cfg.observe.trace == "/tmp/t.json"
+    assert cfg.observe.peak_tflops == 275.0
