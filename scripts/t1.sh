@@ -1,0 +1,44 @@
+#!/usr/bin/env bash
+# Tier-1 wrapper: the ROADMAP.md verify command plus ONE automatic rerun
+# when the suite dies to the known container XLA:CPU SIGSEGV/heap-abort
+# (the jax runtime intermittently corrupts the allocator under
+# concurrent dispatch + host transfers; reproduced on the untouched
+# seed tree). A genuine test failure still prints a pytest summary line
+# and is NOT retried — the abort is detected specifically via a MISSING
+# summary line, so tier-1 numbers stop being flake-gated without ever
+# masking a real red.
+#
+# Usage: scripts/t1.sh          (from the repo root)
+#   T1_LOG=/path/override.log scripts/t1.sh
+set -o pipefail
+
+LOG="${T1_LOG:-/tmp/_t1.log}"
+cd "$(dirname "$0")/.."
+
+run_suite() {
+  rm -f "$LOG"
+  timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee "$LOG"
+  return "${PIPESTATUS[0]}"
+}
+
+has_summary_line() {
+  # pytest's final tally ("34 failed, 303 passed, ... in 493.83s" —
+  # bare under -q, ===-decorated otherwise, "no tests ran" when
+  # collection found nothing); a runtime abort kills the process
+  # before it prints.
+  grep -qaE '([0-9]+ (passed|failed|errors?)|no tests ran)' "$LOG"
+}
+
+run_suite
+rc=$?
+if ! has_summary_line; then
+  echo "[t1] no pytest summary line in $LOG (known container XLA:CPU" \
+       "abort) — rerunning once" >&2
+  run_suite
+  rc=$?
+fi
+
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | wc -c)"
+exit "$rc"

@@ -28,4 +28,10 @@ Package layout:
 
 __version__ = "0.1.0"
 
-from tensorflow_distributed_tpu.config import TrainConfig  # noqa: F401
+# Fill jax API-skew gaps (jax.shard_map / get_abstract_mesh on older
+# containers) before any module touches them; no-op on current jax.
+from tensorflow_distributed_tpu.utils import jaxcompat as _jaxcompat
+
+_jaxcompat.install()
+
+from tensorflow_distributed_tpu.config import TrainConfig  # noqa: F401,E402

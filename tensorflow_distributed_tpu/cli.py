@@ -25,10 +25,20 @@ from typing import Optional, Sequence
 
 from tensorflow_distributed_tpu.config import parse_args
 from tensorflow_distributed_tpu.parallel.mesh import is_chief
+from tensorflow_distributed_tpu.resilience.watchdog import StallError
 from tensorflow_distributed_tpu.train.loop import (
     evaluate_only, generate_only, train)
 from tensorflow_distributed_tpu.utils.compilecache import (
     enable_persistent_cache)
+
+# Distinct exit codes for the failure classes a supervisor (e.g.
+# resilience.supervisor) or scheduler wants to tell apart in logs:
+# 2 = training diverged (non-finite halt / recovery budget exhausted —
+# a restart will usually re-diverge), 3 = stall watchdog fired (a
+# restart is exactly the remedy). Clean completion and graceful
+# preemption both exit 0.
+EXIT_DIVERGED = 2
+EXIT_STALLED = 3
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -40,7 +50,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if cfg.mode == "generate":
         generate_only(cfg)
         return 0
-    result = train(cfg)
+    try:
+        result = train(cfg)
+    except FloatingPointError as e:
+        print(f"[resilience] diverged: {e}", file=sys.stderr, flush=True)
+        return EXIT_DIVERGED
+    except StallError as e:
+        print(f"[resilience] stalled: {e}", file=sys.stderr, flush=True)
+        return EXIT_STALLED
     if is_chief():
         # Emit the reference's hand-maintained `performance` table
         # automatically (performance:1-6).

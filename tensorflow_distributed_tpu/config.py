@@ -109,6 +109,90 @@ class ObserveConfig:
 
 
 @dataclasses.dataclass
+class ResilienceConfig:
+    """The resilience/ subsystem's knobs (see resilience package docs
+    and the README "Fault tolerance" section). All off by default —
+    the loop's hot path pays nothing unless a policy, watchdog, or
+    fault plan is configured. Checkpoint-save retries are the one
+    always-on piece (they cost nothing until a save actually fails)."""
+
+    # Deterministic fault-injection plan, e.g.
+    # "nan_grad@40,ckpt_io_fail@80,data_stall@120:5s,sigterm@200" —
+    # comma-separated kind@step[:arg] events (resilience/faults.py).
+    # Kinds: nan_grad (NaN-poison that step's batch -> genuinely
+    # non-finite loss AND gradients), ckpt_io_fail (:N failures,
+    # default 1, injected into the next checkpoint save's write path),
+    # data_stall (:duration, e.g. 5s, slept inside the batch fetch so
+    # the watchdog sees it), sigterm / sigkill (self-signal when the
+    # step is dispatched; first-leg only, so a supervised restart
+    # terminates). Test/drill harness — empty in production runs.
+    fault_plan: str = ""
+    # Non-finite-loss policy, checked per step on the metrics the loop
+    # already retires: "off" (legacy: train on, unless the separate
+    # halt_on_nonfinite cadence check fires), "halt" (flush saves,
+    # raise), "skip_batch" (the jitted step discards that batch's
+    # update on device — params/opt state/EMA keep their pre-step
+    # values, the step counter still advances — and the host charges
+    # the skip budget), "rewind" (restore the newest verifiable
+    # checkpoint in-process and re-enter the loop from there).
+    nonfinite: str = "off"  # off | halt | skip_batch | rewind
+    # Recovery budgets: exceeding either halts with a clear error —
+    # unbounded skipping/rewinding would loop forever on a truly
+    # diverged run.
+    max_skips: int = 3
+    max_rewinds: int = 1
+    # Loss-spike detection over a rolling window: a FINITE loss >
+    # spike_factor x the window median counts as a divergence event
+    # (emitted always; under nonfinite=rewind it also triggers a
+    # budgeted rewind — a skip can't help, the update already
+    # applied). 0 = off.
+    spike_window: int = 0
+    spike_factor: float = 10.0
+    # Watchdog timeouts (seconds; 0 = off): next-batch fetch and
+    # device sync. A breach raises StallError — a diagnosable failure
+    # instead of a silent hang. Multi-host caveat: always raise, never
+    # unilaterally skip (an uncoordinated skip desyncs the SPMD
+    # programs; resilience/watchdog.py).
+    data_timeout_s: float = 0.0
+    sync_timeout_s: float = 0.0
+    # Capped-exponential-backoff retries around checkpoint save I/O
+    # (train/checkpoint.py::set_io_policy): transient FS errors retry
+    # instead of killing the run.
+    save_retries: int = 2
+    save_retry_backoff_s: float = 0.05
+
+    def validate(self) -> None:
+        if self.nonfinite not in ("off", "halt", "skip_batch",
+                                  "rewind"):
+            raise ValueError(
+                f"unknown resilience.nonfinite {self.nonfinite!r}; "
+                f"have ('off', 'halt', 'skip_batch', 'rewind')")
+        if self.max_skips < 0 or self.max_rewinds < 0:
+            raise ValueError(
+                "resilience.max_skips/max_rewinds must be >= 0")
+        if self.spike_window < 0:
+            raise ValueError(
+                f"resilience.spike_window must be >= 0, "
+                f"got {self.spike_window}")
+        if self.spike_window and self.spike_factor <= 1.0:
+            raise ValueError(
+                f"resilience.spike_factor must be > 1, "
+                f"got {self.spike_factor}")
+        if self.data_timeout_s < 0 or self.sync_timeout_s < 0:
+            raise ValueError(
+                "resilience timeouts must be >= 0 (0 disables)")
+        if self.save_retries < 0 or self.save_retry_backoff_s < 0:
+            raise ValueError(
+                "resilience.save_retries/save_retry_backoff_s must "
+                "be >= 0")
+        if self.fault_plan:
+            # Parse for syntax errors at config time, not mid-run.
+            from tensorflow_distributed_tpu.resilience.faults import (
+                parse_fault_plan)
+            parse_fault_plan(self.fault_plan)
+
+
+@dataclasses.dataclass
 class TrainConfig:
     """Everything needed to run one training job, any model, any mesh."""
 
@@ -380,6 +464,13 @@ class TrainConfig:
     # --observe.metrics-jsonl, --observe.trace, --observe.peak-tflops...
     observe: ObserveConfig = dataclasses.field(
         default_factory=ObserveConfig)
+
+    # --- resilience ------------------------------------------------------
+    # Fault-tolerance policies and drills (resilience/ package). CLI
+    # flags: --resilience.nonfinite, --resilience.fault-plan,
+    # --resilience.data-timeout-s...
+    resilience: ResilienceConfig = dataclasses.field(
+        default_factory=ResilienceConfig)
 
     # --- misc ------------------------------------------------------------
     seed: int = 0
@@ -755,8 +846,33 @@ class TrainConfig:
             raise ValueError(f"unknown norm {self.norm!r}")
         if self.mode == "eval" and not self.checkpoint_dir:
             raise ValueError("mode=eval requires checkpoint_dir")
+        if self.resilience.nonfinite == "rewind" and not self.checkpoint_dir:
+            raise ValueError(
+                "resilience.nonfinite=rewind restores the newest "
+                "verifiable checkpoint in-process; it requires "
+                "checkpoint_dir")
+        if self.resilience.nonfinite == "skip_batch":
+            if (self.model == "pipelined_lm"
+                    and self.pipeline_schedule == "1f1b"):
+                raise ValueError(
+                    "resilience.nonfinite=skip_batch is implemented in "
+                    "the standard jitted step (the update is discarded "
+                    "on device); the hand-scheduled 1F1B step has no "
+                    "skip path — use nonfinite=rewind or halt")
+            if self.param_sync_every > 1:
+                raise ValueError(
+                    "resilience.nonfinite=skip_batch does not compose "
+                    "with param_sync_every > 1 (the local-SGD step has "
+                    "no skip path); use nonfinite=rewind or halt")
+        if self.halt_on_nonfinite and self.resilience.nonfinite != "off":
+            raise ValueError(
+                "halt_on_nonfinite=true and resilience.nonfinite are "
+                "two handlers for the same event — drop "
+                "halt_on_nonfinite (resilience.nonfinite=halt is its "
+                "per-step superset)")
         self.mesh.validate()
         self.observe.validate()
+        self.resilience.validate()
 
 
 def _add_dataclass_args(parser: argparse.ArgumentParser, cls, prefix: str = "") -> None:

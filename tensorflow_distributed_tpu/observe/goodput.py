@@ -33,12 +33,21 @@ class GoodputCounter:
         self._lock = threading.Lock()
         self._local = threading.local()
         self.overhead: Dict[str, float] = {}
+        self.events: Dict[str, int] = {}
         self._t0 = clock()
 
     def add(self, category: str, seconds: float) -> None:
         with self._lock:
             self.overhead[category] = (
                 self.overhead.get(category, 0.0) + seconds)
+
+    def incr(self, event: str, n: int = 1) -> None:
+        """Count a recovery event (rewind, ckpt_retry, quarantine,
+        stall, skip) — the ledger's how-often companion to the
+        how-long overhead categories; surfaces as ``<event>_count`` in
+        :meth:`summary`."""
+        with self._lock:
+            self.events[event] = self.events.get(event, 0) + n
 
     @contextlib.contextmanager
     def account(self, category: str) -> Iterator[None]:
@@ -85,9 +94,11 @@ class GoodputCounter:
                  else self._clock() - self._t0)
         with self._lock:
             overhead = dict(self.overhead)
+            events = dict(self.events)
         spent = sum(overhead.values())
         productive = max(total - spent, 0.0)
         out = {f"{k}_seconds": round(v, 4) for k, v in overhead.items()}
+        out.update({f"{k}_count": v for k, v in events.items()})
         out["total_seconds"] = round(total, 4)
         out["productive_seconds"] = round(productive, 4)
         out["goodput"] = round(productive / total, 4) if total > 0 else 0.0
@@ -124,6 +135,12 @@ def add(category: str, seconds: float) -> None:
     counter = _active
     if counter is not None and seconds > 0:
         counter.add(category, seconds)
+
+
+def incr(event: str, n: int = 1) -> None:
+    counter = _active
+    if counter is not None:
+        counter.incr(event, n)
 
 
 def accounted(category: str):

@@ -21,6 +21,7 @@ from typing import Any, Dict, Iterator, Optional
 from tensorflow_distributed_tpu.observe import goodput as goodput_mod
 from tensorflow_distributed_tpu.observe import mfu as mfu_mod
 from tensorflow_distributed_tpu.observe.goodput import GoodputCounter
+from tensorflow_distributed_tpu.observe import registry as registry_mod
 from tensorflow_distributed_tpu.observe.registry import (
     CsvSink, JsonlSink, MetricsRegistry, host_tags)
 from tensorflow_distributed_tpu.observe.steptime import StepTimeBreakdown
@@ -66,6 +67,9 @@ class Observatory:
         self._last_log: Optional[tuple] = None  # (step, clock)
         if self.active:
             goodput_mod.set_active(self.goodput)
+            # Library-level recovery events (checkpoint retries,
+            # quarantines, watchdog stalls) flow to the same sinks.
+            registry_mod.set_active(self.registry)
 
     # -- construction -----------------------------------------------------
     @classmethod
@@ -216,5 +220,7 @@ class Observatory:
     def close(self) -> None:
         if goodput_mod.get_active() is self.goodput:
             goodput_mod.set_active(None)
+        if registry_mod.get_active() is self.registry:
+            registry_mod.set_active(None)
         self.tracer.close()
         self.registry.close()

@@ -22,6 +22,7 @@ import collections
 import hashlib
 import json
 import sys
+import threading
 import time
 from typing import Any, Dict, Iterable, Mapping, Optional, TextIO
 
@@ -104,14 +105,21 @@ class JsonlSink(Sink):
         self.path = path
         self.append = append
         self._f: Optional[TextIO] = None
+        self._closed = False
 
     def emit(self, record: Mapping[str, Any]) -> None:
+        if self._closed:
+            # A straggler emit (e.g. a background writer's retry
+            # event racing run teardown) must not LAZILY REOPEN the
+            # file — mode "w" would truncate the finished artifact.
+            return
         if self._f is None:
             self._f = open(self.path, "a" if self.append else "w")
         self._f.write(json.dumps(dict(record)) + "\n")
         self._f.flush()
 
     def close(self) -> None:
+        self._closed = True
         if self._f is not None:
             self._f.close()
             self._f = None
@@ -163,6 +171,39 @@ class CsvSink(Sink):
         self._rows.clear()
 
 
+# --- module-level indirection (resilience / train.checkpoint) ----------
+#
+# Deep library code (checkpoint retries, watchdog stalls, quarantines)
+# must emit recovery events through the RUN's registry without the
+# run threading a registry handle through every call — the same
+# pattern observe.goodput uses for its active counter. The Observatory
+# installs its registry here; emit_event is a no-op without one, so
+# the library modules stay importable and free outside a training run.
+
+_active_registry: Optional["MetricsRegistry"] = None
+
+
+def set_active(registry: Optional["MetricsRegistry"]) -> None:
+    """Install the run's registry (observe.hub.Observatory does)."""
+    global _active_registry
+    _active_registry = registry
+
+
+def get_active() -> Optional["MetricsRegistry"]:
+    return _active_registry
+
+
+def emit_event(event: str, **fields: Any) -> None:
+    """Emit through the active registry; no-op when none is installed.
+
+    The resilience subsystem routes every recovery event (checkpoint
+    retries, quarantines, stall detections, injected faults) through
+    here so they land in the same JSONL/CSV artifacts as step records.
+    """
+    if _active_registry is not None:
+        _active_registry.emit(event, **fields)
+
+
 class MetricsRegistry:
     """Collects records, tags them, and fans out to sinks.
 
@@ -181,6 +222,12 @@ class MetricsRegistry:
             maxlen=max_records)
         self._clock = clock
         self._t0 = clock()
+        # emit() is no longer main-thread-only: the background
+        # checkpoint writer emits ckpt_retry recovery events
+        # concurrently with the loop's step records. One lock keeps
+        # sink writes whole-line (JSONL lazy-open included) and the
+        # ring buffer consistent.
+        self._lock = threading.Lock()
 
     def emit(self, event: str, **fields: Any) -> Dict[str, Any]:
         rec: Dict[str, Any] = {
@@ -188,12 +235,18 @@ class MetricsRegistry:
             "t": round(self._clock() - self._t0, 6),
             **self.tags, **fields,
         }
-        self.records.append(rec)
-        if self.enabled:
-            for sink in self.sinks:
-                sink.emit(rec)
+        with self._lock:
+            self.records.append(rec)
+            if self.enabled:
+                for sink in self.sinks:
+                    sink.emit(rec)
         return rec
 
     def close(self) -> None:
-        for sink in self.sinks:
-            sink.close()
+        # Under the same lock as emit(): the background checkpoint
+        # writer may be emitting a ckpt_retry record while an
+        # exception path tears the run down — closing the sink file
+        # mid-write would raise from inside the writer's retry loop.
+        with self._lock:
+            for sink in self.sinks:
+                sink.close()

@@ -1,0 +1,34 @@
+"""Fault tolerance: injection drills, recovery policies, watchdogs,
+and the restart supervisor.
+
+The reference's entire fault story was reactive — ``tf.train.Supervisor``
+restarted a dead worker and restored the last periodic checkpoint
+(mnist_python_m.py:245-253), losing everything since. This package is
+the TPU-native, *proactive* layer on top of the durable checkpointing
+train/checkpoint.py already provides:
+
+- :mod:`faults` — a deterministic fault-injection plan
+  (``--resilience.fault-plan "nan_grad@40,ckpt_io_fail@80,..."``) so
+  every recovery path below is exercisable in CPU-only tests and
+  production fire drills, not just believed.
+- :mod:`policies` — non-finite-loss handling beyond halt: bounded
+  ``skip_batch`` (the jitted step discards the update on device) and
+  ``rewind`` (in-process restore of the newest verifiable checkpoint),
+  plus rolling-window loss-spike detection.
+- :mod:`watchdog` — timeouts on batch fetch and device sync that turn
+  a silent hang into a diagnosable :class:`~watchdog.StallError`.
+- :mod:`supervisor` — ``python -m
+  tensorflow_distributed_tpu.resilience.supervisor -- <train cli
+  args>``: restarts a crashed/preempted child with capped backoff and
+  ``--resume`` — the reference Supervisor's restart loop, minus its
+  lose-everything restore.
+
+Checkpoint integrity (checksums, quarantine of corrupt step dirs,
+fallback to the newest verifiable step, save-I/O retries) lives in
+train/checkpoint.py itself; this package only injects its faults.
+
+Every recovery event is emitted through the observe/ registry
+(``observe.registry.emit_event``) as an ``event="recovery"`` record
+and counted on the goodput ledger, so a run's metrics JSONL is also
+its incident log.
+"""

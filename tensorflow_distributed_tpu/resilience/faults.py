@@ -1,0 +1,206 @@
+"""Deterministic fault injection: every recovery path, exercisable.
+
+A fault plan is a comma-separated list of ``kind@step[:arg]`` events,
+e.g. ``"nan_grad@40,ckpt_io_fail@80,data_stall@120:5s,sigterm@200"``.
+The train loop binds one plan per run and consults it at the exact
+points real faults strike:
+
+- ``nan_grad@K`` — NaN-poison the float leaves of step K's HOST batch
+  before it is sharded to devices. The loss and gradients of that step
+  are then genuinely non-finite through the real math (not a spoofed
+  metric), so the skip/rewind policies are tested against what an
+  actual divergence produces.
+- ``ckpt_io_fail@K[:N]`` — arm N (default 1) injected ``OSError``
+  failures in the checkpoint writer the next time the cadence save at
+  step K runs (train/checkpoint.py consumes them inside its retry
+  loop, so a plan with N <= save_retries proves save-retry recovery).
+- ``data_stall@K[:Ds]`` — sleep D seconds (default 5) inside the
+  batch fetch for step K, on the consumer side of the prefetcher, so
+  the data watchdog sees exactly the hang it guards against.
+- ``sigterm@K`` / ``sigkill@K`` — self-signal when step K is
+  dispatched: the graceful preemption notice, or the hard kill a
+  supervisor must restart from. Signal events fire on the FIRST leg
+  only (``bind(start_step=0)``): a resumed leg IS the recovery under
+  test, and re-firing would kill a supervised run forever.
+
+Every injection emits an ``event="recovery", kind="fault_injected"``
+record through the observe registry. Events are one-shot per plan
+object, so an in-process rewind past an injected NaN does not re-poison
+the replayed step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+import signal
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from tensorflow_distributed_tpu.observe.registry import emit_event
+
+KINDS = ("nan_grad", "ckpt_io_fail", "data_stall", "sigterm", "sigkill")
+
+_EVENT_RE = re.compile(
+    r"^(?P<kind>[a-z_]+)@(?P<step>\d+)(?::(?P<arg>[0-9.]+s?))?$")
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultEvent:
+    kind: str
+    step: int
+    arg: Optional[float] = None  # seconds for data_stall, count for
+    #                              ckpt_io_fail; None elsewhere
+
+
+def parse_fault_plan(spec: str) -> "FaultPlan":
+    """Parse ``kind@step[:arg]`` comma lists; raises ValueError with
+    the offending token on any syntax problem (config.validate calls
+    this, so a bad plan dies at startup, not at step K)."""
+    events: List[FaultEvent] = []
+    for token in filter(None, (t.strip() for t in spec.split(","))):
+        m = _EVENT_RE.match(token)
+        if not m:
+            raise ValueError(
+                f"bad fault-plan token {token!r}: want kind@step[:arg] "
+                f"(e.g. nan_grad@40, data_stall@120:5s)")
+        kind, step = m.group("kind"), int(m.group("step"))
+        if kind not in KINDS:
+            raise ValueError(
+                f"unknown fault kind {kind!r} in {token!r}; have "
+                f"{KINDS}")
+        if step < 1:
+            raise ValueError(f"fault step must be >= 1 in {token!r}")
+        arg_s = m.group("arg")
+        arg: Optional[float] = None
+        if arg_s is not None:
+            if kind == "data_stall":
+                arg = float(arg_s[:-1] if arg_s.endswith("s") else arg_s)
+                if arg <= 0:
+                    raise ValueError(
+                        f"data_stall duration must be > 0 in {token!r}")
+            elif kind == "ckpt_io_fail":
+                arg = float(arg_s)
+                if arg != int(arg) or arg < 1:
+                    raise ValueError(
+                        f"ckpt_io_fail count must be a positive int "
+                        f"in {token!r}")
+            else:
+                raise ValueError(
+                    f"fault kind {kind!r} takes no :arg ({token!r})")
+        events.append(FaultEvent(kind, step, arg))
+    return FaultPlan(events)
+
+
+class FaultPlan:
+    """One run's bound fault schedule. Falsy when empty, so the loop
+    can skip every hook at zero cost for production configs."""
+
+    def __init__(self, events: List[FaultEvent] = ()):  # type: ignore[assignment]
+        self._by_step: Dict[Tuple[str, int], FaultEvent] = {
+            (e.kind, e.step): e for e in events}
+        self._fired: set = set()
+        self._start_step = 0
+
+    def __bool__(self) -> bool:
+        return bool(self._by_step)
+
+    def bind(self, start_step: int) -> None:
+        """Pin the leg's resume point: events at or before it are
+        consumed (already happened on a previous leg), and signal
+        events are suppressed entirely on a resumed leg — the restart
+        being tested must terminate."""
+        self._start_step = start_step
+        for key, ev in self._by_step.items():
+            if ev.step <= start_step:
+                self._fired.add(key)
+
+    def _take(self, kind: str, step: int) -> Optional[FaultEvent]:
+        key = (kind, step)
+        ev = self._by_step.get(key)
+        if ev is None or key in self._fired:
+            return None
+        self._fired.add(key)
+        return ev
+
+    # -- injection points (the loop calls these; all no-op off-plan) ------
+    def wrap_stream(self, stream, start_step: int):
+        """Apply batch-level faults (nan_grad poisoning) to a task
+        stream, aligned to absolute step ids: the k-th yielded batch
+        feeds training step ``start_step + k``. Wrapping happens
+        BEFORE prefetch/sharding so the poison flows through the real
+        host->device path. Returns the stream unchanged for an empty
+        plan."""
+        if not self:
+            return stream
+
+        def gen():
+            step = start_step
+            for batch in stream:
+                step += 1
+                yield self.poison_batch(step, batch)
+
+        return gen()
+
+    def poison_batch(self, step: int, batch: Any) -> Any:
+        """NaN-fill the float leaves of step ``step``'s host batch.
+        Called on the raw task stream BEFORE sharding/prefetch, so the
+        NaNs flow through the genuine device math."""
+        if self._take("nan_grad", step) is None:
+            return batch
+        poisoned = [0]
+
+        def one(x):
+            if (isinstance(x, np.ndarray)
+                    and np.issubdtype(x.dtype, np.floating)):
+                poisoned[0] += 1
+                return np.full_like(x, np.nan)
+            return x
+
+        import jax
+
+        out = jax.tree_util.tree_map(one, batch)
+        if not poisoned[0]:
+            raise ValueError(
+                f"fault nan_grad@{step}: batch has no float leaves to "
+                f"poison (integer token streams can't produce a NaN "
+                f"loss this way — use a float-input task)")
+        emit_event("recovery", kind="fault_injected", fault="nan_grad",
+                   step=step)
+        return out
+
+    def maybe_stall(self, step: int) -> None:
+        """Sleep the injected stall inside the batch-fetch path (the
+        watchdog wraps this call, so the timeout sees it)."""
+        ev = self._take("data_stall", step)
+        if ev is not None:
+            emit_event("recovery", kind="fault_injected",
+                       fault="data_stall", step=step,
+                       seconds=ev.arg or 5.0)
+            time.sleep(ev.arg if ev.arg is not None else 5.0)
+
+    def arm_checkpoint_faults(self, step: int) -> None:
+        """Arm N injected write failures in train.checkpoint just
+        before the cadence save at ``step`` runs."""
+        ev = self._take("ckpt_io_fail", step)
+        if ev is not None:
+            from tensorflow_distributed_tpu.train import checkpoint
+            n = int(ev.arg) if ev.arg is not None else 1
+            emit_event("recovery", kind="fault_injected",
+                       fault="ckpt_io_fail", step=step, failures=n)
+            checkpoint.arm_io_fault(n)
+
+    def maybe_signal(self, step: int) -> None:
+        """Self-SIGTERM/SIGKILL at dispatch of ``step`` — first leg
+        only (see bind)."""
+        if self._start_step > 0:
+            return
+        for kind, signum in (("sigterm", signal.SIGTERM),
+                             ("sigkill", signal.SIGKILL)):
+            if self._take(kind, step) is not None:
+                emit_event("recovery", kind="fault_injected",
+                           fault=kind, step=step)
+                os.kill(os.getpid(), signum)

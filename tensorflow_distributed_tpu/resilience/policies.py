@@ -1,0 +1,141 @@
+"""Bad-step policies: what to do when the loss goes wrong.
+
+The loop's original behavior was binary — train on garbage, or
+(``halt_on_nonfinite``) raise at the next log cadence. This module is
+the configurable middle ground, checked per retired step on metrics
+the loop has already paid to synchronize:
+
+- ``halt`` — flush queued saves so the named resume point is the true
+  latest, then raise.
+- ``skip_batch`` — the jitted step already discarded that batch's
+  update on device (train/step.py ``skip_nonfinite``): params,
+  optimizer state, and EMA kept their pre-step values, only the step
+  counter advanced. The host side here just charges the bounded skip
+  budget and halts when it is exhausted — unbounded skipping would
+  loop a truly-diverged run forever.
+- ``rewind`` — the loop restores the newest verifiable checkpoint
+  in-process and re-enters from there (bounded by ``max_rewinds``).
+  Unlike skip, this also helps when the damage predates detection
+  (loss spikes, silent corruption surfaced late).
+
+Loss-SPIKE detection (:class:`LossSpikeDetector`) flags a finite loss
+greater than ``factor`` x the rolling-window median. A spike differs
+from a NaN in one crucial way: by the time the host sees it, the
+update has already applied and cannot be skipped — so under the
+``rewind`` policy a spike triggers a budgeted rewind, and under any
+other policy it is emitted as a recovery event only.
+"""
+
+from __future__ import annotations
+
+import collections
+import statistics
+from typing import Optional
+
+from tensorflow_distributed_tpu.observe.registry import emit_event
+
+
+class RecoveryBudgetExceeded(FloatingPointError):
+    """A bounded recovery policy ran out of budget — the run halts
+    with the full recovery history in the message."""
+
+
+class NonFinitePolicy:
+    """Budgeted per-step dispositions for non-finite losses (and, under
+    ``rewind``, loss spikes). Returns one of ``"halt" | "skip" |
+    "rewind"`` from :meth:`on_nonfinite`; the loop executes it."""
+
+    def __init__(self, mode: str, max_skips: int = 3,
+                 max_rewinds: int = 1):
+        assert mode in ("halt", "skip_batch", "rewind"), mode
+        self.mode = mode
+        self.max_skips = max_skips
+        self.max_rewinds = max_rewinds
+        self.skips_used = 0
+        self.rewinds_used = 0
+
+    def on_nonfinite(self, step: int, loss: float) -> str:
+        if self.mode == "halt":
+            emit_event("recovery", kind="nonfinite", step=step,
+                       loss=str(loss), action="halt")
+            return "halt"
+        if self.mode == "skip_batch":
+            # Counters track EXECUTED recoveries; the attempt that
+            # finds the budget empty halts without incrementing, so
+            # the halt message reads "N/N", not "N+1/N".
+            if self.skips_used >= self.max_skips:
+                emit_event("recovery", kind="nonfinite", step=step,
+                           loss=str(loss), action="halt",
+                           reason="skip budget exhausted",
+                           used=self.skips_used,
+                           budget=self.max_skips)
+                return "halt"
+            self.skips_used += 1
+            emit_event("recovery", kind="nonfinite", step=step,
+                       loss=str(loss), action="skip",
+                       used=self.skips_used, budget=self.max_skips)
+            return "skip"
+        return self._charge_rewind(step, loss=str(loss),
+                                   trigger="nonfinite")
+
+    def on_spike(self, step: int, loss: float,
+                 median: float) -> Optional[str]:
+        """A finite spike: rewind when that's the policy (the update
+        already applied — skip can't help); otherwise event-only."""
+        emit_event("recovery", kind="loss_spike", step=step,
+                   loss=round(loss, 6), window_median=round(median, 6))
+        if self.mode != "rewind":
+            return None
+        return self._charge_rewind(step, loss=round(loss, 6),
+                                   trigger="loss_spike")
+
+    def _charge_rewind(self, step: int, **fields) -> str:
+        if self.rewinds_used >= self.max_rewinds:
+            emit_event("recovery", kind="nonfinite", step=step,
+                       action="halt", reason="rewind budget exhausted",
+                       used=self.rewinds_used,
+                       budget=self.max_rewinds, **fields)
+            return "halt"
+        self.rewinds_used += 1
+        emit_event("recovery", kind="nonfinite", step=step,
+                   action="rewind", used=self.rewinds_used,
+                   budget=self.max_rewinds, **fields)
+        return "rewind"
+
+    def halt_message(self, step: int, loss: float,
+                     last_checkpoint) -> str:
+        return (
+            f"non-finite loss {loss} at step {step} "
+            f"(resilience.nonfinite={self.mode}; skips used "
+            f"{self.skips_used}/{self.max_skips}, rewinds used "
+            f"{self.rewinds_used}/{self.max_rewinds}); last durable "
+            f"checkpoint: {last_checkpoint}")
+
+
+class LossSpikeDetector:
+    """Rolling-window divergence detector for FINITE losses.
+
+    ``observe(loss)`` returns the window median when ``loss >
+    factor * median`` over a full window, else None. The spiking value
+    is NOT added to the window (one outlier must not drag the baseline
+    toward itself), but training-regime shifts still track because
+    every non-spike value is."""
+
+    def __init__(self, window: int, factor: float):
+        self.factor = factor
+        self._window: collections.deque = collections.deque(
+            maxlen=window)
+
+    def observe(self, loss: float) -> Optional[float]:
+        full = len(self._window) == self._window.maxlen
+        if full:
+            med = statistics.median(self._window)
+            if loss > self.factor * max(med, 1e-12):
+                return med
+        self._window.append(loss)
+        return None
+
+    def reset(self) -> None:
+        """After a rewind the replayed steps re-approach the spike
+        region legitimately; a stale window would re-flag them."""
+        self._window.clear()

@@ -1,0 +1,155 @@
+"""Restart supervisor: the reference's ``tf.train.Supervisor``, TPU-shaped.
+
+::
+
+    python -m tensorflow_distributed_tpu.resilience.supervisor \\
+        [--max-restarts N] [--backoff-base-s B] [--backoff-max-s M] \\
+        -- <train cli args>
+
+Runs ``python -m tensorflow_distributed_tpu.cli <args>`` as a child
+and restarts it on any abnormal exit (crash, OOM kill, SIGKILL'd by
+the scheduler) with capped exponential backoff, adding ``--resume
+true`` from the second leg on so each restart continues from the
+newest verifiable checkpoint — where the reference restored the last
+periodic checkpoint and silently lost everything since
+(mnist_python_m.py:245-253), this supervisor composes with the
+preemption guard (SIGTERM legs exit 0 after a durable save and are
+NOT restarts) and the checkpoint layer's integrity fallback.
+
+Stops on: clean child exit (rc 0), or restart-budget exhaustion
+(exits with the child's last rc). SIGTERM/SIGINT to the supervisor is
+forwarded to the child, so a preemption notice drains the whole tree
+gracefully.
+
+Each restart appends an ``event="recovery", kind="restart"`` JSON line
+to the child's ``--observe.metrics-jsonl`` file (when one is
+configured), so the run's metrics artifact records its own restart
+history — the next leg appends to that same file because its
+``--resume`` restore makes observe.hub open the sink in append mode.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional, Sequence
+
+
+def _child_flag_value(args: Sequence[str], flag: str) -> Optional[str]:
+    for i, a in enumerate(args):
+        if a == flag and i + 1 < len(args):
+            return args[i + 1]
+        if a.startswith(flag + "="):
+            return a.split("=", 1)[1]
+    return None
+
+
+def _append_event(path: Optional[str], record: dict) -> None:
+    if not path:
+        return
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(record) + "\n")
+    except OSError:
+        pass  # the event also went to stdout; never kill the
+        #       supervisor over its own bookkeeping
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--" not in argv:
+        print("usage: python -m tensorflow_distributed_tpu.resilience"
+              ".supervisor [options] -- <train cli args>",
+              file=sys.stderr)
+        return 2
+    split = argv.index("--")
+    parser = argparse.ArgumentParser(
+        prog="tensorflow_distributed_tpu.resilience.supervisor",
+        description="restart a crashed/killed training child with "
+        "capped backoff and --resume")
+    parser.add_argument("--max-restarts", type=int, default=5)
+    parser.add_argument("--backoff-base-s", type=float, default=1.0)
+    parser.add_argument("--backoff-max-s", type=float, default=60.0)
+    # cli.py exits EXIT_DIVERGED (2) when training halts on a
+    # non-finite loss / exhausted recovery budget — with a
+    # deterministic data stream a resumed leg usually re-diverges at
+    # the same step, so restarting just burns the budget. Off by
+    # default; crashes and stalls (any other nonzero rc) do restart.
+    parser.add_argument("--restart-on-diverge", action="store_true")
+    opts = parser.parse_args(argv[:split])
+    child_args = argv[split + 1:]
+
+    ckpt_dir = _child_flag_value(child_args, "--checkpoint-dir")
+    jsonl = _child_flag_value(child_args, "--observe.metrics-jsonl")
+    if not ckpt_dir:
+        print("[supervisor] WARNING: no --checkpoint-dir in child args"
+              " — restarts will repeat from step 0 (the reference "
+              "Supervisor's lose-everything behavior)", flush=True)
+
+    restarts = 0
+    rc = 1
+    while True:
+        args = list(child_args)
+        # _child_flag_value handles both "--resume true" and
+        # "--resume=true" forms — an explicit user setting (either
+        # spelling, either value) is never overridden.
+        if (restarts > 0 and ckpt_dir
+                and _child_flag_value(args, "--resume") is None):
+            args += ["--resume", "true"]
+        cmd = [sys.executable, "-m", "tensorflow_distributed_tpu.cli",
+               *args]
+        print(f"[supervisor] leg {restarts}: {' '.join(cmd)}",
+              flush=True)
+        proc = subprocess.Popen(cmd)
+
+        def forward(signum, frame, _p=proc):
+            try:
+                _p.send_signal(signum)
+            except ProcessLookupError:
+                pass
+
+        prev = {s: signal.signal(s, forward)
+                for s in (signal.SIGTERM, signal.SIGINT)}
+        try:
+            rc = proc.wait()
+        finally:
+            for s, h in prev.items():
+                signal.signal(s, h)
+        if rc == 0:
+            print(f"[supervisor] clean exit after {restarts} "
+                  f"restart(s)", flush=True)
+            return 0
+        if rc == 2 and not opts.restart_on_diverge:
+            # EXIT_DIVERGED (see cli.py): the run halted on policy —
+            # restarting replays the same divergence.
+            print("[supervisor] child diverged (rc=2); not restarting"
+                  " (pass --restart-on-diverge to override)",
+                  flush=True)
+            _append_event(jsonl, {
+                "event": "recovery", "kind": "diverged_no_restart",
+                "restarts": restarts, "rc": rc})
+            return rc
+        if restarts >= opts.max_restarts:
+            print(f"[supervisor] restart budget exhausted "
+                  f"({opts.max_restarts}); last rc={rc}", flush=True)
+            _append_event(jsonl, {
+                "event": "recovery", "kind": "restart_budget_exhausted",
+                "restarts": restarts, "rc": rc})
+            return 128 - rc if rc < 0 else rc
+        restarts += 1
+        delay = min(opts.backoff_base_s * 2 ** (restarts - 1),
+                    opts.backoff_max_s)
+        record = {"event": "recovery", "kind": "restart",
+                  "leg": restarts, "rc": rc,
+                  "backoff_s": round(delay, 3), "resume": bool(ckpt_dir)}
+        print(f"[supervisor] {json.dumps(record)}", flush=True)
+        _append_event(jsonl, record)
+        time.sleep(delay)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,114 @@
+"""Data/device watchdog: silent hangs become diagnosable errors.
+
+The two places a training run can hang forever with no traceback are
+the next-batch fetch (a wedged data source, a dead NFS mount) and the
+device sync (a peer process gone without its collectives — the
+XLA runtime can wait indefinitely). The watchdog runs each blocking
+call on a worker thread with a deadline; a breach emits a recovery
+event and raises :class:`StallError` naming what stalled and for how
+long — which a restart supervisor can then act on.
+
+Multi-host caveat (the important one): the watchdog RAISES, it never
+unilaterally skips or retries the stalled work. Under
+``jax.process_count() > 1`` every process runs the same SPMD program;
+one process deciding on its own to drop a batch or abandon a
+collective desyncs the others into exactly the silent hang this module
+exists to prevent. Recovery from a stall is process-level (crash ->
+supervisor restart -> --resume), never step-level.
+
+The abandoned worker thread may still be blocked after the raise
+(Python can't cancel a blocked call); that's fine — StallError is
+fatal to the run by design, and the thread is a daemon.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Optional
+
+import jax
+
+from tensorflow_distributed_tpu.observe import goodput as _goodput
+from tensorflow_distributed_tpu.observe.registry import emit_event
+
+
+class StallError(RuntimeError):
+    """A watched blocking call exceeded its deadline."""
+
+
+class Watchdog:
+    # ONE persistent hand-rolled DAEMON worker, deliberately not
+    # ThreadPoolExecutor: executor workers are non-daemon and joined
+    # by an atexit handler, so a thread still wedged in the stalled
+    # call would block interpreter shutdown forever — the process
+    # would print the StallError and then hang at exit instead of
+    # exiting code 3 for the supervisor to act on. A daemon dies with
+    # the process. Persistent (vs thread-per-call) so the hot path
+    # pays a queue handoff, not a thread spawn, per watched step; a
+    # worker wedged by a timeout is abandoned and replaced on the
+    # next call (which, timeouts being fatal by policy, is rare).
+
+    def __init__(self, data_timeout_s: float = 0.0,
+                 sync_timeout_s: float = 0.0):
+        self.data_timeout_s = data_timeout_s
+        self.sync_timeout_s = sync_timeout_s
+        self._requests: Optional[queue.Queue] = None
+
+    def _worker_loop(self, requests: queue.Queue) -> None:
+        while True:
+            fn, box, done = requests.get()
+            try:
+                box["value"] = fn()
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                box["error"] = e
+            finally:
+                done.set()
+
+    def _watched(self, fn: Callable[[], Any], what: str, step: int,
+                 timeout: float) -> Any:
+        if timeout <= 0:
+            return fn()
+        if self._requests is None:
+            self._requests = queue.Queue()
+            threading.Thread(target=self._worker_loop,
+                             args=(self._requests,), daemon=True,
+                             name="tfd-watchdog").start()
+        box: dict = {}
+        done = threading.Event()
+        self._requests.put((fn, box, done))
+        if not done.wait(timeout):
+            # Abandon the wedged worker (it still holds the stalled
+            # call); a subsequent watched call gets a fresh one.
+            self._requests = None
+            emit_event("recovery", kind="stall", what=what, step=step,
+                       timeout_s=timeout,
+                       multihost=jax.process_count() > 1)
+            _goodput.incr("stall")
+            raise StallError(
+                f"{what} for step {step} exceeded the "
+                f"{timeout:g}s watchdog deadline"
+                + (" (multi-host run: raising is the ONLY safe "
+                   "disposition — an unilateral skip would desync the "
+                   "peer processes' collectives; recover by restart + "
+                   "--resume)" if jax.process_count() > 1 else
+                   "; recover by restart + --resume (e.g. under "
+                   "resilience.supervisor)"))
+        if "error" in box:
+            raise box["error"]
+        return box["value"]
+
+    def fetch(self, fn: Callable[[], Any], step: int) -> Any:
+        """Run the next-batch fetch under the data deadline."""
+        return self._watched(fn, "next-batch fetch", step,
+                             self.data_timeout_s)
+
+    def sync(self, value: Any, step: int) -> Any:
+        """Block on a device value under the sync deadline."""
+        return self._watched(lambda: jax.block_until_ready(value),
+                             "device sync", step, self.sync_timeout_s)
+
+    def close(self) -> None:
+        """Drop the worker reference; the daemon thread dies with the
+        process (it blocks forever on a queue nobody feeds)."""
+        self._requests = None

@@ -34,10 +34,12 @@ Design:
 from __future__ import annotations
 
 import concurrent.futures
+import hashlib
 import json
 import os
 import shutil
 import threading
+import time
 from typing import Any, List, Optional
 
 import jax
@@ -45,9 +47,62 @@ import numpy as np
 from flax import serialization
 
 from tensorflow_distributed_tpu.observe import goodput as _goodput
+from tensorflow_distributed_tpu.observe.registry import emit_event
 from tensorflow_distributed_tpu.parallel.mesh import is_chief
 
 _STEP_PREFIX = "step_"
+_QUARANTINE_PREFIX = "quarantined_"
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint failed integrity verification (checksum mismatch,
+    truncated/undecodable state file). restore() quarantines the
+    offender and falls back to the newest verifiable step; this only
+    escapes when an EXPLICIT step was requested or no verifiable
+    checkpoint remains."""
+
+
+# --- save-I/O retry policy (capped exponential backoff) -----------------
+# Module-level so save() call sites don't thread it through; the train
+# loop configures it from cfg.resilience at run start.
+
+_io_retries = 2
+_io_backoff_s = 0.05
+_io_backoff_max_s = 2.0
+# Injected write failures (resilience.faults arms these for drills):
+# the next N write attempts raise OSError INSIDE the retry loop, so a
+# plan with N <= retries proves save-retry recovery end to end.
+_injected_io_failures = 0
+
+
+def set_io_policy(retries: int = 2, backoff_s: float = 0.05,
+                  backoff_max_s: float = 2.0) -> None:
+    global _io_retries, _io_backoff_s, _io_backoff_max_s
+    _io_retries, _io_backoff_s = retries, backoff_s
+    _io_backoff_max_s = backoff_max_s
+
+
+def arm_io_fault(count: int = 1) -> None:
+    global _injected_io_failures
+    _injected_io_failures = count
+
+
+def _retry_io(fn, step: int):
+    """Run a save-I/O callable with capped-exponential-backoff retries;
+    each retry is a recovery event and a goodput count."""
+    delay = _io_backoff_s
+    for attempt in range(_io_retries + 1):
+        try:
+            return fn()
+        except OSError as e:
+            if attempt == _io_retries:
+                raise
+            emit_event("recovery", kind="ckpt_retry", step=step,
+                       attempt=attempt + 1, budget=_io_retries,
+                       error=str(e), backoff_s=round(delay, 4))
+            _goodput.incr("ckpt_retry")
+            time.sleep(delay)
+            delay = min(delay * 2, _io_backoff_max_s)
 
 
 def _identity(a):
@@ -112,7 +167,15 @@ def available_steps(ckpt_dir: str) -> List[int]:
     implies a full state.msgpack), orbax dirs count once the chief's
     commit marker lands — an in-flight or crashed orbax save is
     invisible here, so latest_step never shadows an intact older
-    checkpoint."""
+    checkpoint.
+
+    Everything else in the directory is ignored by construction:
+    ``step_XXXXXXXX.tmp`` staging dirs (crashed mid-write), dirs
+    missing both the msgpack and the commit marker, stray non-dir
+    files that happen to parse as a step, quarantined_* dirs the
+    integrity fallback renamed aside, and any other non-step entry —
+    a crashed or corrupt save can never make ``latest_step`` point at
+    garbage."""
     if not os.path.isdir(ckpt_dir):
         return []
     out = []
@@ -122,8 +185,10 @@ def available_steps(ckpt_dir: str) -> List[int]:
         try:
             step = int(name[len(_STEP_PREFIX):])
         except ValueError:
-            continue
+            continue  # step_X.tmp staging dirs, misnamed entries
         d = os.path.join(ckpt_dir, name)
+        if not os.path.isdir(d):
+            continue  # a stray FILE named like a step dir
         if (os.path.exists(os.path.join(d, "state.msgpack"))
                 or os.path.exists(os.path.join(d, _ORBAX_MARKER))):
             out.append(step)
@@ -277,27 +342,46 @@ _pending: List[concurrent.futures.Future] = []
 
 
 def _write(ckpt_dir: str, step: int, host_state: Any, keep: int) -> str:
-    """Serialize + atomically publish one checkpoint (chief only)."""
+    """Serialize + atomically publish one checkpoint (chief only).
+
+    The state blob's sha256 is recorded in the manifest next to the
+    step metadata and verified on restore — bit rot or a truncated
+    write surfaces as :class:`CheckpointCorruptError` (quarantine +
+    fallback) instead of silently restoring garbage. The whole I/O
+    sequence retries under the capped-backoff policy (serialization
+    happens once, outside the retries)."""
     final = _step_dir(ckpt_dir, step)
-    os.makedirs(ckpt_dir, exist_ok=True)
-    tmp = final + ".tmp"
-    if os.path.exists(tmp):
-        shutil.rmtree(tmp)
-    os.makedirs(tmp)
-    with open(os.path.join(tmp, "state.msgpack"), "wb") as f:
-        f.write(serialization.to_bytes(host_state))
+    blob = serialization.to_bytes(host_state)
     manifest = {
         "step": step,
         "param_bytes": int(sum(
             np.asarray(x).nbytes
             for x in jax.tree_util.tree_leaves(host_state.params))),
         "format": "flax-msgpack-v1",
+        "sha256": hashlib.sha256(blob).hexdigest(),
     }
-    with open(os.path.join(tmp, "manifest.json"), "w") as f:
-        json.dump(manifest, f)
-    if os.path.exists(final):
-        shutil.rmtree(final)
-    os.rename(tmp, final)
+
+    def attempt() -> None:
+        global _injected_io_failures
+        if _injected_io_failures > 0:
+            _injected_io_failures -= 1
+            raise OSError(
+                f"injected checkpoint I/O failure at step {step} "
+                f"(resilience fault drill)")
+        os.makedirs(ckpt_dir, exist_ok=True)
+        tmp = final + ".tmp"
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        with open(os.path.join(tmp, "state.msgpack"), "wb") as f:
+            f.write(blob)
+        with open(os.path.join(tmp, "manifest.json"), "w") as f:
+            json.dump(manifest, f)
+        if os.path.exists(final):
+            shutil.rmtree(final)
+        os.rename(tmp, final)
+
+    _retry_io(attempt, step)
     for old in available_steps(ckpt_dir)[:-keep]:
         shutil.rmtree(_step_dir(ckpt_dir, old), ignore_errors=True)
     return final
@@ -421,34 +505,65 @@ def restore_averaged(ckpt_dir: str, state: Any,
     leaves average; integer leaves (step, opt counters) take
     replica 0 (identical by construction). Both backends' layouts are
     read (native msgpack and orbax OCDBT, auto-detected like
-    restore()) — local SGD and sharded checkpointing compose."""
-    step = step if step is not None else latest_step(ckpt_dir)
-    if step is None:
-        raise FileNotFoundError(f"no checkpoints under {ckpt_dir}")
-    sd = _step_dir(ckpt_dir, step)
-    opath = os.path.join(sd, _ORBAX_DIRNAME)
-    if os.path.exists(os.path.join(sd, _ORBAX_MARKER)):
-        # Orbax OCDBT layout, detected via the COMMIT MARKER exactly
-        # like restore() — a crashed orbax re-save into a dir holding
-        # an intact native state.msgpack must fall through to the
-        # msgpack, not dispatch onto unmarked shard debris.
-        # Template-free restore reads the SAVED (replica-stacked)
-        # tree as host numpy — the shapes come from the checkpoint,
-        # which is the point (the stacked leaves don't match the
-        # plain template until after the mean below). Warning-free
-        # topology safety doesn't apply: host arrays carry no
-        # sharding to mismatch.
-        import warnings
+    restore()) — local SGD and sharded checkpointing compose.
 
-        path = opath
-        with warnings.catch_warnings():
-            warnings.simplefilter("ignore")
-            raw = jax.tree_util.tree_map(np.asarray, _orbax().restore(
-                opath))
+    Same integrity contract as restore(): ``step=None`` means the
+    newest VERIFIABLE step (a corrupt latest is quarantined with
+    fallback to the next-newest); an explicit ``step`` is exact."""
+    _warm_runtime()
+    steps = available_steps(ckpt_dir)
+
+    def read_raw(s: int):
+        sd = _step_dir(ckpt_dir, s)
+        opath = os.path.join(sd, _ORBAX_DIRNAME)
+        if os.path.exists(os.path.join(sd, _ORBAX_MARKER)):
+            # Orbax OCDBT layout, detected via the COMMIT MARKER
+            # exactly like restore() — a crashed orbax re-save into a
+            # dir holding an intact native state.msgpack must fall
+            # through to the msgpack, not dispatch onto unmarked
+            # shard debris. Template-free restore reads the SAVED
+            # (replica-stacked) tree as host numpy — the shapes come
+            # from the checkpoint, which is the point (the stacked
+            # leaves don't match the plain template until after the
+            # mean below). Warning-free topology safety doesn't
+            # apply: host arrays carry no sharding to mismatch.
+            import warnings
+
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                return opath, jax.tree_util.tree_map(
+                    np.asarray, _orbax().restore(opath))
+        # Same read+verify path as restore(): a checksum-mismatched
+        # or truncated blob raises CheckpointCorruptError.
+        return (os.path.join(sd, "state.msgpack"),
+                _load_native_raw(sd))
+
+    if step is not None:
+        if step not in steps:
+            raise FileNotFoundError(
+                f"no checkpoint for step {step} under {ckpt_dir}; "
+                f"available steps: {steps if steps else 'none'}")
+        path, raw = read_raw(step)
     else:
-        path = os.path.join(sd, "state.msgpack")
-        with open(path, "rb") as f:
-            raw = serialization.msgpack_restore(f.read())
+        if not steps:
+            raise FileNotFoundError(
+                f"no checkpoints under {ckpt_dir} — is this a "
+                f"--resume/mode=eval on an empty or absent checkpoint "
+                f"dir, or the wrong --checkpoint-dir?")
+        last_err: Optional[CheckpointCorruptError] = None
+        for s in reversed(steps):
+            try:
+                path, raw = read_raw(s)
+                step = s
+                break
+            except CheckpointCorruptError as e:
+                _quarantine(ckpt_dir, s, str(e))
+                last_err = e
+        else:
+            raise CheckpointCorruptError(
+                f"every checkpoint under {ckpt_dir} failed "
+                f"verification (all quarantined); last error: "
+                f"{last_err}")
     if not (isinstance(raw, dict) and isinstance(raw.get("step"),
                                                  np.ndarray)
             and raw["step"].ndim == 1):
@@ -469,13 +584,148 @@ def restore_averaged(ckpt_dir: str, state: Any,
     return _restore_from_raw(raw, state)
 
 
-@_goodput.accounted("restore")
-def restore(ckpt_dir: str, state: Any, step: Optional[int] = None) -> Any:
-    """Restore into the structure/shardings of ``state`` (a freshly
-    created template). ``step=None`` means latest."""
-    step = step if step is not None else latest_step(ckpt_dir)
-    if step is None:
-        raise FileNotFoundError(f"no checkpoints under {ckpt_dir}")
+def _plus_zero(tree: Any) -> Any:
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(
+        lambda x: x + jnp.zeros((), x.dtype), tree)
+
+
+def launder_buffers(state: Any) -> Any:
+    """Rebuild a restored state's arrays through one on-device
+    computation (x + 0); shardings propagate elementwise, so the
+    layout is unchanged.
+
+    Container-bug workaround, same family as :func:`_warm_runtime`:
+    DONATING arrays produced by ``jax.device_put`` into a
+    cache-DESERIALIZED executable segfaults this jaxlib's CPU runtime
+    (reproduced 6/6 on the in-process rewind path with the persistent
+    compile cache on; 0/4 with it off, 2026-08-03). Buffers that came
+    out of a jitted computation donate fine, so restore paths that
+    feed a donating step launder the state through this identity —
+    one extra params-sized device pass per restore, nothing per
+    step."""
+    return jax.jit(_plus_zero)(state)
+
+
+_runtime_warmed = False
+
+
+def _warm_runtime() -> None:
+    """Run one trivial jitted executable before the first checkpoint
+    read of the process.
+
+    Workaround for a container jaxlib bug (XLA:CPU + the persistent
+    compile cache): when the FIRST executable a fresh process loads is
+    deserialized from the disk cache after a multi-MB flax msgpack
+    restore has churned the heap, the runtime corrupts the allocator
+    (`corrupted double-linked list` / `_int_malloc` aborts, ~90%
+    reproducible on `--resume`; bisected 2026-08-03 — warm-touching
+    the jit machinery first avoids it 100%). Costs one tiny compile
+    (~ms, cached); runs AFTER mesh bootstrap because restore does, so
+    multi-host backend init order is preserved. No-op after the first
+    call or in any process that already ran a jitted computation's
+    worth of initialization."""
+    global _runtime_warmed
+    if _runtime_warmed:
+        return
+    _runtime_warmed = True
+    import jax.numpy as jnp
+
+    jax.jit(lambda x: x + 1)(jnp.zeros(8, jnp.float32)
+                             ).block_until_ready()
+
+
+def _quarantine(ckpt_dir: str, step: int, reason: str) -> str:
+    """Rename a corrupt step dir aside (``quarantined_step_XXXXXXXX``)
+    so available_steps/latest_step never see it again, preserving the
+    bytes for forensics instead of deleting them. Chief-only rename
+    (shared FS under multi-host — every process computed the same
+    verification verdict from the same bytes, so the fallback order
+    agrees)."""
+    name = f"{_STEP_PREFIX}{step:08d}"
+    dst = os.path.join(ckpt_dir, _QUARANTINE_PREFIX + name)
+    if is_chief():
+        if os.path.exists(dst):
+            shutil.rmtree(dst, ignore_errors=True)
+        try:
+            os.rename(os.path.join(ckpt_dir, name), dst)
+        except OSError:
+            pass  # already moved/removed — the skip is what matters
+    emit_event("recovery", kind="quarantine", step=step, reason=reason)
+    _goodput.incr("quarantine")
+    return dst
+
+
+def _procs_sync(tag: str) -> None:
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(tag)
+
+
+def quarantine_from(ckpt_dir: str, step: int, reason: str) -> List[int]:
+    """Quarantine every available checkpoint at/after ``step``.
+
+    The rewind policy's companion: a bad update applies at step K but
+    is detected a few steps later (the loop retires metrics with lag),
+    so cadence saves taken in between hold the POISONED state — their
+    bytes are intact (checksums pass) but they must never be a resume
+    target. Called before the rewind restore so ``latest_step`` lands
+    on the newest pre-damage checkpoint. Returns the quarantined
+    steps (chief's view).
+
+    Multi-host protocol: COLLECTIVE — every process must call it.
+    Barrier on entry (nobody lists the dir while a previous
+    operation's renames are in flight), chief-only renames, barrier
+    on exit (the renames are visible on the shared FS before any
+    process recomputes ``latest_step``) — so all processes proceed to
+    the same restore target."""
+    _procs_sync(f"tfd_quarantine_enter_{step}")
+    bad: List[int] = []
+    if is_chief():
+        bad = [s for s in available_steps(ckpt_dir) if s >= step]
+        for s in bad:
+            _quarantine(ckpt_dir, s, reason)
+    _procs_sync(f"tfd_quarantine_exit_{step}")
+    return bad
+
+
+def _load_native_raw(step_path: str) -> Any:
+    """Read + VERIFY a native checkpoint's state dict. Raises
+    CheckpointCorruptError on unreadable bytes, a manifest-checksum
+    mismatch, or an undecodable msgpack blob. Pre-integrity
+    checkpoints (no "sha256" in the manifest) skip the checksum and
+    still get the decode check."""
+    path = os.path.join(step_path, "state.msgpack")
+    try:
+        with open(path, "rb") as f:
+            blob = f.read()
+    except OSError as e:
+        raise CheckpointCorruptError(f"unreadable {path}: {e}") from e
+    expected = None
+    man_path = os.path.join(step_path, "manifest.json")
+    if os.path.exists(man_path):
+        try:
+            with open(man_path) as f:
+                expected = json.load(f).get("sha256")
+        except (OSError, ValueError):
+            expected = None  # unreadable manifest: decode check remains
+    if expected is not None:
+        got = hashlib.sha256(blob).hexdigest()
+        if got != expected:
+            raise CheckpointCorruptError(
+                f"checksum mismatch for {path}: manifest sha256 "
+                f"{expected[:12]}…, file {got[:12]}… (truncated or "
+                f"bit-flipped write)")
+    try:
+        return serialization.msgpack_restore(blob)
+    except Exception as e:  # msgpack raises library-specific types
+        raise CheckpointCorruptError(
+            f"undecodable {path}: {e}") from e
+
+
+def _load_step(ckpt_dir: str, step: int, state: Any) -> Any:
     step_path = _step_dir(ckpt_dir, step)
     if os.path.exists(os.path.join(step_path, _ORBAX_MARKER)):
         # Auto-detect via the COMMIT MARKER (not the orbax subdir):
@@ -483,10 +733,47 @@ def restore(ckpt_dir: str, state: Any, step: Optional[int] = None) -> Any:
         # native state.msgpack must fall through to the msgpack,
         # not dispatch onto incomplete shard debris.
         return _orbax_restore(step_path, state)
-    path = os.path.join(step_path, "state.msgpack")
-    with open(path, "rb") as f:
-        raw = serialization.msgpack_restore(f.read())
-    return _restore_from_raw(raw, state)
+    return _restore_from_raw(_load_native_raw(step_path), state)
+
+
+@_goodput.accounted("restore")
+def restore(ckpt_dir: str, state: Any, step: Optional[int] = None) -> Any:
+    """Restore into the structure/shardings of ``state`` (a freshly
+    created template).
+
+    ``step=None`` means the newest VERIFIABLE step: native checkpoints
+    are checksum-verified against their manifest, and a corrupt/
+    truncated candidate is quarantined (renamed aside, recovery event
+    emitted) with automatic fallback to the next-newest step — a
+    damaged latest checkpoint costs `checkpoint_every` steps of
+    progress, never the run. An EXPLICIT ``step`` is exact: missing
+    raises FileNotFoundError listing the steps actually available;
+    corrupt raises CheckpointCorruptError without touching the dir
+    (an explicitly requested step is being inspected, not recovered
+    around)."""
+    _warm_runtime()
+    steps = available_steps(ckpt_dir)
+    if step is not None:
+        if step not in steps:
+            raise FileNotFoundError(
+                f"no checkpoint for step {step} under {ckpt_dir}; "
+                f"available steps: {steps if steps else 'none'}")
+        return _load_step(ckpt_dir, step, state)
+    if not steps:
+        raise FileNotFoundError(
+            f"no checkpoints under {ckpt_dir} — is this a --resume "
+            f"on an empty or absent checkpoint dir, or the wrong "
+            f"--checkpoint-dir?")
+    last_err: Optional[CheckpointCorruptError] = None
+    for s in reversed(steps):
+        try:
+            return _load_step(ckpt_dir, s, state)
+        except CheckpointCorruptError as e:
+            _quarantine(ckpt_dir, s, str(e))
+            last_err = e
+    raise CheckpointCorruptError(
+        f"every checkpoint under {ckpt_dir} failed verification "
+        f"(all quarantined); last error: {last_err}")
 
 
 def _align_masked_opt(skel: Any, raw: Any) -> Any:

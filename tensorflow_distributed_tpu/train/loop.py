@@ -25,6 +25,11 @@ from tensorflow_distributed_tpu.parallel import make_mesh
 from tensorflow_distributed_tpu.parallel.mesh import bootstrap, is_chief
 from tensorflow_distributed_tpu.parallel.sharding import (
     process_slice, shard_batch)
+from tensorflow_distributed_tpu.resilience.faults import (
+    FaultPlan, parse_fault_plan)
+from tensorflow_distributed_tpu.resilience.policies import (
+    LossSpikeDetector, NonFinitePolicy, RecoveryBudgetExceeded)
+from tensorflow_distributed_tpu.resilience.watchdog import Watchdog
 from tensorflow_distributed_tpu.train import checkpoint as ckpt
 from tensorflow_distributed_tpu.train.optim import make_optimizer
 from tensorflow_distributed_tpu.train.preemption import PreemptionGuard
@@ -363,9 +368,31 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
         if cfg.resume and ckpt.latest_step(cfg.checkpoint_dir) is not None:
             with obs.phase("restore"):
                 state = ckpt.restore(cfg.checkpoint_dir, state)
+                # The restored buffers feed a DONATING step; see
+                # checkpoint.launder_buffers for the container bug
+                # this sidesteps.
+                state = ckpt.launder_buffers(state)
             start_step = ckpt.host_step(state)
             logger.log_json({"event": "resumed", "step": start_step})
             obs.emit("resumed", step=start_step)
+
+        # Resilience wiring (all off by default — see config.
+        # ResilienceConfig and the resilience/ package): fault plan,
+        # non-finite policy, spike detector, watchdog, save-retry
+        # policy. Built AFTER the Observatory so recovery events from
+        # the library layers reach the run's sinks.
+        res = cfg.resilience
+        plan = (parse_fault_plan(res.fault_plan) if res.fault_plan
+                else FaultPlan())
+        plan.bind(start_step)
+        policy = (NonFinitePolicy(res.nonfinite, res.max_skips,
+                                  res.max_rewinds)
+                  if res.nonfinite != "off" else None)
+        spikes = (LossSpikeDetector(res.spike_window, res.spike_factor)
+                  if res.spike_window else None)
+        wdog = (Watchdog(res.data_timeout_s, res.sync_timeout_s)
+                if (res.data_timeout_s or res.sync_timeout_s) else None)
+        ckpt.set_io_policy(res.save_retries, res.save_retry_backoff_s)
 
         # ZeRO-1 needs new_params constrained back to the params' OWN
         # state-creation layout after the slot-sharded update — captured
@@ -401,7 +428,9 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
                 accum_steps=cfg.grad_accum_steps,
                 grad_norm_metric=cfg.log_grad_norm,
                 ema_decay=cfg.ema_decay,
-                params_out_shardings=params_out)
+                params_out_shardings=params_out,
+                skip_nonfinite=(policy is not None
+                                and policy.mode == "skip_batch"))
         eval_fn = make_eval_step(mesh, loss=task.eval_loss or task.loss,
                                  batch_shardings=task.batch_shardings)
         # 1F1B-recompute steps advertise their extra executed FLOPs
@@ -419,8 +448,22 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
         obs.emit("start", model=cfg.model, task=task.name, params=n_params,
                  global_batch=cfg.batch_size, start_step=start_step)
 
-        it = prefetch_to_mesh(task.train_stream(start_step), mesh,
-                              seq_axis=task.seq_axis)
+        def make_iterator(from_step: int):
+            """Task stream -> fault wrapping -> prefetch; rebuilt on a
+            rewind so the replayed steps consume the batches the
+            uninterrupted run would have (fault events are one-shot,
+            so an injected NaN is not re-injected on replay)."""
+            return prefetch_to_mesh(
+                plan.wrap_stream(task.train_stream(from_step),
+                                 from_step),
+                mesh, seq_axis=task.seq_axis)
+
+        it = make_iterator(start_step)
+
+        def _fetch(step_id: int):
+            plan.maybe_stall(step_id)  # injected stalls happen INSIDE
+            #                            the watched fetch
+            return next(it)
 
         def cadence(step_now: int, state: TrainState, metrics) -> None:
             """Periodic log/eval/checkpoint — applied to EVERY step
@@ -450,21 +493,175 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
                          **{f"val_{k}": float(v) for k, v in em.items()})
             if (cfg.checkpoint_dir and cfg.checkpoint_every
                     and step_now % cfg.checkpoint_every == 0):
+                if plan:
+                    # An armed ckpt_io_fail@step_now fires inside this
+                    # save's retry loop.
+                    plan.arm_checkpoint_faults(step_now)
                 with obs.phase("checkpoint"):
                     ckpt.save(cfg.checkpoint_dir, state, cfg.keep_checkpoints,
                               background=cfg.checkpoint_async,
                               backend=cfg.checkpoint_backend)
 
+        def _inspect(step_id: int, step_metrics) -> Optional[int]:
+            """Policy check on one RETIRED step's metrics (already
+            device-synced — the host read costs nothing extra).
+            Returns the bad step id when the policy orders a rewind;
+            raises on halt / budget exhaustion; None otherwise. Inert
+            (no host fetch at all) when no policy or detector is
+            configured."""
+            if policy is None and spikes is None:
+                return None
+            host_loss = float(jax.device_get(step_metrics["loss"]))
+            # The jitted step can skip on a non-finite GRAD NORM while
+            # the loss stays finite (backward-only overflow); the
+            # skipped_nonfinite metric it reports is the authority, so
+            # those skips charge the budget exactly like NaN losses.
+            skipped = step_metrics.get("skipped_nonfinite")
+            device_skipped = (skipped is not None
+                              and float(jax.device_get(skipped)) > 0)
+            if not np.isfinite(host_loss) or device_skipped:
+                if policy is None:
+                    return None  # legacy path: cadence halt (or not)
+                action = policy.on_nonfinite(step_id, host_loss)
+                if action == "halt":
+                    # Flush queued async saves first so the named
+                    # resume point is the TRUE latest.
+                    ckpt.wait()
+                    raise RecoveryBudgetExceeded(policy.halt_message(
+                        step_id, host_loss,
+                        ckpt.latest_step(cfg.checkpoint_dir)
+                        if cfg.checkpoint_dir else None))
+                if action == "skip":
+                    # The jitted step already discarded the update on
+                    # device; here we only count it.
+                    obs.goodput.incr("skip_nonfinite")
+                    return None
+                return step_id
+            if spikes is not None:
+                med = spikes.observe(host_loss)
+                if med is not None:
+                    if policy is not None:
+                        action = policy.on_spike(step_id, host_loss,
+                                                 med)
+                        if action == "halt":
+                            # Rewind budget exhausted on a spike:
+                            # same ending as the nonfinite path —
+                            # a swallowed halt would train on the
+                            # diverged run unbounded.
+                            ckpt.wait()
+                            raise RecoveryBudgetExceeded(
+                                policy.halt_message(
+                                    step_id, host_loss,
+                                    ckpt.latest_step(cfg.checkpoint_dir)
+                                    if cfg.checkpoint_dir else None))
+                        if action == "rewind":
+                            return step_id
+                    else:
+                        obs.emit("recovery", kind="loss_spike",
+                                 step=step_id,
+                                 loss=round(host_loss, 6),
+                                 window_median=round(med, 6))
+            return None
+
+        def _sync_retired(sid: int, m) -> None:
+            """The one retirement sync protocol, shared by the main
+            loop and the trailing drain (watchdog deadline when
+            configured, plain block otherwise)."""
+            if wdog is not None:
+                wdog.sync(m, sid)
+            else:
+                jax.block_until_ready(m)
+
+        def _rewind(cur_state, bad_step: int):
+            """In-process recovery: flush the writer, quarantine every
+            checkpoint saved at/after the bad update (detection lags
+            retirement by the in-flight window, so cadence saves in
+            between hold the POISONED state — intact bytes, damaged
+            values), restore the newest verifiable pre-damage
+            checkpoint (corrupt candidates are quarantined by
+            ckpt.restore itself), and hand back the step to re-enter
+            the loop from. The poisoned live state is only a placement
+            template for the restore."""
+            # Drain the device FIRST: steps dispatched after the bad
+            # one are still executing, and interleaving their
+            # completion with the restore's device_puts + the replay's
+            # fresh dispatch trips the container XLA:CPU runtime's
+            # heap (same class as the async-ckpt SIGSEGV the repo
+            # already documents). A rewind is off the hot path; a full
+            # quiesce costs nothing that matters.
+            jax.block_until_ready(cur_state.params)
+            ckpt.wait()
+            ckpt.quarantine_from(
+                cfg.checkpoint_dir, bad_step,
+                reason=f"saved at/after non-finite step {bad_step} "
+                       f"(rewind)")
+            with obs.phase("rewind"):
+                # The save at bad_step - 1 is usually clean (step K's
+                # loss comes from the params ENTERING K, i.e. update
+                # K-1's output — batch-caused NaNs never touch it),
+                # but when the damage IS in the params (backward-only
+                # overflow at K-1), that checkpoint holds intact
+                # bytes around poisoned values. So verify each
+                # candidate's params are finite after restoring and
+                # walk back until one is — never quarantining a clean
+                # sole checkpoint on a mere suspicion, never
+                # restoring a poisoned one and burning the budget on
+                # an instant re-NaN.
+                while True:
+                    target = ckpt.latest_step(cfg.checkpoint_dir)
+                    if target is None:
+                        raise FloatingPointError(
+                            "resilience.nonfinite=rewind: non-finite "
+                            f"loss at step {bad_step} with no finite "
+                            "durable checkpoint before it — nothing "
+                            "to rewind to (checkpoint_dir="
+                            f"{cfg.checkpoint_dir!r}, checkpoint_"
+                            f"every={cfg.checkpoint_every})")
+                    new_state = ckpt.restore(cfg.checkpoint_dir,
+                                             cur_state)
+                    finite = bool(jax.device_get(jax.jit(
+                        lambda p: jax.numpy.all(jax.numpy.array(
+                            [jax.numpy.all(jax.numpy.isfinite(x))
+                             for x in jax.tree_util.tree_leaves(p)]))
+                    )(new_state.params)))
+                    if finite:
+                        break
+                    ckpt.quarantine_from(
+                        cfg.checkpoint_dir, target,
+                        reason=f"restored params non-finite (damage "
+                               f"predates step {target})")
+                new_state = ckpt.launder_buffers(new_state)
+            rewound_to = ckpt.host_step(new_state)
+            obs.goodput.incr("rewind")
+            logger.log_json({"event": "rewound", "step": rewound_to})
+            obs.emit("recovery", kind="rewind", from_step=bad_step,
+                     to_step=rewound_to)
+            if spikes is not None:
+                spikes.reset()  # replayed steps re-approach the spike
+            return new_state, rewound_to
+
         # Warm-up compile outside the timed steady-state span (the
         # reference's timings conflated graph setup with steps; ours don't).
         # Goodput charges it as "compile" — setup, not forward progress.
         metrics = None
+        want_rewind = None  # bad step id when a rewind is ordered
         with Timer() as compile_t:
             if cfg.train_steps > start_step:
+                # Signal faults scheduled for the warm-up step fire
+                # here like any other step's would (the guard isn't
+                # armed yet, so a sigterm@first-step drill is a hard
+                # first-leg crash — which is what it models).
+                plan.maybe_signal(start_step + 1)
                 with obs.phase("compile"):
-                    state, metrics = step_fn(state, next(it))
+                    # The first fetch is the one most likely to wedge
+                    # (cold source, first NFS touch) — watch it too.
+                    batch0 = (wdog.fetch(
+                        lambda: _fetch(start_step + 1), start_step + 1)
+                        if wdog is not None else _fetch(start_step + 1))
+                    state, metrics = step_fn(state, batch0)
                     jax.block_until_ready(metrics)
                 cadence(start_step + 1, state, metrics)
+                want_rewind = _inspect(start_step + 1, metrics)
         steps_done = 1 if cfg.train_steps > start_step else 0
 
         # Bounded async dispatch: block on the oldest pending step once more
@@ -487,23 +684,64 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
         guard = PreemptionGuard(enabled=bool(cfg.checkpoint_dir))
         try:
             with Timer() as train_t:
-                for i in range(start_step + steps_done, cfg.train_steps):
-                    if guard.should_stop(i):
-                        logger.log_json({"event": "preempted", "step": i})
-                        obs.instant("preempted", step=i)
-                        obs.emit("preempted", step=i)
-                        break
-                    profiler.observe(i + 1, pending=metrics)
-                    with obs.data():
-                        batch = next(it)
-                    with obs.dispatch():
-                        state, metrics = step_fn(state, batch)
-                    inflight.append(metrics)
-                    if len(inflight) > 2:
-                        with obs.device_wait():
-                            jax.block_until_ready(inflight.popleft())
-                    cadence(i + 1, state, metrics)
-                    obs.step_end()
+                # The outer while exists for ONE flow: a policy-ordered
+                # rewind restores a checkpoint in-process and re-enters
+                # the step loop from the restored step. Every other
+                # exit (completion, preemption, halt) leaves it on the
+                # first pass; without resilience configured the body is
+                # the plain single-pass loop it always was.
+                next_start = start_step + steps_done
+                while True:
+                    if want_rewind is not None:
+                        state, next_start = _rewind(state, want_rewind)
+                        it = make_iterator(next_start)
+                        want_rewind = None
+                    for i in range(next_start, cfg.train_steps):
+                        if guard.should_stop(i):
+                            logger.log_json({"event": "preempted",
+                                             "step": i})
+                            obs.instant("preempted", step=i)
+                            obs.emit("preempted", step=i)
+                            break
+                        plan.maybe_signal(i + 1)
+                        profiler.observe(i + 1, pending=metrics)
+                        with obs.data():
+                            batch = (wdog.fetch(lambda: _fetch(i + 1),
+                                                i + 1)
+                                     if wdog is not None
+                                     else _fetch(i + 1))
+                        with obs.dispatch():
+                            state, metrics = step_fn(state, batch)
+                        inflight.append((i + 1, metrics))
+                        if len(inflight) > 2:
+                            sid, m = inflight.popleft()
+                            with obs.device_wait():
+                                _sync_retired(sid, m)
+                            verdict = _inspect(sid, m)
+                            if verdict is not None:
+                                want_rewind = verdict
+                                inflight.clear()
+                                break
+                        cadence(i + 1, state, metrics)
+                        obs.step_end()
+                    if want_rewind is not None:
+                        continue
+                    if guard.fired is None:
+                        # Retire the trailing in-flight steps through
+                        # the same policy checks (a NaN on the final
+                        # steps must not slip out unhandled); inert
+                        # without a policy/detector.
+                        while inflight:
+                            sid, m = inflight.popleft()
+                            _sync_retired(sid, m)
+                            verdict = _inspect(sid, m)
+                            if verdict is not None:
+                                want_rewind = verdict
+                                inflight.clear()
+                                break
+                        if want_rewind is not None:
+                            continue
+                    break
                 jax.block_until_ready(state.params)
         finally:
             # Always restore the prior SIGTERM disposition — an exception
@@ -517,6 +755,8 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
             guard.close()
             profiler.stop(pending=metrics)
             obs.flush()
+            if wdog is not None:
+                wdog.close()
 
         preempted = guard.fired is not None
         if preempted and cfg.checkpoint_dir:

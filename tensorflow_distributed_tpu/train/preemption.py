@@ -132,9 +132,16 @@ class PreemptionGuard:
         return False
 
     def close(self) -> None:
+        """Restore the previous signal dispositions; idempotent, and
+        safe to call whether or not a notice ever arrived or
+        ``should_stop`` ever consumed it. Also drops any un-consumed
+        notice state so a closed guard can never charge drain time to
+        a goodput counter installed by a LATER run."""
         for sig, prev in self._prev.items():
             try:
                 signal.signal(sig, prev)
             except ValueError:
                 pass
         self._prev.clear()
+        self._notice_time = None
+        self._flag.clear()

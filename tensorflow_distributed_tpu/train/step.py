@@ -92,7 +92,8 @@ def make_train_step(mesh: Mesh, seed: int = 0, donate: bool = True,
                     jit: bool = True,
                     grad_norm_metric: bool = False,
                     ema_decay: float = 0.0,
-                    params_out_shardings: Any = None
+                    params_out_shardings: Any = None,
+                    skip_nonfinite: bool = False
                     ) -> Callable[[TrainState, Batch],
                                   Tuple[TrainState, Metrics]]:
     """Build the jitted train step for a mesh.
@@ -119,6 +120,19 @@ def make_train_step(mesh: Mesh, seed: int = 0, donate: bool = True,
     already has in registers, the standard divergence/LR-tuning
     signal. Off by default to keep metric dicts stable for parity
     tests.
+
+    ``skip_nonfinite`` (resilience.nonfinite=skip_batch): when the
+    step's loss or gradient norm is non-finite, the update is
+    discarded ON DEVICE — params, optimizer state, stat collections,
+    and EMA all keep their pre-step values (the select happens before
+    ``tx.update``'s outputs are committed, so Adam moments are never
+    poisoned), and only the step counter advances. The bad batch is
+    simply dropped from the optimization trajectory. Host-side budget
+    enforcement lives in resilience.policies, reading the (still
+    non-finite) reported loss; ``metrics["skipped_nonfinite"]``
+    reports 1.0 on a skipped step. The select is replicated-by-
+    construction (loss and grad norm are global reductions), so every
+    device takes the same branch — multi-host safe.
     """
 
     if batch_shardings is None:
@@ -180,6 +194,14 @@ def make_train_step(mesh: Mesh, seed: int = 0, donate: bool = True,
                 lambda m: jnp.mean(m, axis=0), metrics_stack)
         if grad_norm_metric:
             metrics = dict(metrics, grad_norm=optax.global_norm(grads))
+        ok = None
+        if skip_nonfinite:
+            # Loss catches forward-side NaNs, the grad norm catches
+            # backward-only ones (finite loss, overflowed grads).
+            ok = (jnp.isfinite(metrics["loss"])
+                  & jnp.isfinite(optax.global_norm(grads)))
+            metrics = dict(metrics,
+                           skipped_nonfinite=jnp.where(ok, 0.0, 1.0))
         updates, new_opt = state.tx.update(grads, state.opt_state, state.params)
         new_params = jax.tree_util.tree_map(
             lambda p, u: (p + u.astype(p.dtype)), state.params, updates)
@@ -197,10 +219,27 @@ def make_train_step(mesh: Mesh, seed: int = 0, donate: bool = True,
             new_params = jax.tree_util.tree_map(
                 jax.lax.with_sharding_constraint, new_params,
                 params_out_shardings)
+        if ok is not None:
+            # Discard the whole update on a non-finite step: the NaN
+            # sits in the not-taken where branch, so nothing poisoned
+            # survives (params, slots, stats). Selected BEFORE the EMA
+            # update so the average tracks only applied params.
+            def keep_old(new, old):
+                return jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(ok, n, o), new, old)
+
+            new_params = keep_old(new_params, state.params)
+            new_opt = keep_old(new_opt, state.opt_state)
+            new_extra = keep_old(new_extra, state.extra)
         new_ema = state.ema
         if ema_decay and state.ema is not None:
             new_ema = ema_update(state.ema, new_params, ema_decay,
                                  state.step)
+            if ok is not None:
+                # A skipped step must not perturb the average either
+                # (the update toward unchanged params still moves the
+                # EMA and its bias correction).
+                new_ema = keep_old(new_ema, state.ema)
         new_state = state.replace(step=state.step + 1, params=new_params,
                                   opt_state=new_opt, extra=new_extra,
                                   ema=new_ema)

@@ -144,11 +144,62 @@ def test_restore_missing_raises(tmp_path, mesh8):
         ckpt.restore(str(tmp_path / "nope"), _state(mesh8))
 
 
+def test_restore_explicit_missing_step_lists_available(tmp_path, mesh8):
+    """An explicit step that isn't on disk must die with the steps
+    that ARE, not an opaque open() traceback."""
+    state = _state(mesh8)
+    step = make_train_step(mesh8, donate=False)
+    b = shard_batch(mesh8, _batch())
+    for _ in range(2):
+        state, _ = step(state, b)
+        ckpt.save(str(tmp_path), state)
+    with pytest.raises(FileNotFoundError,
+                       match=r"available steps: \[1, 2\]"):
+        ckpt.restore(str(tmp_path), _state(mesh8), step=7)
+    with pytest.raises(FileNotFoundError, match="empty or absent"):
+        ckpt.restore(str(tmp_path / "empty"), _state(mesh8))
+    with pytest.raises(FileNotFoundError,
+                       match=r"available steps: \[1, 2\]"):
+        ckpt.restore_averaged(str(tmp_path), _state(mesh8), step=7)
+
+
+def test_available_steps_ignores_garbage(tmp_path, mesh8):
+    """Crashed/partial/foreign entries must never surface as resume
+    targets: tmp staging dirs, quarantined dirs, stray files named
+    like steps, step dirs without a state file, non-step entries."""
+    state = _state(mesh8)
+    step = make_train_step(mesh8, donate=False)
+    state, _ = step(state, shard_batch(mesh8, _batch()))
+    ckpt.save(str(tmp_path), state)
+
+    # A crashed mid-write staging dir WITH a complete-looking payload.
+    tmp_dir = tmp_path / "step_00000005.tmp"
+    tmp_dir.mkdir()
+    (tmp_dir / "state.msgpack").write_bytes(b"partial")
+    # A quarantined dir from a previous integrity failure.
+    qdir = tmp_path / "quarantined_step_00000004"
+    qdir.mkdir()
+    (qdir / "state.msgpack").write_bytes(b"bad")
+    # A stray FILE named exactly like a step dir.
+    (tmp_path / "step_00000007").write_text("not a dir")
+    # An empty step dir (no state file, no orbax marker).
+    (tmp_path / "step_00000009").mkdir()
+    # Foreign debris.
+    (tmp_path / "notes.txt").write_text("hi")
+
+    assert ckpt.available_steps(str(tmp_path)) == [1]
+    assert ckpt.latest_step(str(tmp_path)) == 1
+    restored = ckpt.restore(str(tmp_path), _state(mesh8))
+    assert int(jax.device_get(restored.step)) == 1
+
+
 def test_restore_pre_ema_checkpoint(tmp_path, mesh8):
     """A checkpoint written before TrainState grew the ema field (no
     "ema" key in the serialized dict) must still restore — absence
     means "EMA off", not a from_state_dict missing-field error."""
     from flax import serialization
+
+    import json
 
     state = _state(mesh8)
     path = ckpt.save(str(tmp_path), state)
@@ -158,6 +209,15 @@ def test_restore_pre_ema_checkpoint(tmp_path, mesh8):
     raw.pop("ema", None)  # simulate the pre-EMA on-disk layout
     with open(fname, "wb") as f:
         f.write(serialization.msgpack_serialize(raw))
+    # Pre-EMA checkpoints predate the integrity manifest too — strip
+    # the checksum so the simulation is the real old layout (restore
+    # skips verification when no sha256 is recorded).
+    mpath = os.path.join(path, "manifest.json")
+    with open(mpath) as f:
+        manifest = json.load(f)
+    manifest.pop("sha256", None)
+    with open(mpath, "w") as f:
+        json.dump(manifest, f)
 
     restored = ckpt.restore(str(tmp_path), _state(mesh8))
     assert restored.ema is None

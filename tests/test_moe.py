@@ -84,6 +84,8 @@ def test_moe_group_len_matches_naive_routing():
     assert y_s.shape == short.shape
 
 
+@pytest.mark.slow  # 10.7s compile on the CI box (second-heaviest
+#                    default-tier test; round-6 curation)
 def test_moe_scatter_dispatch_matches_dense():
     """dispatch="scatter" is the SAME routing as the dense one-hot
     formulation — identical masks, positions, capacity-drop rule, and

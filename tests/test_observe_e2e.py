@@ -7,6 +7,7 @@ import json
 
 import jax
 import numpy as np
+import pytest
 
 from tensorflow_distributed_tpu.config import (
     MeshConfig, ObserveConfig, TrainConfig)
@@ -93,6 +94,9 @@ def test_vision_run_reports_images_per_sec(tmp_path):
     assert steps[-1]["mfu"] > 0
 
 
+@pytest.mark.slow  # 158s on the CI box (jax.profiler capture startup
+#                    dominates) — the single heaviest default-tier test
+#                    before the round-6 curation moved it here
 def test_profiler_window_closed_on_loop_exit(tmp_path):
     """Satellite regression: training that ends INSIDE the profiler's
     trace window must still finalize the trace (loop-exit stop), and

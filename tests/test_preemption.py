@@ -34,6 +34,39 @@ def test_guard_flag_and_handler_restore():
     assert signal.getsignal(signal.SIGTERM) == prev
 
 
+def test_guard_close_without_should_stop_restores_everything():
+    """close() must restore the previous SIGTERM disposition and leave
+    the process-global goodput state untouched even when a notice
+    arrived but ``should_stop`` never consumed it (the loop raised, or
+    the run finished first) — and a later SIGTERM must not feed the
+    dead guard's flag or charge drain to a later run's counter."""
+    from tensorflow_distributed_tpu.observe import goodput
+    from tensorflow_distributed_tpu.train.preemption import PreemptionGuard
+
+    counter = goodput.GoodputCounter()
+    goodput.set_active(counter)
+    prev = signal.getsignal(signal.SIGTERM)
+    guard = PreemptionGuard()
+    try:
+        os.kill(os.getpid(), signal.SIGTERM)  # notice, never consumed
+        assert guard._flag.is_set()
+        guard.close()
+        # Handlers restored despite the un-consumed notice...
+        assert signal.getsignal(signal.SIGTERM) == prev
+        # ...the installed goodput global is exactly as we left it
+        # (the guard neither uninstalled nor swapped it)...
+        assert goodput.get_active() is counter
+        # ...no drain was charged (only should_stop charges it)...
+        assert "drain" not in counter.overhead
+        # ...and the un-consumed notice state was dropped, so a
+        # should_stop on the closed guard doesn't fire stale.
+        assert not guard.should_stop(0)
+        guard.close()  # idempotent
+    finally:
+        goodput.set_active(None)
+        signal.signal(signal.SIGTERM, prev)
+
+
 def test_guard_disabled_installs_nothing():
     from tensorflow_distributed_tpu.train.preemption import PreemptionGuard
 

@@ -1,0 +1,525 @@
+"""CPU fault-injection suite: every recovery path, exercised.
+
+The resilience/ package's contract is that recovery is PROVEN, not
+believed: each scenario here injects a real fault through the
+deterministic plan (``--resilience.fault-plan``) and demands the run
+recovers — and that the recovery left its event trail in the metrics
+JSONL and the goodput ledger.
+"""
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tensorflow_distributed_tpu.config import (
+    MeshConfig, ObserveConfig, ResilienceConfig, TrainConfig)
+from tensorflow_distributed_tpu.train import checkpoint as ckpt
+from tensorflow_distributed_tpu.train.loop import train
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cfg(**kw):
+    base = dict(dataset="synthetic", batch_size=64, train_steps=10,
+                eval_every=0, log_every=0, eval_batch_size=64,
+                compute_dtype="float32", mesh=MeshConfig(data=8))
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def _recovery(path):
+    with open(path) as f:
+        recs = [json.loads(line) for line in f]
+    return recs, [r for r in recs if r["event"] == "recovery"]
+
+
+def _summary(recs):
+    return [r for r in recs if r["event"] == "summary"][-1]
+
+
+# --- fault-plan grammar --------------------------------------------------
+
+def test_fault_plan_grammar():
+    from tensorflow_distributed_tpu.resilience.faults import (
+        parse_fault_plan)
+
+    plan = parse_fault_plan(
+        "nan_grad@40,ckpt_io_fail@80:2,data_stall@120:5s,sigterm@200")
+    assert bool(plan)
+    assert not parse_fault_plan("")
+    for bad in ("nan_grad", "nan_grad@0", "bogus@5", "nan_grad@5:3",
+                "data_stall@5:0s", "ckpt_io_fail@5:1.5"):
+        with pytest.raises(ValueError):
+            parse_fault_plan(bad)
+    # Config-time validation catches plan syntax at startup.
+    with pytest.raises(ValueError):
+        _cfg(resilience=ResilienceConfig(
+            fault_plan="bogus@5")).validate()
+
+
+def test_policy_config_validation():
+    with pytest.raises(ValueError, match="rewind"):
+        _cfg(resilience=ResilienceConfig(nonfinite="rewind")).validate()
+    with pytest.raises(ValueError, match="halt_on_nonfinite"):
+        _cfg(halt_on_nonfinite=True,
+             resilience=ResilienceConfig(nonfinite="halt")).validate()
+    with pytest.raises(ValueError, match="skip_batch"):
+        _cfg(model="pipelined_lm", batch_size=64,
+             resilience=ResilienceConfig(
+                 nonfinite="skip_batch")).validate()
+
+
+# --- NaN policies --------------------------------------------------------
+
+def test_nan_skip_batch_trains_past(tmp_path):
+    """Injected NaN at step 5: the device discards that update, the
+    budget decrements, and training reaches the final step with finite
+    loss."""
+    jsonl = str(tmp_path / "m.jsonl")
+    r = train(_cfg(
+        observe=ObserveConfig(metrics_jsonl=jsonl),
+        resilience=ResilienceConfig(fault_plan="nan_grad@5",
+                                    nonfinite="skip_batch",
+                                    max_skips=2)))
+    assert int(jax.device_get(r.state.step)) == 10
+    assert np.isfinite(r.final_metrics["loss"])
+    recs, rec = _recovery(jsonl)
+    kinds = [(x.get("kind"), x.get("step"), x.get("action"))
+             for x in rec]
+    assert ("fault_injected", 5, None) in kinds
+    assert ("nonfinite", 5, "skip") in kinds
+    skip = [x for x in rec if x.get("action") == "skip"][0]
+    assert (skip["used"], skip["budget"]) == (1, 2)
+    assert _summary(recs)["skip_nonfinite_count"] == 1
+
+
+def test_nan_skip_budget_exhausted(tmp_path):
+    from tensorflow_distributed_tpu.resilience.policies import (
+        RecoveryBudgetExceeded)
+
+    with pytest.raises(RecoveryBudgetExceeded, match="skips used"):
+        train(_cfg(
+            observe=ObserveConfig(
+                metrics_jsonl=str(tmp_path / "m.jsonl")),
+            resilience=ResilienceConfig(
+                fault_plan="nan_grad@4,nan_grad@6", nonfinite="skip_batch",
+                max_skips=1)))
+
+
+def test_nan_halt_policy_raises(tmp_path):
+    with pytest.raises(FloatingPointError, match="non-finite loss"):
+        train(_cfg(resilience=ResilienceConfig(
+            fault_plan="nan_grad@4", nonfinite="halt")))
+
+
+def test_nan_rewind_restores_and_completes(tmp_path):
+    """Injected NaN at step 5 under rewind: checkpoints saved after
+    the bad update are quarantined (they hold the poisoned state),
+    the run restores step 4, replays, and reaches the final step."""
+    ckpt_dir = str(tmp_path / "ckpt")
+    jsonl = str(tmp_path / "m.jsonl")
+    r = train(_cfg(
+        checkpoint_dir=ckpt_dir, checkpoint_every=2,
+        observe=ObserveConfig(metrics_jsonl=jsonl),
+        resilience=ResilienceConfig(fault_plan="nan_grad@5",
+                                    nonfinite="rewind",
+                                    max_rewinds=1)))
+    assert int(jax.device_get(r.state.step)) == 10
+    assert np.isfinite(r.final_metrics["loss"])
+    recs, rec = _recovery(jsonl)
+    rewinds = [x for x in rec if x.get("kind") == "rewind"]
+    # NaN injected via the BATCH at 5: the save at 4 (params entering
+    # 5) is clean, passes the restore-time finiteness check, and is
+    # the target; the cadence save at 6 (taken between the bad update
+    # and its lagged detection) is quarantined.
+    assert rewinds and rewinds[0]["to_step"] == 4
+    assert rewinds[0]["from_step"] == 5
+    # The cadence save taken between the bad update and its detection
+    # held NaN params — it must be quarantined, not a resume target.
+    assert any(x.get("kind") == "quarantine" for x in rec)
+    assert any(n.startswith("quarantined_")
+               for n in os.listdir(ckpt_dir))
+    summ = _summary(recs)
+    assert summ["rewind_count"] == 1
+    assert summ["rewind_seconds"] > 0
+    # Post-rewind saves are clean: a fresh restore of the latest must
+    # carry finite params.
+    final = ckpt.restore(ckpt_dir, r.state)
+    leaf = jax.tree_util.tree_leaves(jax.device_get(final.params))[0]
+    assert np.isfinite(leaf).all()
+
+
+def test_nonfinite_policy_unit():
+    from tensorflow_distributed_tpu.resilience.policies import (
+        NonFinitePolicy)
+
+    p = NonFinitePolicy("skip_batch", max_skips=2, max_rewinds=1)
+    assert p.on_nonfinite(3, float("nan")) == "skip"
+    assert p.on_nonfinite(4, float("nan")) == "skip"
+    assert p.on_nonfinite(5, float("nan")) == "halt"  # budget spent
+    # Spikes don't rewind under skip_batch (the update already
+    # applied) — event-only.
+    assert p.on_spike(6, 99.0, median=1.0) is None
+
+    r = NonFinitePolicy("rewind", max_skips=0, max_rewinds=2)
+    assert r.on_nonfinite(3, float("inf")) == "rewind"
+    assert r.on_spike(9, 99.0, median=1.0) == "rewind"  # shares budget
+    assert r.on_nonfinite(12, float("nan")) == "halt"
+    assert "rewinds used 2/2" in r.halt_message(12, float("nan"), 8)
+
+
+def test_rewind_skips_poisoned_params_checkpoint(tmp_path):
+    """Param-side damage: the latest checkpoint has intact bytes but
+    NaN values (backward-only overflow saved before detection). The
+    rewind's restore-time finiteness check must quarantine it and
+    walk back to the older clean step instead of burning the budget
+    on an instant re-NaN."""
+    from flax import serialization
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    train(_cfg(train_steps=6, checkpoint_dir=ckpt_dir,
+               checkpoint_every=2))
+    assert ckpt.available_steps(ckpt_dir) == [2, 4, 6]
+    # NaN-poison step 6's params in place, keeping bytes VALID
+    # (re-serialize + refresh the manifest checksum) so only the
+    # value check can catch it.
+    import hashlib
+
+    sd = os.path.join(ckpt_dir, "step_00000006")
+    with open(os.path.join(sd, "state.msgpack"), "rb") as f:
+        raw = serialization.msgpack_restore(f.read())
+    raw["params"] = jax.tree_util.tree_map(
+        lambda x: np.full_like(x, np.nan), raw["params"])
+    blob = serialization.msgpack_serialize(raw)
+    with open(os.path.join(sd, "state.msgpack"), "wb") as f:
+        f.write(blob)
+    with open(os.path.join(sd, "manifest.json")) as f:
+        man = json.load(f)
+    man["sha256"] = hashlib.sha256(blob).hexdigest()
+    with open(os.path.join(sd, "manifest.json"), "w") as f:
+        json.dump(man, f)
+
+    jsonl = str(tmp_path / "m.jsonl")
+    r = train(_cfg(
+        train_steps=10, checkpoint_dir=ckpt_dir, checkpoint_every=2,
+        resume=True,
+        observe=ObserveConfig(metrics_jsonl=jsonl),
+        resilience=ResilienceConfig(nonfinite="rewind",
+                                    max_rewinds=1)))
+    # Resume restored the poisoned step 6, the first losses were NaN,
+    # and ONE rewind recovered: step 6 failed the finiteness check,
+    # was quarantined, and step 4 became the target.
+    assert int(jax.device_get(r.state.step)) == 10
+    assert np.isfinite(r.final_metrics["loss"])
+    recs, rec = _recovery(jsonl)
+    rewinds = [x for x in rec if x.get("kind") == "rewind"]
+    assert rewinds and rewinds[0]["to_step"] == 4
+    quars = [x for x in rec if x.get("kind") == "quarantine"]
+    assert any("non-finite" in q.get("reason", "") for q in quars)
+
+
+def test_loss_spike_detector_unit():
+    from tensorflow_distributed_tpu.resilience.policies import (
+        LossSpikeDetector)
+
+    det = LossSpikeDetector(window=4, factor=10.0)
+    for v in (1.0, 1.1, 0.9, 1.0):
+        assert det.observe(v) is None  # window filling
+    assert det.observe(1.2) is None
+    med = det.observe(50.0)
+    assert med is not None and 0.9 <= med <= 1.2  # spike flagged
+    det.reset()
+    assert det.observe(50.0) is None  # fresh window after rewind
+
+
+# --- checkpoint integrity ------------------------------------------------
+
+def _state(mesh8):
+    from tensorflow_distributed_tpu.models.cnn import MnistCNN
+    from tensorflow_distributed_tpu.train.state import create_train_state
+
+    model = MnistCNN(dropout_rate=0.0, compute_dtype=jnp.float32)
+    return create_train_state(model, optax.adam(1e-3),
+                              jnp.zeros((2, 28, 28, 1)), mesh8, seed=0)
+
+
+def _save_n(tmp_path, mesh8, n=3):
+    from tensorflow_distributed_tpu.parallel.sharding import shard_batch
+    from tensorflow_distributed_tpu.train.step import make_train_step
+
+    state = _state(mesh8)
+    step = make_train_step(mesh8, donate=False)
+    rng = np.random.default_rng(0)
+    b = shard_batch(mesh8, (
+        rng.normal(size=(16, 28, 28, 1)).astype(np.float32),
+        rng.integers(0, 10, size=(16,)).astype(np.int32)))
+    for _ in range(n):
+        state, _ = step(state, b)
+        ckpt.save(str(tmp_path), state)
+    return state
+
+
+def test_corrupt_latest_falls_back_and_quarantines(tmp_path, mesh8):
+    """Bit-flipped latest checkpoint: restore() falls back to the
+    previous verifiable step, quarantines the bad one, and emits the
+    recovery event."""
+    from tensorflow_distributed_tpu.observe import registry as reg
+    from tensorflow_distributed_tpu.observe.registry import (
+        MetricsRegistry)
+
+    _save_n(tmp_path, mesh8, 3)
+    p = tmp_path / "step_00000003" / "state.msgpack"
+    blob = bytearray(p.read_bytes())
+    blob[1000] ^= 0xFF
+    p.write_bytes(bytes(blob))
+
+    r = MetricsRegistry()
+    reg.set_active(r)
+    try:
+        restored = ckpt.restore(str(tmp_path), _state(mesh8))
+    finally:
+        reg.set_active(None)
+    assert int(jax.device_get(restored.step)) == 2
+    assert ckpt.available_steps(str(tmp_path)) == [1, 2]
+    assert (tmp_path / "quarantined_step_00000003").exists()
+    assert any(x["event"] == "recovery" and x["kind"] == "quarantine"
+               and x["step"] == 3 for x in r.records)
+
+
+def test_truncated_latest_falls_back(tmp_path, mesh8):
+    _save_n(tmp_path, mesh8, 2)
+    with open(tmp_path / "step_00000002" / "state.msgpack",
+              "r+b") as f:
+        f.truncate(1000)
+    restored = ckpt.restore(str(tmp_path), _state(mesh8))
+    assert int(jax.device_get(restored.step)) == 1
+
+
+def test_all_corrupt_raises_clear_error(tmp_path, mesh8):
+    _save_n(tmp_path, mesh8, 2)
+    for n in (1, 2):
+        with open(tmp_path / f"step_0000000{n}" / "state.msgpack",
+                  "r+b") as f:
+            f.truncate(100)
+    with pytest.raises(ckpt.CheckpointCorruptError,
+                       match="failed verification"):
+        ckpt.restore(str(tmp_path), _state(mesh8))
+
+
+def test_explicit_corrupt_step_raises_without_quarantine(
+        tmp_path, mesh8):
+    _save_n(tmp_path, mesh8, 2)
+    with open(tmp_path / "step_00000002" / "state.msgpack",
+              "r+b") as f:
+        f.truncate(1000)
+    with pytest.raises(ckpt.CheckpointCorruptError):
+        ckpt.restore(str(tmp_path), _state(mesh8), step=2)
+    # Explicit inspection does not rename the dir away.
+    assert (tmp_path / "step_00000002").exists()
+
+
+def test_restore_averaged_corrupt_latest_falls_back(tmp_path, mesh8):
+    """restore_averaged shares restore()'s integrity contract: a
+    corrupt latest STACKED checkpoint is quarantined and the
+    next-newest verifiable one restores."""
+    from tensorflow_distributed_tpu.train.local_sgd import stack_state
+
+    stacked = stack_state(_state(mesh8), mesh8)
+    ckpt.save(str(tmp_path), stacked)               # step 0
+    ckpt.save(str(tmp_path),
+              stacked.replace(step=stacked.step + 1))  # step 1
+    with open(tmp_path / "step_00000001" / "state.msgpack",
+              "r+b") as f:
+        f.truncate(1000)
+    restored = ckpt.restore_averaged(str(tmp_path), _state(mesh8))
+    assert int(jax.device_get(restored.step)) == 0
+    assert (tmp_path / "quarantined_step_00000001").exists()
+
+
+def test_save_io_failure_retries_and_succeeds(tmp_path, mesh8):
+    """Armed injected write failures are consumed by the capped-
+    backoff retry loop; the save lands."""
+    from tensorflow_distributed_tpu.observe import registry as reg
+    from tensorflow_distributed_tpu.observe.registry import (
+        MetricsRegistry)
+
+    state = _state(mesh8)
+    ckpt.set_io_policy(retries=2, backoff_s=0.01)
+    r = MetricsRegistry()
+    reg.set_active(r)
+    try:
+        ckpt.arm_io_fault(2)
+        ckpt.save(str(tmp_path), state)
+    finally:
+        reg.set_active(None)
+        ckpt.set_io_policy()
+    assert ckpt.available_steps(str(tmp_path)) == [0]
+    retries = [x for x in r.records if x.get("kind") == "ckpt_retry"]
+    assert [x["attempt"] for x in retries] == [1, 2]
+
+
+def test_save_io_failure_exhausts_retries(tmp_path, mesh8):
+    state = _state(mesh8)
+    ckpt.set_io_policy(retries=1, backoff_s=0.01)
+    try:
+        ckpt.arm_io_fault(5)
+        with pytest.raises(OSError, match="injected"):
+            ckpt.save(str(tmp_path), state)
+    finally:
+        ckpt.arm_io_fault(0)
+        ckpt.set_io_policy()
+
+
+def test_ckpt_io_fail_in_training_run(tmp_path):
+    """End-to-end: ckpt_io_fail@4 injected into the cadence save is
+    absorbed by the retry policy; every checkpoint lands."""
+    ckpt_dir = str(tmp_path / "ckpt")
+    jsonl = str(tmp_path / "m.jsonl")
+    train(_cfg(
+        train_steps=6, checkpoint_dir=ckpt_dir, checkpoint_every=2,
+        observe=ObserveConfig(metrics_jsonl=jsonl),
+        resilience=ResilienceConfig(fault_plan="ckpt_io_fail@4:2",
+                                    save_retries=3,
+                                    save_retry_backoff_s=0.01)))
+    assert ckpt.available_steps(ckpt_dir) == [2, 4, 6]
+    recs, rec = _recovery(jsonl)
+    assert [x["attempt"] for x in rec
+            if x.get("kind") == "ckpt_retry"] == [1, 2]
+    assert _summary(recs)["ckpt_retry_count"] == 2
+
+
+# --- watchdog ------------------------------------------------------------
+
+def test_data_stall_raises_stallerror(tmp_path):
+    """An injected 1.5s fetch stall against a 0.3s deadline becomes a
+    diagnosable StallError, with the stall event in the JSONL."""
+    from tensorflow_distributed_tpu.resilience.watchdog import (
+        StallError)
+
+    jsonl = str(tmp_path / "m.jsonl")
+    with pytest.raises(StallError, match="next-batch fetch"):
+        train(_cfg(
+            observe=ObserveConfig(metrics_jsonl=jsonl),
+            resilience=ResilienceConfig(
+                fault_plan="data_stall@4:1.5s", data_timeout_s=0.3)))
+    _, rec = _recovery(jsonl)
+    stalls = [x for x in rec if x.get("kind") == "stall"]
+    assert stalls and stalls[0]["what"] == "next-batch fetch"
+    assert stalls[0]["step"] == 4
+
+
+def test_watchdog_unit_passthrough_and_timeout():
+    import time
+
+    from tensorflow_distributed_tpu.resilience.watchdog import (
+        StallError, Watchdog)
+
+    wd = Watchdog(data_timeout_s=0.2, sync_timeout_s=0.0)
+    try:
+        assert wd.fetch(lambda: 42, step=1) == 42
+        # sync with timeout 0 is an unwatched plain block.
+        assert int(wd.sync(jnp.ones(()), step=1)) == 1
+        with pytest.raises(StallError):
+            wd.fetch(lambda: time.sleep(1.0), step=2)
+    finally:
+        wd.close()
+
+
+# --- supervisor ----------------------------------------------------------
+
+def _child_env():
+    return {
+        "PATH": os.environ["PATH"],
+        "HOME": os.environ.get("HOME", "/tmp"),
+        "PYTHONPATH": REPO,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "JAX_COMPILATION_CACHE_DIR":
+            os.environ.get("JAX_COMPILATION_CACHE_DIR", ""),
+        "PYTHONUNBUFFERED": "1",
+    }
+
+
+def test_supervisor_restarts_sigkilled_child(tmp_path):
+    """The acceptance scenario: a child SIGKILLed mid-run (no notice,
+    no graceful drain) is restarted with --resume and the run reaches
+    the target step with state continuous across the restart."""
+    ckpt_dir = str(tmp_path / "ckpt")
+    jsonl = str(tmp_path / "m.jsonl")
+    proc = subprocess.run(
+        [sys.executable, "-m",
+         "tensorflow_distributed_tpu.resilience.supervisor",
+         "--max-restarts", "3", "--backoff-base-s", "0.2", "--",
+         "--dataset", "synthetic", "--mesh.data", "8",
+         "--batch-size", "64", "--train-steps", "8",
+         "--eval-every", "0", "--log-every", "0",
+         "--eval-batch-size", "64", "--compute-dtype", "float32",
+         "--checkpoint-dir", ckpt_dir, "--checkpoint-every", "2",
+         "--observe.metrics-jsonl", jsonl,
+         "--resilience.fault-plan", "sigkill@5"],
+        env=_child_env(), cwd=REPO, capture_output=True, text=True,
+        timeout=500)
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-2000:]
+    assert '"kind": "restart"' in proc.stdout
+    recs, rec = _recovery(jsonl)
+    # Leg 0 injected the kill at step 5; leg 1 resumed from the last
+    # durable save (step 4) and ran to completion.
+    assert any(x.get("fault") == "sigkill" for x in rec)
+    assert any(x.get("kind") == "restart" and x.get("rc") == -9
+               for x in rec)
+    resumed = [x for x in recs if x["event"] == "resumed"]
+    assert resumed and resumed[0]["step"] == 4
+    assert [x.get("steps") for x in recs
+            if x["event"] == "summary"] == [8]
+    assert ckpt.latest_step(ckpt_dir) == 8
+
+
+def test_supervisor_does_not_restart_diverged_child(tmp_path):
+    """A child that halts on divergence (exit 2) is NOT restarted —
+    a deterministic data stream would just re-diverge at the same
+    step, burning the whole restart budget for nothing."""
+    proc = subprocess.run(
+        [sys.executable, "-m",
+         "tensorflow_distributed_tpu.resilience.supervisor",
+         "--max-restarts", "3", "--backoff-base-s", "0.1", "--",
+         "--dataset", "synthetic", "--mesh.data", "8",
+         "--batch-size", "64", "--train-steps", "8",
+         "--eval-every", "0", "--log-every", "0",
+         "--eval-batch-size", "64", "--compute-dtype", "float32",
+         "--checkpoint-dir", str(tmp_path / "ckpt"),
+         "--resilience.fault-plan", "nan_grad@3",
+         "--resilience.nonfinite", "halt"],
+        env=_child_env(), cwd=REPO, capture_output=True, text=True,
+        timeout=300)
+    assert proc.returncode == 2, proc.stdout[-2000:] + proc.stderr[-1000:]
+    assert "not restarting" in proc.stdout
+    assert '"kind": "restart"' not in proc.stdout
+
+
+def test_supervisor_gives_up_after_budget(tmp_path):
+    """A child that always fails exhausts the restart budget; the
+    supervisor exits nonzero with the child's failure code."""
+    proc = subprocess.run(
+        [sys.executable, "-m",
+         "tensorflow_distributed_tpu.resilience.supervisor",
+         "--max-restarts", "1", "--backoff-base-s", "0.1", "--",
+         "--dataset", "synthetic", "--train-steps", "-1"],
+        env=_child_env(), cwd=REPO, capture_output=True, text=True,
+        timeout=240)
+    assert proc.returncode != 0
+    assert "restart budget exhausted" in proc.stdout
+
+
+def test_supervisor_usage_error():
+    from tensorflow_distributed_tpu.resilience.supervisor import main
+
+    assert main([]) == 2  # no "--" separator

@@ -62,6 +62,9 @@ def test_rope_decode_matches_full_forward():
                                    err_msg=f"position {t}")
 
 
+@pytest.mark.slow  # 82s on the CI box — the seq-sharded ring compile
+#                    is the heaviest single default-tier compile
+#                    (round-6 curation)
 def test_rope_seq_sharded_matches_unsharded(devices8):
     """RoPE under ring attention: the rotation is elementwise along the
     seq dim, so a seq=8 mesh forward equals the unsharded forward."""
