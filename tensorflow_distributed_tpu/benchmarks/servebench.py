@@ -1,0 +1,166 @@
+"""Continuous-batching vs sequential one-shot serving benchmark.
+
+The serving engine's claim (serve/ package): aggregate throughput on a
+mixed-length request stream comes from keeping ONE hot compiled decode
+step saturated with whatever requests are in flight, not from running
+each request through its own prefill+decode program. This bench pits
+the two against each other on the same workload and model:
+
+- **continuous**: serve.SlotDecodeEngine + Scheduler — requests share
+  the slot batch, prompts prefill through the bounded bucket ladder;
+- **sequential**: one ``generate()`` call per request, in arrival
+  order — every distinct prompt length traces a fresh XLA program
+  (the repo's only serving story before serve/ existed).
+
+Emits one JSON line per metric plus a summary line carrying the two
+acceptance checks (also pinned in tests/test_serve.py):
+``speedup_ok`` (continuous >= --min-speedup x sequential aggregate
+tokens/s) and ``prefill_programs_ok`` (distinct compiled prefill
+programs <= bucket count). Exits 1 if either fails (--no-check to
+report without gating). --out writes the lines to SERVEBENCH.json
+(overwritten per run, like the sibling benchmarks).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--size", default="tiny",
+                        help="gpt_lm size preset (tiny | small)")
+    parser.add_argument("--requests", type=int, default=16)
+    parser.add_argument("--num-slots", type=int, default=4)
+    parser.add_argument("--prompt-len-min", type=int, default=4)
+    parser.add_argument("--prompt-len-max", type=int, default=48)
+    parser.add_argument("--new-tokens", type=int, default=32)
+    parser.add_argument("--decode-priority", type=int, default=4)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--min-speedup", type=float, default=2.0)
+    parser.add_argument("--no-check", action="store_true",
+                        help="report without gating on the checks")
+    parser.add_argument("--out", default="SERVEBENCH.json")
+    args = parser.parse_args(argv)
+    if args.requests < 1 or args.num_slots < 1:
+        parser.error("--requests and --num-slots must be >= 1")
+
+    import jax
+    import numpy as np
+
+    from tensorflow_distributed_tpu.models.generate import generate
+    from tensorflow_distributed_tpu.models.transformer import gpt_lm
+    from tensorflow_distributed_tpu.parallel.mesh import (
+        single_device_mesh)
+    from tensorflow_distributed_tpu.serve.buckets import default_buckets
+    from tensorflow_distributed_tpu.serve.engine import SlotDecodeEngine
+    from tensorflow_distributed_tpu.serve.scheduler import (
+        Request, Scheduler)
+    from tensorflow_distributed_tpu.train.state import (
+        create_train_state, param_count)
+    from tensorflow_distributed_tpu.utils.compilecache import (
+        enable_persistent_cache)
+
+    enable_persistent_cache()
+    import optax
+
+    rng = np.random.default_rng(args.seed)
+    lens = rng.integers(args.prompt_len_min, args.prompt_len_max + 1,
+                        size=args.requests)
+    buckets = default_buckets(int(lens.max()))
+    max_len = max(buckets) + args.new_tokens
+
+    dev = jax.devices()[0]
+    mesh = single_device_mesh(dev)
+    model = gpt_lm(mesh, size=args.size, max_len=max_len,
+                   dropout_rate=0.0)
+    state = create_train_state(model, optax.identity(),
+                               np.zeros((2, 16), np.int32), mesh, seed=0)
+    params = state.params
+    prompts = [rng.integers(0, model.cfg.vocab_size,
+                            size=int(n)).astype(np.int32) for n in lens]
+    total_tokens = args.requests * args.new_tokens
+
+    # --- continuous batching -------------------------------------------
+    engine = SlotDecodeEngine(model, params, args.num_slots,
+                              buckets=buckets)
+    sched = Scheduler(engine, decode_priority=args.decode_priority)
+    reqs = [Request(rid=i, prompt=p, max_new_tokens=args.new_tokens)
+            for i, p in enumerate(prompts)]
+    t0 = time.perf_counter()
+    done = {c.rid: c for c in sched.run(reqs)}
+    continuous_s = time.perf_counter() - t0
+
+    # --- sequential one-shot baseline ----------------------------------
+    # One generate() per request in arrival order — the pre-serve/
+    # path: a fresh prefill+decode program per distinct prompt length,
+    # batch 1 on the decode step.
+    t0 = time.perf_counter()
+    seq_out = [np.asarray(generate(model, params,
+                                   jax.numpy.asarray(p[None, :]),
+                                   args.new_tokens)) [0]
+               for p in prompts]
+    sequential_s = time.perf_counter() - t0
+
+    matches = sum(
+        bool(np.array_equal(seq_out[i], np.asarray(done[i].tokens)))
+        for i in range(args.requests))
+    cont_tps = total_tokens / continuous_s
+    seq_tps = total_tokens / sequential_s
+    speedup = cont_tps / seq_tps
+
+    common = {
+        "model": f"gpt_lm/{args.size}",
+        "params": param_count(params),
+        "requests": args.requests, "new_tokens": args.new_tokens,
+        "num_slots": args.num_slots,
+        "prompt_lens": f"{args.prompt_len_min}-{args.prompt_len_max}",
+        "buckets": ",".join(str(b) for b in buckets),
+        "device": dev.device_kind,
+    }
+    lines = [
+        {"metric": "serve_continuous_tokens_per_sec",
+         "value": round(cont_tps, 1), "unit": "tokens/sec"},
+        {"metric": "serve_sequential_tokens_per_sec",
+         "value": round(seq_tps, 1), "unit": "tokens/sec"},
+        {"metric": "serve_speedup", "value": round(speedup, 2),
+         "unit": "x"},
+        {"metric": "serve_ttft_ms_p50", "unit": "ms",
+         "value": round(1e3 * float(np.percentile(
+             [done[i].ttft_s for i in range(args.requests)], 50)), 2)},
+        {"metric": "serve_mean_slot_occupancy",
+         "value": sched.summary["mean_slot_occupancy"], "unit": ""},
+        {"metric": "serve_prefill_programs",
+         "value": engine.prefill_compiles, "unit": "programs"},
+    ]
+    checks = {
+        "metric": "serve_checks",
+        "speedup_ok": bool(speedup >= args.min_speedup),
+        "min_speedup": args.min_speedup,
+        "prefill_programs_ok": bool(
+            engine.prefill_compiles <= len(buckets)),
+        "token_identical": int(matches), "of": args.requests,
+    }
+    lines.append(checks)
+    lines = [dict(ln, **common) for ln in lines]
+
+    print("\n".join(json.dumps(ln) for ln in lines))
+    if args.out:
+        # Overwrite like the sibling benchmarks: reruns replace, never
+        # silently accumulate stale lines.
+        from tensorflow_distributed_tpu.observe.registry import (
+            write_jsonl)
+        write_jsonl(args.out, lines)
+    if not args.no_check and not (
+            checks["speedup_ok"] and checks["prefill_programs_ok"]
+            and matches == args.requests):
+        print(f"servebench: checks FAILED: {checks}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

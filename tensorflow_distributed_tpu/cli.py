@@ -16,6 +16,10 @@ Examples:
     # reference-faithful hyperparameters (for apples-to-apples runs):
     python -m tensorflow_distributed_tpu.cli --init-scheme reference \
         --learning-rate 0.01 --log-every 1
+
+    # continuous-batching inference (serve/; README "Serving"):
+    python -m tensorflow_distributed_tpu.cli --mode serve \
+        --model gpt_lm --serve.num-slots 8 --serve.num-requests 32
 """
 
 from __future__ import annotations
@@ -49,6 +53,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return 0
     if cfg.mode == "generate":
         generate_only(cfg)
+        return 0
+    if cfg.mode == "serve":
+        # Continuous-batching inference over a request workload
+        # (serve/run.py): slots join/leave one hot compiled decode
+        # step, prompts prefill through a bounded bucket ladder.
+        from tensorflow_distributed_tpu.serve.run import serve_run
+        serve_run(cfg)
         return 0
     try:
         result = train(cfg)

@@ -109,6 +109,77 @@ class ObserveConfig:
 
 
 @dataclasses.dataclass
+class ServeConfig:
+    """The serve/ subsystem's knobs (continuous-batching inference —
+    see the serve package docs and the README "Serving" section).
+    Active only under ``mode=serve``."""
+
+    # Decode batch width: concurrent requests in flight. The decode
+    # step is ONE compiled program over [num_slots, max_len] for the
+    # life of the process; requests join/leave between steps.
+    num_slots: int = 8
+    # Default per-request generation budget (a request file may
+    # override per request).
+    max_new_tokens: int = 64
+    # Prefill bucket ladder, e.g. "32,64,128" (prompts pad up to the
+    # next bucket; compiled prefill programs are bounded by the ladder
+    # size). "" = power-of-two ladder covering the workload's longest
+    # prompt (serve/buckets.py).
+    buckets: str = ""
+    # Starvation bound for the decode-priority interleave: a queued
+    # request with a free slot is admitted after at most this many
+    # decode steps.
+    decode_priority: int = 4
+    # EOS token id terminating a request early (-1 = run every request
+    # to its full budget).
+    eos_id: int = -1
+    # Request file (JSONL: {"prompt": [ids...], "max_new_tokens": n,
+    # "eos_id": e, "arrival_s": t} — "text" instead of "prompt" with
+    # --dataset text). "" = synthetic workload below.
+    requests: str = ""
+    # Synthetic workload: request count, mixed prompt lengths
+    # (uniform in [min, max], seeded by --seed), open-loop arrival
+    # rate in req/s (0 = the whole batch queued at t=0).
+    num_requests: int = 16
+    prompt_len_min: int = 8
+    prompt_len_max: int = 64
+    arrival_rate: float = 0.0
+    # Print each streamed token as it retires (chief only).
+    stream: bool = False
+
+    def validate(self) -> None:
+        if self.num_slots < 1:
+            raise ValueError(
+                f"serve.num_slots must be >= 1, got {self.num_slots}")
+        if self.max_new_tokens < 1:
+            raise ValueError(
+                f"serve.max_new_tokens must be >= 1, "
+                f"got {self.max_new_tokens}")
+        if self.decode_priority < 1:
+            raise ValueError(
+                f"serve.decode_priority must be >= 1, "
+                f"got {self.decode_priority}")
+        if self.buckets:
+            from tensorflow_distributed_tpu.serve.buckets import (
+                parse_buckets)
+            parse_buckets(self.buckets)  # syntax at config time
+        if not self.requests:
+            if self.num_requests < 1:
+                raise ValueError(
+                    f"serve.num_requests must be >= 1, "
+                    f"got {self.num_requests}")
+            if not 1 <= self.prompt_len_min <= self.prompt_len_max:
+                raise ValueError(
+                    f"serve prompt length range [{self.prompt_len_min},"
+                    f" {self.prompt_len_max}] must satisfy 1 <= min "
+                    f"<= max")
+        if self.arrival_rate < 0:
+            raise ValueError(
+                f"serve.arrival_rate must be >= 0, "
+                f"got {self.arrival_rate}")
+
+
+@dataclasses.dataclass
 class ResilienceConfig:
     """The resilience/ subsystem's knobs (see resilience package docs
     and the README "Fault tolerance" section). All off by default —
@@ -472,15 +543,24 @@ class TrainConfig:
     resilience: ResilienceConfig = dataclasses.field(
         default_factory=ResilienceConfig)
 
+    # --- serving ---------------------------------------------------------
+    # Continuous-batching inference (serve/ package; active under
+    # mode=serve). CLI flags: --serve.num-slots, --serve.buckets,
+    # --serve.decode-priority, --serve.requests...
+    serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
+
     # --- misc ------------------------------------------------------------
     seed: int = 0
     # "eval": restore the latest checkpoint from checkpoint_dir and run
     # only the validation pass (train.loop.evaluate_only) — the
     # reference's validation loop without its mandatory training
     # prelude; "generate" restores a checkpoint and continues a prompt
-    # (causal LM families; train/loop.py::generate_only). "train"
-    # (default) is the full loop.
-    mode: str = "train"  # train | eval | generate
+    # (causal LM families; train/loop.py::generate_only); "serve"
+    # drives the continuous-batching inference engine over a request
+    # workload (serve/run.py; checkpoint optional — fresh-init params
+    # serve as a load-testing mode). "train" (default) is the full
+    # loop.
+    mode: str = "train"  # train | eval | generate | serve
 
     # --- mode=generate ---------------------------------------------------
     # The prompt: for dataset=text, a string run through the SAME
@@ -718,8 +798,19 @@ class TrainConfig:
                 f"grad_accum_steps {self.grad_accum_steps}")
         if self.resume and not self.checkpoint_dir:
             raise ValueError("resume=True requires checkpoint_dir")
-        if self.mode not in ("train", "eval", "generate"):
+        if self.mode not in ("train", "eval", "generate", "serve"):
             raise ValueError(f"unknown mode {self.mode!r}")
+        if self.mode == "serve":
+            if self.model not in ("gpt_lm", "moe_lm"):
+                raise ValueError(
+                    f"mode=serve needs a causal LM with the decode "
+                    f"cache (gpt_lm or moe_lm), got {self.model!r}")
+            if (self.mesh.model > 1 or self.mesh.seq > 1
+                    or self.mesh.pipe > 1 or self.mesh.expert > 1):
+                raise ValueError(
+                    "mode=serve requires a pure data mesh (model/seq/"
+                    "pipe/expert == 1): the slot engine's single-token "
+                    "steps can't be model-sharded yet")
         if self.mode == "generate":
             if self.model not in ("gpt_lm", "moe_lm"):
                 raise ValueError(
@@ -873,6 +964,7 @@ class TrainConfig:
         self.mesh.validate()
         self.observe.validate()
         self.resilience.validate()
+        self.serve.validate()
 
 
 def _add_dataclass_args(parser: argparse.ArgumentParser, cls, prefix: str = "") -> None:

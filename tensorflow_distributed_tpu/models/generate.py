@@ -18,6 +18,75 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from tensorflow_distributed_tpu.observe.registry import emit_event
+
+# --- compiled-program cache accounting ---------------------------------
+#
+# Every jitted program here is built by an lru_cache'd factory; a MISS
+# means a fresh trace + XLA compile (seconds to minutes), a HIT reuses
+# the executable. Retrace storms — e.g. a caller cycling max_new_tokens
+# or sampler knobs per request — show up as a climbing miss count, so
+# the counts are queryable (compile_cache_stats) and each miss emits a
+# "compile_cache" record through the active observe registry.
+
+_compile_events = {"hits": 0, "misses": 0}
+
+
+def compile_cache_stats() -> dict:
+    """Cumulative compiled-program cache hits/misses (process-wide,
+    all program factories in this module plus serve/engine.py's
+    bucketed prefill)."""
+    return dict(_compile_events)
+
+
+def lookup_program(factory, *key):
+    """Fetch ``factory(*key)`` counting lru_cache hits/misses; a miss
+    (a fresh trace+compile) also emits a ``compile_cache`` observe
+    record naming the factory, so retrace storms are visible in the
+    run's JSONL instead of only as mysterious wall time."""
+    before = factory.cache_info().misses
+    fn = factory(*key)
+    if factory.cache_info().misses > before:
+        _compile_events["misses"] += 1
+        emit_event("compile_cache", program=factory.__name__,
+                   result="miss", **_compile_events)
+    else:
+        _compile_events["hits"] += 1
+    return fn
+
+
+def prefill_cache(model, params, prompt: jax.Array,
+                  positions: Optional[jax.Array] = None):
+    """One forward pass over ``prompt`` [B, P] that populates every
+    layer's KV cache — THE prefill, shared by greedy decoding, beam
+    search, and the serving engine's bucketed prefill programs
+    (serve/engine.py). Returns (logits [B, P, V], cache pytree).
+
+    ``positions`` defaults to arange(P) (a fresh cache); pass explicit
+    positions to prefill at an offset."""
+    if positions is None:
+        positions = jnp.arange(prompt.shape[1])[None, :]
+    logits, state = model.apply(
+        {"params": params}, prompt, decode=True,
+        positions=positions, mutable=["cache"])
+    return logits, state["cache"]
+
+
+def decode_token(model, params, cache, tok: jax.Array,
+                 positions: jax.Array):
+    """One single-token decode step against the cache — THE decode
+    step, shared by greedy decoding, beam search, and the serving
+    engine. ``tok`` [B] int32; ``positions`` [B] (per-row cache
+    depths — the serving engine's slots differ) or [1] (every row in
+    lockstep). Returns (last-position logits [B, V], updated cache)."""
+    pos = jnp.asarray(positions, jnp.int32)
+    if pos.ndim == 0:
+        pos = pos[None]
+    logits, state = model.apply(
+        {"params": params, "cache": cache}, tok[:, None], decode=True,
+        positions=pos[:, None], mutable=["cache"])
+    return logits[:, -1, :], state["cache"]
+
 
 def _filter_logits(logits: jax.Array, top_k: int, top_p: float
                    ) -> jax.Array:
@@ -62,13 +131,9 @@ def _compiled(model, max_new_tokens: int, temperature: float,
     def run(params, prompt, key):
         P = prompt.shape[1]
         # Prefill: one pass over the prompt populates every layer cache.
-        logits, state = model.apply(
-            {"params": params}, prompt, decode=True,
-            positions=jnp.arange(P)[None, :], mutable=["cache"])
-        cache = state["cache"]
+        logits, cache = prefill_cache(model, params, prompt)
 
-        def pick(logits, key):
-            last = logits[:, -1, :]
+        def pick(last, key):
             if temperature == 0.0:
                 return jnp.argmax(last, axis=-1).astype(jnp.int32)
             last = _filter_logits(last / temperature, top_k, top_p)
@@ -78,15 +143,13 @@ def _compiled(model, max_new_tokens: int, temperature: float,
         def step(carry, _):
             cache, tok, pos, key = carry
             key, sub = jax.random.split(key)
-            logits, state = model.apply(
-                {"params": params, "cache": cache}, tok[:, None],
-                decode=True, positions=pos[None, None],
-                mutable=["cache"])
-            nxt = pick(logits, sub)
-            return (state["cache"], nxt, pos + 1, key), nxt
+            last, cache = decode_token(model, params, cache, tok,
+                                       pos[None])
+            nxt = pick(last, sub)
+            return (cache, nxt, pos + 1, key), nxt
 
         key, sub = jax.random.split(key)
-        first = pick(logits, sub)
+        first = pick(logits[:, -1, :], sub)
         (_, _, _, _), toks = jax.lax.scan(
             step, (cache, first, jnp.asarray(P, jnp.int32), key),
             None, length=max_new_tokens - 1)
@@ -130,8 +193,8 @@ def generate(model, params, prompt: jax.Array, max_new_tokens: int, *,
         # compile cache isn't fragmented by values the program never
         # reads.
         top_k, top_p = 0, 1.0
-    return _compiled(model, max_new_tokens, temperature, top_k,
-                     float(top_p))(params, prompt, key)
+    return lookup_program(_compiled, model, max_new_tokens, temperature,
+                          top_k, float(top_p))(params, prompt, key)
 
 
 @functools.lru_cache(maxsize=32)
@@ -157,13 +220,11 @@ def _compiled_beam(model, max_new_tokens: int, num_beams: int,
         # the K beam copies are byte-identical, so repeating the
         # cache leaves costs 1/K of the prompt-dominant prefill
         # FLOPs and HBM traffic that repeating the PROMPT would.
-        logits, state = model.apply(
-            {"params": params}, prompt, decode=True,
-            positions=jnp.arange(P)[None, :], mutable=["cache"])
+        logits, pre = prefill_cache(model, params, prompt)
         cache = jax.tree_util.tree_map(
             lambda c: jnp.repeat(c, K, axis=0)
             if getattr(c, "ndim", 0) and c.shape[0] == B else c,
-            state["cache"])
+            pre)
         logp0 = jax.nn.log_softmax(
             logits[:, -1, :].astype(jnp.float32))      # [B, V]
         # First expansion: B x top-K over the vocab seeds the beams.
@@ -174,13 +235,11 @@ def _compiled_beam(model, max_new_tokens: int, num_beams: int,
 
         def step(carry, i):
             cache, scores, alive, tok = carry
-            logits, state = model.apply(
-                {"params": params, "cache": cache}, tok[:, None],
-                decode=True, positions=jnp.full((1, 1), P + i),
-                mutable=["cache"])  # fed token sits AT position P + i
-            cache = state["cache"]
+            # Fed token sits AT position P + i.
+            last, cache = decode_token(model, params, cache, tok,
+                                       jnp.full((1,), P + i))
             logp = jax.nn.log_softmax(
-                logits[:, -1, :].astype(jnp.float32)).reshape(B, K, V)
+                last.astype(jnp.float32)).reshape(B, K, V)
             # Finished beams emit ONLY eos at zero cost, so they keep
             # their score and stay comparable with live beams.
             if eos_id >= 0:
@@ -270,7 +329,7 @@ def beam_search(model, params, prompt: jax.Array, max_new_tokens: int,
     if eos_id is not None and not 0 <= eos_id < cfg.vocab_size:
         raise ValueError(f"eos_id {eos_id} outside vocab "
                          f"[0, {cfg.vocab_size})")
-    return _compiled_beam(model, max_new_tokens, num_beams,
-                          float(length_penalty),
+    return lookup_program(_compiled_beam, model, max_new_tokens,
+                          num_beams, float(length_penalty),
                           -1 if eos_id is None else int(eos_id))(
         params, prompt)

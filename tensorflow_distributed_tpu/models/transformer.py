@@ -276,10 +276,18 @@ class SelfAttention(nn.Module):
             q = rope_rotate(q, positions, cfg.rope_theta)
             k = rope_rotate(k, positions, cfg.rope_theta)
         if decode:
-            # KV-cache incremental decoding: stash k/v at the running
-            # index, attend q (the L new tokens) against the whole
+            # KV-cache incremental decoding: stash k/v at each row's
+            # position, attend q (the L new tokens) against the whole
             # cache with a position mask. Static shapes throughout —
-            # the cache is always [B, max_len, H, Dh].
+            # the cache is always [B, max_len, H, Dh]. POSITIONS are
+            # the authority on where writes land (they already had to
+            # be per-step correct for RoPE and the mask): rows may sit
+            # at DIFFERENT depths — the serving engine's slots
+            # (serve/engine.py) decode a [num_slots] batch whose
+            # requests joined at different times — so writes are
+            # per-row dynamic_update_slices vmapped over the batch.
+            # A [1, L] positions array broadcasts to the whole batch
+            # (the generate()/beam path, every row in lockstep).
             if not cfg.causal:
                 raise ValueError("decode=True needs a causal config")
             B, L = x.shape[0], x.shape[1]
@@ -301,7 +309,16 @@ class SelfAttention(nn.Module):
                                     (B, cfg.max_len, nk), jnp.float32)
             ci = self.variable("cache", "index",
                                lambda: jnp.zeros((), jnp.int32))
-            idx = ci.value
+            pos = positions.astype(jnp.int32)       # [1 | B, L]
+            # Each row's L new tokens are contiguous from its first
+            # position (prefill: arange; decode: a single token).
+            start = jnp.broadcast_to(pos[:, :1], (B, 1))[:, 0]  # [B]
+
+            def _row_put(buf, new, s):
+                return jax.lax.dynamic_update_slice(
+                    buf, new, (s,) + (0,) * (new.ndim - 1))
+
+            put = jax.vmap(_row_put)
 
             def q8(x):
                 scale = jnp.maximum(
@@ -315,28 +332,27 @@ class SelfAttention(nn.Module):
             if quant:
                 k8, ks = q8(k)
                 v8, vs = q8(v)
-                ck.value = jax.lax.dynamic_update_slice(
-                    ck.value, k8, (0, idx, 0, 0))
-                cv.value = jax.lax.dynamic_update_slice(
-                    cv.value, v8, (0, idx, 0, 0))
-                cks.value = jax.lax.dynamic_update_slice(
-                    cks.value, ks, (0, idx, 0))
-                cvs.value = jax.lax.dynamic_update_slice(
-                    cvs.value, vs, (0, idx, 0))
+                ck.value = put(ck.value, k8, start)
+                cv.value = put(cv.value, v8, start)
+                cks.value = put(cks.value, ks, start)
+                cvs.value = put(cvs.value, vs, start)
             else:
-                ck.value = jax.lax.dynamic_update_slice(ck.value, k,
-                                                        (0, idx, 0, 0))
-                cv.value = jax.lax.dynamic_update_slice(cv.value, v,
-                                                        (0, idx, 0, 0))
-            ci.value = idx + L
+                ck.value = put(ck.value, k, start)
+                cv.value = put(cv.value, v, start)
+            # Scalar running index kept for callers that step every row
+            # in lockstep (meaningless for mixed-depth slot batches —
+            # positions are the authority either way).
+            ci.value = start[0] + L
             from tensorflow_distributed_tpu.ops.flash_attention import (
-                window_bias)
-            rows = jnp.arange(L)[:, None]              # new-token offsets
-            cols = jnp.arange(cfg.max_len)[None, :]
+                NEG_INF, window_keep)
+            cols = jnp.arange(cfg.max_len)[None, None, :]
             # The SAME (pos - window, pos] band as training
-            # (window_bias is the one construction): cache entries
-            # older than the window are masked out.
-            bias = window_bias(idx + rows, cols, cfg.attn_window)
+            # (window_keep is the one construction), per row: cache
+            # entries past each row's position — or older than the
+            # window — are masked out. [1 | B, L, max_len].
+            bias = jnp.where(
+                window_keep(pos[:, :, None], cols, cfg.attn_window),
+                0.0, float(NEG_INF))
             def grouped_attend(kc, vc, kscale=None, vscale=None):
                 # ONE grouped attend for every cache layout (g == 1
                 # covers MHA): narrow (GQA) caches stay narrow, and
@@ -347,7 +363,7 @@ class SelfAttention(nn.Module):
                 # dequantized cache is ever materialized and the only
                 # full-cache HBM reads are int8. Rows are never fully
                 # masked (the just-written diagonal entry at col
-                # idx+r is always inside the window band), so plain
+                # each row's position is always inside its band), so plain
                 # softmax is safe.
                 g = h // nk
                 qg = q.reshape(B, L, nk, g, dh).astype(jnp.float32)

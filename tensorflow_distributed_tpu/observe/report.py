@@ -35,12 +35,41 @@ def _mean(values: List[float]) -> float:
     return sum(values) / len(values)
 
 
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile (no numpy dependency here)."""
+    idx = min(len(sorted_vals) - 1,
+              max(0, round(q / 100.0 * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
 def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     """Aggregate step/summary events into the report dict."""
     steps = [r for r in records if r.get("event") == "step"]
     summaries = [r for r in records if r.get("event") == "summary"]
     out: Dict[str, Any] = {"records": len(records),
                            "step_records": len(steps)}
+    # Serve-mode records (serve/scheduler.py): per-request
+    # serve_request rows + one serve_summary — reported alongside the
+    # training summary so one JSONL tells the whole story.
+    serve_reqs = [r for r in records if r.get("event") == "serve_request"]
+    serve_sums = [r for r in records if r.get("event") == "serve_summary"]
+    if serve_reqs:
+        out["serve_requests"] = len(serve_reqs)
+        ttfts = sorted(float(r["ttft_ms"]) for r in serve_reqs
+                       if isinstance(r.get("ttft_ms"), (int, float)))
+        if ttfts:
+            out["serve_ttft_ms_p50"] = round(_percentile(ttfts, 50), 3)
+            out["serve_ttft_ms_p95"] = round(_percentile(ttfts, 95), 3)
+        toks = [float(r["tok_ms"]) for r in serve_reqs
+                if isinstance(r.get("tok_ms"), (int, float))]
+        if toks:
+            out["serve_tok_ms_mean"] = round(_mean(toks), 4)
+    if serve_sums:
+        final = serve_sums[-1]
+        for key in ("tokens_per_sec", "mean_slot_occupancy",
+                    "total_new_tokens", "prefill_compiles"):
+            if key in final:
+                out[f"serve_{key}"] = final[key]
     if steps:
         out["last_step"] = max(int(r.get("step", 0)) for r in steps)
         # The freshest rolling-window stats (each step record carries
@@ -76,7 +105,11 @@ def render(summary: Dict[str, Any]) -> str:
              "step_ms_p95", "data_ms", "dispatch_ms", "device_ms",
              "mean_tokens_per_sec", "mean_images_per_sec",
              "mean_items_per_sec", "mean_model_tflops", "mean_mfu",
-             "mean_hw_mfu", "first_loss", "last_loss", "goodput")
+             "mean_hw_mfu", "first_loss", "last_loss", "goodput",
+             "serve_requests", "serve_ttft_ms_p50", "serve_ttft_ms_p95",
+             "serve_tok_ms_mean", "serve_tokens_per_sec",
+             "serve_mean_slot_occupancy", "serve_total_new_tokens",
+             "serve_prefill_compiles")
     for key in order:
         if key in summary:
             lines.append(f"  {key:<22} {summary[key]}")

@@ -1,0 +1,33 @@
+"""Continuous-batching LM inference engine (in-flight batching).
+
+The repo's one-shot ``models/generate.py`` prefills and decodes a
+fixed batch to completion: short requests wait for long ones, and the
+device idles between calls. This package serves a dynamically changing
+request set from ONE hot compiled decode program instead:
+
+- :mod:`serve.engine` — slot-based decode engine: one jitted
+  single-token step over a fixed ``[num_slots, max_len]`` KV cache
+  whose slots are independently occupied/freed (insert = a
+  ``dynamic_update_slice`` of a prefilled row; free = host-side), so
+  requests join and leave the batch between steps with ZERO
+  recompilation; plus bucketed prefill (prompt lengths padded to a
+  small set of buckets, bounding the prefill program count);
+- :mod:`serve.buckets` — the bucket ladder and pick logic;
+- :mod:`serve.scheduler` — FIFO admission with a decode-priority /
+  bounded-starvation interleaving policy, per-request EOS and
+  max-token termination, host-side token streaming, and per-request
+  metrics (TTFT, per-token latency, queue steps) through observe/;
+- :mod:`serve.run` — the ``mode=serve`` CLI driver (request-file or
+  synthetic open-loop workload).
+
+Correctness contract (pinned in tests/test_serve.py): engine outputs
+are token-identical to one-shot greedy ``generate()`` per request —
+batching must not change results.
+"""
+
+from tensorflow_distributed_tpu.serve.buckets import (  # noqa: F401
+    default_buckets, parse_buckets, pick_bucket)
+from tensorflow_distributed_tpu.serve.engine import (  # noqa: F401
+    SlotDecodeEngine)
+from tensorflow_distributed_tpu.serve.scheduler import (  # noqa: F401
+    Completion, Request, Scheduler)

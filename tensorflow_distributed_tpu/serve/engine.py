@@ -1,0 +1,209 @@
+"""Slot-based continuous-batching decode engine.
+
+One jitted single-token decode program runs over a fixed
+``[num_slots, max_len]`` KV cache for the life of the process. Slots
+are independently occupied and freed BETWEEN steps, so the request set
+changes with zero recompilation:
+
+- **insert**: a bucketed prefill program (one compile per bucket
+  length, shared with generate()'s prefill via
+  models.generate.prefill_cache) fills a fresh ``[1, max_len]`` cache
+  row, and one jitted ``dynamic_update_slice`` per cache leaf drops it
+  into the slot — the slot index is a traced scalar, so every slot
+  uses the SAME program;
+- **decode**: per-row positions (models/transformer.py writes each
+  row's K/V at ITS position and masks attention past it) let slot 0
+  sit at depth 700 while slot 3 is at depth 12 — one program, any
+  mix of depths;
+- **free**: host-side bookkeeping only. A freed slot keeps riding the
+  batched step (static shapes), writing into its own row at position
+  0 with its mask clamped to one column — garbage that the next
+  insert's full-row overwrite replaces, and that no other row can
+  attend (attention never crosses rows).
+
+Greedy sampling only: the engine's contract (pinned in
+tests/test_serve.py) is token-identical output to one-shot greedy
+``generate()`` per request — continuous batching must not change
+results.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensorflow_distributed_tpu.models.generate import (
+    decode_token, lookup_program, prefill_cache)
+from tensorflow_distributed_tpu.serve.buckets import (
+    default_buckets, pick_bucket)
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled_prefill(model, bucket: int):
+    """One jitted prefill program per (model, bucket length): prompt
+    padded to ``bucket`` -> (cache row [1, max_len, ...], greedy first
+    token from the TRUE last position). ``true_len`` is a traced
+    scalar, so every prompt length sharing a bucket shares the
+    executable."""
+
+    @jax.jit
+    def run(params, prompt, true_len):
+        logits, cache = prefill_cache(model, params, prompt)
+        last = jax.lax.dynamic_index_in_dim(
+            logits, true_len - 1, axis=1, keepdims=False)   # [1, V]
+        return cache, jnp.argmax(last, axis=-1).astype(jnp.int32)
+
+    return run
+
+
+@functools.lru_cache(maxsize=8)
+def _compiled_step(model):
+    """THE decode program: one greedy token for every slot at its own
+    depth. Compiled once per (model, num_slots) — the shapes come from
+    the arguments, so one engine reuses one executable forever."""
+
+    @jax.jit
+    def run(params, cache, tok, pos):
+        last, cache = decode_token(model, params, cache, tok, pos)
+        return cache, jnp.argmax(last, axis=-1).astype(jnp.int32)
+
+    return run
+
+
+@jax.jit
+def _insert_row(cache, row, slot):
+    """Drop a prefilled [1, ...] cache row into ``slot`` of the engine
+    cache — ``slot`` is traced, so all slots share the program. Scalar
+    leaves (the compat ``index``) pass through: positions are the
+    authority on depth."""
+
+    def put(c, r):
+        if getattr(r, "ndim", 0) and r.shape[:1] == (1,):
+            return jax.lax.dynamic_update_slice(
+                c, r.astype(c.dtype), (slot,) + (0,) * (c.ndim - 1))
+        return c
+
+    return jax.tree_util.tree_map(put, cache, row)
+
+
+class SlotDecodeEngine:
+    """The slot cache + the three programs (prefill/insert/step),
+    with host-side slot bookkeeping. The scheduler (serve/scheduler.py)
+    decides WHEN to prefill vs decode; this class owns WHAT runs on
+    device."""
+
+    def __init__(self, model, params, num_slots: int,
+                 buckets: Optional[Sequence[int]] = None,
+                 min_bucket: int = 16):
+        cfg = model.cfg
+        if not cfg.causal:
+            raise ValueError("SlotDecodeEngine needs a causal model")
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        self.model = model
+        self.params = params
+        self.num_slots = num_slots
+        self.max_len = cfg.max_len
+        self.buckets: Tuple[int, ...] = (
+            tuple(buckets) if buckets
+            else default_buckets(cfg.max_len, min_bucket,
+                                 cap=cfg.max_len))
+        if max(self.buckets) > cfg.max_len:
+            raise ValueError(
+                f"largest bucket {max(self.buckets)} exceeds the "
+                f"model's max_len {cfg.max_len}")
+        self.cache = self._zero_cache()
+        self.tok = np.zeros((num_slots,), np.int32)
+        self.pos = np.zeros((num_slots,), np.int32)
+        self.active = np.zeros((num_slots,), bool)
+        # Distinct prefill programs this engine has invoked — one per
+        # bucket actually used, each a single compiled executable (the
+        # bench asserts <= len(buckets)); generate.compile_cache_stats()
+        # carries the process-wide hit/miss view.
+        self._buckets_used: set = set()
+        self.prefills = 0
+        self.decode_steps = 0
+        self._step_fn = lookup_program(_compiled_step, self.model)
+
+    def _zero_cache(self):
+        """A zeroed [num_slots, max_len, ...] cache pytree, shaped via
+        eval_shape (no device work, no params flops)."""
+        tok = jnp.zeros((self.num_slots, 1), jnp.int32)
+        pos = jnp.zeros((self.num_slots, 1), jnp.int32)
+        shapes = jax.eval_shape(
+            lambda p, t, q: self.model.apply(
+                {"params": p}, t, decode=True, positions=q,
+                mutable=["cache"])[1]["cache"],
+            self.params, tok, pos)
+        return jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+    @property
+    def prefill_compiles(self) -> int:
+        """Distinct prefill programs invoked (one per bucket used)."""
+        return len(self._buckets_used)
+
+    def free_slots(self):
+        return [s for s in range(self.num_slots) if not self.active[s]]
+
+    def occupancy(self) -> float:
+        return float(self.active.sum()) / self.num_slots
+
+    def fits(self, prompt_len: int, max_new_tokens: int) -> bool:
+        """Would this request's full trajectory fit the cache?"""
+        return (prompt_len <= max(self.buckets)
+                and prompt_len + max_new_tokens <= self.max_len)
+
+    def prefill(self, prompt: np.ndarray, slot: int) -> int:
+        """Admit a request into ``slot``: bucketed prefill, row insert,
+        greedy first token. Returns the first generated token."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        plen = len(prompt)
+        if plen < 1:
+            raise ValueError("empty prompt")
+        if self.active[slot]:
+            raise ValueError(f"slot {slot} is occupied")
+        bucket = pick_bucket(plen, self.buckets)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :plen] = prompt
+        fn = lookup_program(_compiled_prefill, self.model, bucket)
+        self._buckets_used.add(bucket)
+        row, first = fn(self.params, jnp.asarray(padded),
+                        jnp.asarray(plen, jnp.int32))
+        self.cache = _insert_row(self.cache, row,
+                                 jnp.asarray(slot, jnp.int32))
+        first_tok = int(jax.device_get(first)[0])
+        self.tok[slot] = first_tok
+        self.pos[slot] = plen
+        self.active[slot] = True
+        self.prefills += 1
+        return first_tok
+
+    def step(self) -> np.ndarray:
+        """One decode step over every slot; returns the [num_slots]
+        next-token array (entries for inactive slots are garbage — the
+        scheduler only reads active ones)."""
+        if (self.pos[self.active] >= self.max_len).any():
+            raise RuntimeError(
+                "an active slot is at max_len — the scheduler admitted "
+                "a request that cannot fit (fits() is the guard)")
+        self.cache, nxt = self._step_fn(
+            self.params, self.cache, jnp.asarray(self.tok),
+            jnp.asarray(self.pos))
+        nxt = np.asarray(jax.device_get(nxt))
+        act = self.active
+        self.tok[act] = nxt[act]
+        self.pos[act] += 1
+        self.decode_steps += 1
+        return nxt
+
+    def free(self, slot: int) -> None:
+        """Release a slot (host bookkeeping only; the row's stale cache
+        is replaced wholesale by the next insert)."""
+        self.active[slot] = False
+        self.tok[slot] = 0
+        self.pos[slot] = 0

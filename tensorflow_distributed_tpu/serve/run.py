@@ -1,0 +1,197 @@
+"""``mode=serve`` driver: build/restore a causal LM, run a request
+workload through the continuous-batching engine, report.
+
+Workloads: ``--serve.requests file.jsonl`` (one JSON object per line:
+``{"prompt": [ids...], "max_new_tokens": 32, "eos_id": 5,
+"arrival_s": 0.25}`` — ``prompt`` may be a ``"text"`` string instead
+when ``--dataset text`` supplies a tokenizer) or, with no file, a
+synthetic open-loop workload: ``--serve.num-requests`` random prompts
+with mixed lengths in [``--serve.prompt-len-min``,
+``--serve.prompt-len-max``], arriving at ``--serve.arrival-rate``
+req/s (0 = all queued at t=0).
+
+``--checkpoint-dir`` restores trained weights (EMA preferred, like
+mode=eval/generate); without one the model serves FRESH-INIT params —
+a load-testing/benchmarking mode, clearly labeled in the output.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List
+
+import numpy as np
+
+from tensorflow_distributed_tpu.config import TrainConfig
+from tensorflow_distributed_tpu.serve.buckets import (
+    default_buckets, parse_buckets)
+from tensorflow_distributed_tpu.serve.engine import SlotDecodeEngine
+from tensorflow_distributed_tpu.serve.scheduler import Request, Scheduler
+
+
+def _workload(cfg: TrainConfig, vocab_size: int,
+              encode=None) -> List[Request]:
+    serve = cfg.serve
+    if serve.requests:
+        reqs = []
+        with open(serve.requests) as f:
+            for i, line in enumerate(f):
+                line = line.strip()
+                if not line:
+                    continue
+                obj = json.loads(line)
+                if "text" in obj:
+                    if encode is None:
+                        raise ValueError(
+                            f"{serve.requests}:{i + 1}: string prompts "
+                            f"need --dataset text (its tokenizer "
+                            f"defines the vocabulary)")
+                    ids = encode(obj["text"])
+                else:
+                    ids = [int(t) for t in obj["prompt"]]
+                if not ids:
+                    raise ValueError(
+                        f"{serve.requests}:{i + 1}: empty prompt")
+                # Id bounds are checked against the BUILT model's
+                # vocab in serve_run (like generate_only): with
+                # synthetic_vocab unset the family default (e.g.
+                # 50257 for gpt_lm small) is the real bound.
+                reqs.append(Request(
+                    rid=len(reqs), prompt=np.asarray(ids, np.int32),
+                    max_new_tokens=int(obj.get("max_new_tokens",
+                                               serve.max_new_tokens)),
+                    eos_id=int(obj.get("eos_id", serve.eos_id)),
+                    arrival_s=float(obj.get("arrival_s", 0.0))))
+        if not reqs:
+            raise ValueError(f"{serve.requests} names no requests")
+        return reqs
+    # Synthetic open-loop workload: mixed lengths, deterministic by
+    # seed, uniformly spaced arrivals at the configured rate.
+    rng = np.random.default_rng(cfg.seed)
+    reqs = []
+    for i in range(serve.num_requests):
+        plen = int(rng.integers(serve.prompt_len_min,
+                                serve.prompt_len_max + 1))
+        prompt = rng.integers(0, vocab_size, size=plen).astype(np.int32)
+        arrival = (i / serve.arrival_rate if serve.arrival_rate > 0
+                   else 0.0)
+        reqs.append(Request(rid=i, prompt=prompt,
+                            max_new_tokens=serve.max_new_tokens,
+                            eos_id=serve.eos_id, arrival_s=arrival))
+    return reqs
+
+
+def serve_run(cfg: TrainConfig) -> Dict:
+    """Run the serve workload; returns the summary dict (per-request
+    records ride the observe JSONL)."""
+    cfg.validate()
+    from tensorflow_distributed_tpu.observe.registry import (
+        JsonlSink, MetricsRegistry, host_tags)
+    from tensorflow_distributed_tpu.parallel.mesh import (
+        bootstrap, is_chief, make_mesh)
+    from tensorflow_distributed_tpu.train import checkpoint as ckpt
+    from tensorflow_distributed_tpu.train.loop import (
+        _build_model_and_state, _GenTask)
+
+    bootstrap()
+    mesh = make_mesh(cfg.mesh)
+
+    encode = None
+    if cfg.dataset == "text":
+        from tensorflow_distributed_tpu.data.lm import text_codec
+        encode, _, vocab = text_codec(cfg.data_dir, cfg.text_tokenizer,
+                                      cfg.bpe_vocab_size)
+    else:
+        vocab = cfg.synthetic_vocab or 64
+    requests = _workload(cfg, vocab, encode)
+
+    max_prompt = max(len(r.prompt) for r in requests)
+    # Per-request trajectory bound (what actually has to fit the
+    # cache); bucket padding is prefill-only slack and is clamped to
+    # the cache length by the ladder cap below.
+    need = max(len(r.prompt) + r.max_new_tokens for r in requests)
+    if cfg.seq_len and need > cfg.seq_len:
+        raise ValueError(
+            f"--seq-len {cfg.seq_len} cannot hold the workload: the "
+            f"longest request (prompt + new tokens) needs a "
+            f"{need}-token cache")
+    if not cfg.seq_len:
+        # Size the cache to the workload (fresh-init serving). A
+        # checkpointed model's max_len is pinned by training — set
+        # --seq-len to the trained length explicitly.
+        cfg = dataclasses.replace(cfg, seq_len=max(need, 32))
+    buckets = (parse_buckets(cfg.serve.buckets) if cfg.serve.buckets
+               else default_buckets(max_prompt, cap=cfg.seq_len))
+
+    shim = _GenTask(vocab_size=vocab, sample_input=np.zeros(
+        (max(2, dict(mesh.shape).get("data", 1)), cfg.seq_len),
+        np.int32))
+    model, state = _build_model_and_state(cfg, mesh, shim)
+    if cfg.dataset != "text":
+        # The embedding gather would silently CLAMP out-of-range ids —
+        # bound-check against the BUILT model's vocabulary (the family
+        # default when synthetic_vocab is unset), like generate_only.
+        for r in requests:
+            bad = [int(t) for t in r.prompt
+                   if not 0 <= t < model.cfg.vocab_size]
+            if bad:
+                raise ValueError(
+                    f"request {r.rid}: prompt ids {bad} outside the "
+                    f"model vocabulary [0, {model.cfg.vocab_size})")
+    restored = False
+    if cfg.checkpoint_dir:
+        # Same restore semantics as mode=generate: local-SGD
+        # checkpoints persist the replica stack — average it into the
+        # plain template (train/loop.py::generate_only).
+        if cfg.param_sync_every > 1:
+            state = ckpt.restore_averaged(cfg.checkpoint_dir, state)
+        else:
+            state = ckpt.restore(cfg.checkpoint_dir, state)
+        restored = True
+    params = state.params if state.ema is None else state.ema
+
+    sinks = []
+    if cfg.observe.metrics_jsonl:
+        sinks.append(JsonlSink(cfg.observe.metrics_jsonl))
+    registry = MetricsRegistry(sinks=sinks, enabled=is_chief(),
+                               tags=host_tags(mesh, cfg),
+                               max_records=cfg.observe.max_records)
+    on_token = None
+    if cfg.serve.stream and is_chief():
+        def on_token(rid: int, tok: int, done: bool) -> None:
+            print(f"[serve] rid={rid} tok={tok}"
+                  + (" <done>" if done else ""), flush=True)
+
+    engine = SlotDecodeEngine(model, params, cfg.serve.num_slots,
+                              buckets=buckets)
+    sched = Scheduler(engine, decode_priority=cfg.serve.decode_priority,
+                      registry=registry, on_token=on_token)
+    try:
+        done = sched.run(requests)
+    finally:
+        registry.close()
+    summary = dict(sched.summary)
+    ttfts = np.asarray([c.ttft_s for c in done])
+    summary["ttft_ms_p50"] = round(1e3 * float(np.percentile(ttfts, 50)), 3)
+    summary["ttft_ms_p95"] = round(1e3 * float(np.percentile(ttfts, 95)), 3)
+    summary["tok_ms_mean"] = round(
+        float(np.mean([c.tok_ms for c in done])), 4)
+    summary["params"] = "checkpoint" if restored else "fresh-init"
+    if is_chief():
+        print(f"[serve] {summary['requests']} requests, "
+              f"{summary['total_new_tokens']} tokens in "
+              f"{summary['wall_s']}s — "
+              f"{summary['tokens_per_sec']} tok/s, occupancy "
+              f"{summary['mean_slot_occupancy']}, ttft p50 "
+              f"{summary['ttft_ms_p50']}ms / p95 "
+              f"{summary['ttft_ms_p95']}ms, "
+              f"{summary['prefill_compiles']} prefill programs "
+              f"(buckets {summary['buckets']}), "
+              f"{summary['params']} params", flush=True)
+        if cfg.observe.metrics_jsonl:
+            print(f"[observe] serve metrics: "
+                  f"{cfg.observe.metrics_jsonl} (summarize: python -m "
+                  f"tensorflow_distributed_tpu.observe.report "
+                  f"{cfg.observe.metrics_jsonl})", flush=True)
+    return summary

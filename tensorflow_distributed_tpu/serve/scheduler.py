@@ -1,0 +1,213 @@
+"""FIFO admission + prefill/decode interleaving for the slot engine.
+
+Policy: **decode-priority with a starvation bound**. Decoding a full
+batch is the throughput-optimal steady state, so the scheduler keeps
+stepping while requests wait — but a queued request with a free slot
+is admitted after at most ``decode_priority`` decode steps (the
+starvation clock only ticks while BOTH hold: someone is waiting and a
+slot is free — capacity waits don't count against the policy). An
+idle engine admits immediately.
+
+Termination is per request (EOS or its max-token budget), tokens
+stream to the host as they retire (``on_token``), and every request's
+lifecycle lands in the observe registry: ``serve_request`` records
+(TTFT, per-token latency, queue steps) plus one final
+``serve_summary`` (aggregate tokens/s, mean slot occupancy) —
+summarized by ``observe.report`` next to the training numbers.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import time
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from tensorflow_distributed_tpu.serve.engine import SlotDecodeEngine
+
+
+@dataclasses.dataclass
+class Request:
+    """One inference request. ``arrival_s`` is the open-loop offset
+    (seconds from run start) at which the request becomes visible to
+    the scheduler; 0 = present from the start."""
+
+    rid: int
+    prompt: np.ndarray
+    max_new_tokens: int
+    eos_id: int = -1          # -1 = run to the full budget
+    arrival_s: float = 0.0
+
+
+@dataclasses.dataclass
+class Completion:
+    """A finished request with its serving metrics."""
+
+    rid: int
+    prompt_len: int
+    tokens: List[int]
+    finish: str               # "eos" | "length"
+    ttft_s: float             # arrival -> first token (queue + prefill)
+    decode_s: float           # first token -> last token
+    queue_steps: int          # decode steps endured while admittable
+
+    @property
+    def tok_ms(self) -> float:
+        """Mean inter-token latency (ms) over the decode phase."""
+        return 1e3 * self.decode_s / max(1, len(self.tokens) - 1)
+
+
+@dataclasses.dataclass
+class _Live:
+    req: Request
+    slot: int
+    tokens: List[int]
+    t_first: float
+    queue_steps: int
+
+
+class Scheduler:
+    """Drives a :class:`SlotDecodeEngine` over a request workload."""
+
+    def __init__(self, engine: SlotDecodeEngine, decode_priority: int = 8,
+                 registry=None,
+                 on_token: Optional[Callable[[int, int, bool], None]] = None,
+                 clock=time.perf_counter):
+        if decode_priority < 1:
+            raise ValueError(
+                f"decode_priority must be >= 1, got {decode_priority}")
+        self.engine = engine
+        self.decode_priority = decode_priority
+        self.registry = registry
+        self.on_token = on_token
+        self.clock = clock
+
+    def _emit(self, event: str, **fields) -> None:
+        if self.registry is not None:
+            self.registry.emit(event, **fields)
+
+    def run(self, requests: Sequence[Request]) -> List[Completion]:
+        """Serve every request to completion; returns completions in
+        finish order (sort by ``rid`` for submission order)."""
+        eng = self.engine
+        for r in requests:
+            if not eng.fits(len(r.prompt), r.max_new_tokens):
+                raise ValueError(
+                    f"request {r.rid}: prompt {len(r.prompt)} + "
+                    f"{r.max_new_tokens} new tokens does not fit "
+                    f"(buckets up to {max(eng.buckets)}, max_len "
+                    f"{eng.max_len})")
+            if r.max_new_tokens < 1:
+                raise ValueError(
+                    f"request {r.rid}: max_new_tokens must be >= 1")
+        pending = collections.deque(
+            sorted(requests, key=lambda r: (r.arrival_s, r.rid)))
+        queue: collections.deque = collections.deque()
+        live: dict = {}                       # slot -> _Live
+        done: List[Completion] = []
+        t0 = self.clock()
+        steps_since_admit = 0
+        occupancy_sum = 0.0
+        run_steps = 0  # THIS run's decode steps (the engine counter
+        #                spans its whole lifetime — reuse would skew
+        #                the occupancy mean)
+
+        def now() -> float:
+            return self.clock() - t0
+
+        def finish(lv: _Live, why: str) -> None:
+            t = now()
+            eng.free(lv.slot)
+            del live[lv.slot]
+            comp = Completion(
+                rid=lv.req.rid, prompt_len=len(lv.req.prompt),
+                tokens=lv.tokens, finish=why,
+                ttft_s=lv.t_first - lv.req.arrival_s,
+                decode_s=t - lv.t_first, queue_steps=lv.queue_steps)
+            done.append(comp)
+            self._emit("serve_request", rid=comp.rid,
+                       prompt_len=comp.prompt_len,
+                       new_tokens=len(comp.tokens), finish=why,
+                       ttft_ms=round(1e3 * comp.ttft_s, 3),
+                       tok_ms=round(comp.tok_ms, 4),
+                       queue_steps=comp.queue_steps)
+            if self.on_token is not None:
+                self.on_token(comp.rid, comp.tokens[-1], True)
+
+        def admit() -> None:
+            req = queue.popleft()
+            slot = eng.free_slots()[0]
+            first = eng.prefill(req.prompt, slot)
+            lv = _Live(req=req, slot=slot, tokens=[first],
+                       t_first=now(), queue_steps=req._waited)
+            live[slot] = lv
+            if self.on_token is not None and not (
+                    first == req.eos_id or req.max_new_tokens == 1):
+                self.on_token(req.rid, first, False)
+            if first == req.eos_id:
+                finish(lv, "eos")
+            elif req.max_new_tokens == 1:
+                finish(lv, "length")
+
+        while pending or queue or live:
+            # Open-loop arrivals: everything whose time has come.
+            while pending and pending[0].arrival_s <= now():
+                req = pending.popleft()
+                req._waited = 0
+                queue.append(req)
+            if queue and eng.free_slots() and (
+                    not live or steps_since_admit
+                    >= self.decode_priority):
+                admit()
+                steps_since_admit = 0
+                continue
+            if not live:
+                if pending:
+                    # Nothing to decode, nothing admittable: sleep to
+                    # the next arrival instead of spinning.
+                    time.sleep(max(0.0, pending[0].arrival_s - now()))
+                    continue
+                break  # queue must be empty too (free slots exist)
+            nxt = eng.step()
+            occupancy_sum += eng.occupancy()
+            run_steps += 1
+            if queue and eng.free_slots():
+                # The starvation clock: a decode step taken WHILE the
+                # head-of-queue request waited with a free slot
+                # available. The bound the policy guarantees (and
+                # tests/test_serve.py pins) is head-of-line: admission
+                # within decode_priority such steps.
+                steps_since_admit += 1
+                queue[0]._waited += 1
+            for slot in list(live):
+                lv = live[slot]
+                tok = int(nxt[slot])
+                lv.tokens.append(tok)
+                if tok == lv.req.eos_id:
+                    finish(lv, "eos")
+                elif len(lv.tokens) >= lv.req.max_new_tokens:
+                    finish(lv, "length")
+                elif self.on_token is not None:
+                    self.on_token(lv.req.rid, tok, False)
+
+        wall = now()
+        total_new = sum(len(c.tokens) for c in done)
+        summary = {
+            "requests": len(done),
+            "total_new_tokens": total_new,
+            "wall_s": round(wall, 4),
+            "tokens_per_sec": round(total_new / max(wall, 1e-9), 2),
+            "mean_slot_occupancy": round(
+                occupancy_sum / max(1, run_steps), 4),
+            "decode_steps": run_steps,
+            "prefills": eng.prefills,
+            "prefill_compiles": eng.prefill_compiles,
+            "buckets": ",".join(str(b) for b in eng.buckets),
+            "num_slots": eng.num_slots,
+            "decode_priority": self.decode_priority,
+        }
+        self._emit("serve_summary", **summary)
+        self.summary = summary
+        return done

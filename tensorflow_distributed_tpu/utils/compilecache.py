@@ -19,10 +19,19 @@ _DEFAULT_DIR = os.environ.get(
 
 
 def enable_persistent_cache(path: str | None = None) -> str:
-    """Idempotently turn on the JAX persistent compilation cache."""
+    """Idempotently turn on the JAX persistent compilation cache.
+
+    Precedence: an explicit ``path`` argument wins; otherwise a
+    user-set ``jax_compilation_cache_dir`` (via jax.config or the
+    ``JAX_COMPILATION_CACHE_DIR`` env var) is RESPECTED rather than
+    silently overridden; only with neither does the repo-local default
+    apply. Returns the effective cache directory either way."""
     import jax
 
-    path = path or _DEFAULT_DIR
+    if path is None:
+        path = (getattr(jax.config, "jax_compilation_cache_dir", None)
+                or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+                or _DEFAULT_DIR)
     os.makedirs(path, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", path)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
