@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# graftcheck gate: the AST lint over the whole package, then the jaxpr
+# collective/upcast census against the committed goldens. Nonzero exit
+# on any finding or drift. Invoked from scripts/t1.sh ahead of the
+# pytest tier (fast: the lint is pure stdlib, the census only traces —
+# no XLA compiles).
+#
+# Usage: scripts/lint.sh            (from anywhere)
+#
+# On a red:
+#   - lint finding: fix it, or suppress the statement with
+#     '# graftcheck: disable=<rule> -- <reason>' (rule catalog:
+#     python -m tensorflow_distributed_tpu.analysis.lint --list-rules)
+#   - census drift: if the collective/upcast change is intentional,
+#     regenerate and commit the goldens:
+#     python -m tensorflow_distributed_tpu.analysis.jaxprcheck --update
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+rc=0
+
+python -m tensorflow_distributed_tpu.analysis.lint \
+  tensorflow_distributed_tpu/ || rc=$?
+
+env JAX_PLATFORMS=cpu python -m tensorflow_distributed_tpu.analysis.jaxprcheck \
+  || rc=$?
+
+exit "$rc"

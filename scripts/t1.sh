@@ -15,6 +15,14 @@ set -o pipefail
 LOG="${T1_LOG:-/tmp/_t1.log}"
 cd "$(dirname "$0")/.."
 
+# graftcheck first (scripts/lint.sh: AST lint + jaxpr census vs
+# goldens): cheap, deterministic, and a finding there is actionable
+# without reading 400s of pytest output. The suite still runs either
+# way so tier-1 numbers keep flowing; a lint red is carried into the
+# final exit code below.
+lint_rc=0
+scripts/lint.sh || lint_rc=$?
+
 run_suite() {
   rm -f "$LOG"
   timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
@@ -41,4 +49,9 @@ if ! has_summary_line; then
 fi
 
 echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | wc -c)"
+if [ "$rc" -eq 0 ] && [ "$lint_rc" -ne 0 ]; then
+  echo "[t1] suite green but graftcheck red (lint_rc=$lint_rc) — see" \
+       "scripts/lint.sh output above" >&2
+  exit "$lint_rc"
+fi
 exit "$rc"

@@ -1,0 +1,28 @@
+"""graftcheck: the repo's self-hosting static-analysis toolchain.
+
+Three layers, one contract — the classic pjit/shard_map footguns that
+compile fine and only surface as perf cliffs or corruption at scale
+must be caught in CI, not on TPU time:
+
+- ``analysis.lint`` — a pure-Python (jax-free) AST lint engine with
+  rules for hidden host↔device syncs in hot paths, PRNGKey reuse,
+  jit-under-loop recompilation, use-after-donation, and Python side
+  effects under trace. Runnable as
+  ``python -m tensorflow_distributed_tpu.analysis.lint [paths]``;
+  findings are suppressed inline with
+  ``# graftcheck: disable=<rule> -- <reason>``.
+- ``analysis.jaxprcheck`` — trace-level contract pass: the LM/MoE/
+  pipelined train steps and the serve decode step are traced with
+  ``jax.make_jaxpr`` and their collective counts (psum/all_gather/
+  ppermute/...) and float-upcast counts (``convert_element_type``
+  widening, e.g. a silent bf16→f32 in a bf16 path) are pinned against
+  committed golden budgets (``analysis/goldens/census.json``).
+- ``analysis.runtime`` — the ``--check`` runtime mode: a
+  ``jax.transfer_guard`` around the hot loops plus a sharding-contract
+  assertion (declared shardings vs actual leaf shardings after the
+  first step) wired into ``train/loop.py`` and ``serve/engine.py``.
+
+The toolchain is self-hosting: tier-1 lints the whole package, so a
+finding in repo code must be fixed or explicitly suppressed with a
+reason.
+"""

@@ -1,0 +1,330 @@
+"""Trace-level contract pass: collective & upcast census vs goldens.
+
+The lint layer (analysis/lint.py) reads SOURCE; this layer reads the
+PROGRAM. Each audited program — the LM / MoE / pipelined train steps
+and the serve decode step — is traced with ``jax.make_jaxpr``
+(precedent: parallel/pipeline.py's variant_residual_mask) and reduced
+to a census of the two quantities that silently drift:
+
+- **collectives**: psum / all_gather / ppermute / all_to_all /
+  reduce_scatter equation counts, sub-jaxprs included. A PR that
+  accidentally adds an all-gather to the decode step, or doubles the
+  pipeline's ppermutes, changes a number here and fails loudly —
+  instead of showing up as an ICI regression three sessions later.
+- **upcasts**: ``convert_element_type`` equations widening a float
+  (bfloat16→float32, float32→float64). bf16 paths legitimately upcast
+  in a few places (loss accumulation, norm statistics, optimizer
+  math); the census pins HOW MANY, so a silently-f32 matmul chain
+  shows up as a count jump.
+
+Budgets live in ``analysis/goldens/census.json`` (committed).
+Regenerate after an INTENTIONAL change with::
+
+    python -m tensorflow_distributed_tpu.analysis.jaxprcheck --update
+
+and review the diff like any other golden. Plain runs compare and exit
+nonzero on drift (wired into scripts/lint.sh → scripts/t1.sh; the
+same comparison is a test in tests/test_analysis.py).
+
+Census counts are pinned against THIS container's jax; a jax upgrade
+that re-lowers a primitive is a legitimate regeneration, and the diff
+shows exactly what moved.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, Iterator, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _force_cpu_topology() -> None:
+    """The 8-device virtual CPU setup, exactly like tests/conftest:
+    flags must land before the backend is first USED (this
+    environment's sitecustomize imports jax at interpreter start, so
+    "before jax import" is not an option — what matters is that no
+    backend exists yet). Called from main() ONLY: importing this
+    module as a library must not re-platform the process (a TPU tool
+    reusing census_of/iter_eqns keeps its devices). Under pytest,
+    conftest already applied the same values; re-applying is a no-op.
+    """
+    if "--xla_force_host_platform_device_count" not in os.environ.get(
+            "XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8").strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass  # backend already initialized: use what the caller chose
+
+GOLDEN_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "goldens", "census.json")
+
+COLLECTIVE_PREFIXES = (
+    "psum", "all_gather", "ppermute", "pmin", "pmax",
+    "all_to_all", "reduce_scatter", "pgather",
+)
+
+
+# --- jaxpr walking -----------------------------------------------------
+
+def _jaxprs_in(value) -> Iterator:
+    """Yield any (Closed)Jaxpr reachable from an eqn param value."""
+    if hasattr(value, "jaxpr") and hasattr(value, "consts"):
+        yield value.jaxpr          # ClosedJaxpr
+    elif hasattr(value, "eqns"):
+        yield value                # Jaxpr
+    elif isinstance(value, (tuple, list)):
+        for v in value:
+            yield from _jaxprs_in(v)
+
+
+def iter_eqns(jaxpr) -> Iterator:
+    """Every equation, sub-jaxprs (pjit / scan / cond / shard_map /
+    remat / custom_vjp bodies) included."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            for sub in _jaxprs_in(v):
+                yield from iter_eqns(sub)
+
+
+def census_of(closed_jaxpr) -> Dict[str, Dict[str, int]]:
+    """{"collectives": {prim: n}, "upcasts": {"bfloat16->float32": n}}"""
+    collectives: Dict[str, int] = {}
+    upcasts: Dict[str, int] = {}
+    for eqn in iter_eqns(closed_jaxpr.jaxpr):
+        name = eqn.primitive.name
+        if name.startswith(COLLECTIVE_PREFIXES):
+            collectives[name] = collectives.get(name, 0) + 1
+        elif name == "convert_element_type":
+            old = np.dtype(eqn.invars[0].aval.dtype)
+            new = np.dtype(eqn.params["new_dtype"])
+            if (jnp.issubdtype(old, jnp.floating)
+                    and jnp.issubdtype(new, jnp.floating)
+                    and new.itemsize > old.itemsize):
+                key = f"{old.name}->{new.name}"
+                upcasts[key] = upcasts.get(key, 0) + 1
+    return {"collectives": dict(sorted(collectives.items())),
+            "upcasts": dict(sorted(upcasts.items()))}
+
+
+# --- the audited programs ----------------------------------------------
+
+_B, _L, _V = 4, 16, 64  # toy shapes; the census tracks structure, not size
+
+
+def _clm_batch():
+    from tensorflow_distributed_tpu.data.lm import synthetic_clm
+    ds = synthetic_clm(n=max(2 * _B, 32), seq_len=_L, vocab_size=_V)
+    return ds.batch(np.arange(_B))
+
+
+def _mesh(data: int = 1, pipe: int = 1):
+    from tensorflow_distributed_tpu.config import MeshConfig
+    from tensorflow_distributed_tpu.parallel.mesh import make_mesh
+    need = data * pipe
+    devs = jax.devices()[:need]
+    if len(devs) < need:
+        raise RuntimeError(
+            f"census needs {need} devices, have {len(devs)} — run via "
+            f"the CLI (it forces an 8-device CPU topology) or under "
+            f"tests/conftest.py")
+    return make_mesh(MeshConfig(data=data, pipe=pipe), devs)
+
+
+def _train_jaxpr(model_name: str):
+    """The REAL jitted LM train step (same builders as train/loop.py),
+    traced: bf16 compute so the upcast census watches the path that
+    matters, dropout 0 so the trace is rng-schedule-free."""
+    import optax
+
+    from tensorflow_distributed_tpu.models import transformer
+    from tensorflow_distributed_tpu.train.state import create_train_state
+    from tensorflow_distributed_tpu.train.step import make_train_step
+    from tensorflow_distributed_tpu.train.tasks import (
+        make_mlm_loss, make_moe_loss, mlm_batch_shardings)
+
+    mesh = _mesh()
+    factory = (transformer.moe_lm if model_name == "moe_lm"
+               else transformer.gpt_lm)
+    model = factory(mesh=mesh, size="tiny", dropout_rate=0.0,
+                    compute_dtype=jnp.bfloat16)
+    state = create_train_state(model, optax.adam(1e-3),
+                               np.zeros((2, _L), np.int32), mesh, seed=0)
+    loss = (make_moe_loss() if model_name == "moe_lm"
+            else make_mlm_loss())
+    step = make_train_step(mesh, loss=loss,
+                           batch_shardings=mlm_batch_shardings(mesh))
+    return jax.make_jaxpr(step)(state, _clm_batch())
+
+
+def _pipelined_jaxpr():
+    """The 1F1B pipelined step on a pipe=2 mesh — the program whose
+    ppermute schedule the census exists to pin."""
+    import optax
+
+    from tensorflow_distributed_tpu.models.pipelined import pipelined_lm
+    from tensorflow_distributed_tpu.train.pipeline_step import (
+        make_1f1b_train_step)
+    from tensorflow_distributed_tpu.train.state import create_train_state
+
+    mesh = _mesh(data=1, pipe=2)
+    model = pipelined_lm(mesh, num_microbatches=2, dropout_rate=0.0,
+                         compute_dtype=jnp.bfloat16, n_layers=2,
+                         max_len=_L)
+    state = create_train_state(model, optax.adam(1e-3),
+                               np.zeros((2, _L), np.int32), mesh)
+    step = make_1f1b_train_step(model, mesh)
+    return jax.make_jaxpr(step)(state, _clm_batch())
+
+
+def _serve_decode_jaxpr():
+    """THE decode program serve/engine.py dispatches every step: one
+    greedy token for every slot at its own depth."""
+    from tensorflow_distributed_tpu.models.generate import decode_token
+    from tensorflow_distributed_tpu.models.transformer import (
+        CausalLM, tiny_config)
+
+    num_slots = 4
+    model = CausalLM(tiny_config(causal=True,
+                                 compute_dtype=jnp.bfloat16))
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    tok = jnp.zeros((num_slots, 1), jnp.int32)
+    pos = jnp.zeros((num_slots, 1), jnp.int32)
+    shapes = jax.eval_shape(
+        lambda p, t, q: model.apply({"params": p}, t, decode=True,
+                                    positions=q,
+                                    mutable=["cache"])[1]["cache"],
+        params, tok, pos)
+    cache = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+    def run(params, cache, tok, pos):
+        last, cache = decode_token(model, params, cache, tok, pos)
+        return cache, jnp.argmax(last, axis=-1).astype(jnp.int32)
+
+    return jax.make_jaxpr(run)(params, cache,
+                               jnp.zeros((num_slots,), jnp.int32),
+                               jnp.zeros((num_slots,), jnp.int32))
+
+
+PROGRAMS = {
+    "gpt_train": lambda: _train_jaxpr("gpt_lm"),
+    "moe_train": lambda: _train_jaxpr("moe_lm"),
+    "pipelined_train": _pipelined_jaxpr,
+    "serve_decode": _serve_decode_jaxpr,
+}
+
+
+def census(programs: Optional[Sequence[str]] = None
+           ) -> Dict[str, Dict[str, Dict[str, int]]]:
+    """Trace the named programs (default: all) and return their
+    censuses, keyed like the golden file."""
+    names = list(programs) if programs else sorted(PROGRAMS)
+    out = {}
+    for name in names:
+        out[name] = census_of(PROGRAMS[name]())
+    return out
+
+
+def load_golden() -> Dict:
+    with open(GOLDEN_PATH) as f:
+        return json.load(f)
+
+
+def diff_censuses(golden: Dict, current: Dict,
+                  required: Optional[Sequence[str]] = None) -> list:
+    """Human-readable drift lines; empty when within budget.
+
+    ``required`` names the programs this run was asked to trace
+    (None = a full run, which must cover every golden entry): a
+    golden program missing from a FULL run is drift — a deleted or
+    renamed PROGRAMS entry must not silently disarm its budget.
+    """
+    lines = []
+    req = set(golden) if required is None else set(required)
+    for prog in sorted(set(golden) | set(current)):
+        if prog not in golden:
+            lines.append(f"{prog}: not in golden (new program? run "
+                         f"--update)")
+            continue
+        if prog not in current:
+            if prog in req:
+                lines.append(
+                    f"{prog}: in the golden but missing from the run "
+                    f"(deleted/renamed in PROGRAMS? its budget is no "
+                    f"longer checked)")
+            continue  # partial run: only compare what was traced
+        for section in ("collectives", "upcasts"):
+            g = golden[prog].get(section, {})
+            c = current[prog].get(section, {})
+            for key in sorted(set(g) | set(c)):
+                gv, cv = g.get(key, 0), c.get(key, 0)
+                if gv != cv:
+                    lines.append(
+                        f"{prog}: {section}[{key}] {gv} -> {cv}")
+    return lines
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tensorflow_distributed_tpu.analysis.jaxprcheck",
+        description="collective/upcast census of the audited programs "
+                    "vs the committed golden budgets")
+    parser.add_argument("--update", action="store_true",
+                        help="rewrite the golden file with the current "
+                             "census (review the diff!)")
+    parser.add_argument("--programs", default="",
+                        help=f"comma-separated subset of "
+                             f"{sorted(PROGRAMS)}")
+    args = parser.parse_args(argv)
+    _force_cpu_topology()
+    names = ([n.strip() for n in args.programs.split(",") if n.strip()]
+             if args.programs else None)
+    unknown = set(names or ()) - set(PROGRAMS)
+    if unknown:
+        print(f"jaxprcheck: unknown programs {sorted(unknown)}; have "
+              f"{sorted(PROGRAMS)}", file=sys.stderr)
+        return 2
+    current = census(names)
+    for prog, c in current.items():
+        print(f"{prog}: collectives={c['collectives']} "
+              f"upcasts={c['upcasts']}")
+    if args.update:
+        if names:
+            merged = load_golden() if os.path.exists(GOLDEN_PATH) else {}
+            merged.update(current)
+            current = dict(sorted(merged.items()))
+        os.makedirs(os.path.dirname(GOLDEN_PATH), exist_ok=True)
+        with open(GOLDEN_PATH, "w") as f:
+            json.dump(current, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"jaxprcheck: wrote {GOLDEN_PATH}")
+        return 0
+    if not os.path.exists(GOLDEN_PATH):
+        print(f"jaxprcheck: no golden at {GOLDEN_PATH}; run with "
+              f"--update to create it", file=sys.stderr)
+        return 1
+    drift = diff_censuses(load_golden(), current, required=names)
+    if drift:
+        for line in drift:
+            print(f"jaxprcheck: DRIFT {line}", file=sys.stderr)
+        print("jaxprcheck: census drift — if intentional, regenerate "
+              "with --update and commit the diff", file=sys.stderr)
+        return 1
+    print("jaxprcheck: within budget")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

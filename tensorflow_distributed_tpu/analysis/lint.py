@@ -1,0 +1,95 @@
+"""graftcheck lint driver.
+
+Usage::
+
+    python -m tensorflow_distributed_tpu.analysis.lint [paths...]
+    python -m tensorflow_distributed_tpu.analysis.lint --list-rules
+
+Paths may be files or directories (recursed for ``*.py``); the default
+is the package itself — the self-hosting configuration tier-1 runs via
+``scripts/lint.sh``. Exit status: 0 clean, 1 findings, 2 usage/parse
+errors. Pure stdlib: linting must never require (or pay for) a jax
+import.
+
+Suppressions: ``# graftcheck: disable=<rule>[,<rule>] -- <reason>`` on
+the flagged statement's lines or the comment line directly above. The
+reason text is for the reviewer; write one.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Iterable, List, Optional, Sequence
+
+from tensorflow_distributed_tpu.analysis.rules import (
+    CATALOG, Finding, ModuleContext, check_module)
+
+PACKAGE_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def iter_py_files(paths: Iterable[str]) -> Iterable[str]:
+    for path in paths:
+        if os.path.isdir(path):
+            for dirpath, dirnames, filenames in os.walk(path):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if not d.startswith((".", "__pycache__")))
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        yield os.path.join(dirpath, fn)
+        else:
+            yield path
+
+
+def lint_source(source: str, path: str = "<string>") -> List[Finding]:
+    """Lint one module's source text (the unit-test entry point)."""
+    return check_module(ModuleContext(path, source))
+
+
+def lint_paths(paths: Sequence[str]) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in iter_py_files(paths):
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+        findings.extend(lint_source(source, path))
+    return findings
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tensorflow_distributed_tpu.analysis.lint",
+        description="graftcheck: static analysis for the TPU stack's "
+                    "jax footguns (host syncs, key reuse, jit-in-loop, "
+                    "use-after-donation, effects under trace)")
+    parser.add_argument("paths", nargs="*", default=None,
+                        help="files or directories (default: the "
+                             "package itself)")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalog and exit")
+    args = parser.parse_args(argv)
+    if args.list_rules:
+        width = max(len(name) for name in CATALOG)
+        for name, desc in sorted(CATALOG.items()):
+            print(f"{name:<{width}}  {desc}")
+        return 0
+    paths = args.paths or [PACKAGE_ROOT]
+    try:
+        findings = lint_paths(paths)
+    except (OSError, SyntaxError) as e:
+        print(f"graftcheck: {e}", file=sys.stderr)
+        return 2
+    for f in findings:
+        print(f.render())
+    if findings:
+        print(f"graftcheck: {len(findings)} finding"
+              f"{'s' if len(findings) != 1 else ''} "
+              f"(suppress intentional ones with "
+              f"'# graftcheck: disable=<rule> -- <reason>')",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,55 @@
+"""graftcheck rule registry.
+
+Each rule module exposes ``check(ctx: ModuleContext) -> Iterator[
+Finding]`` and one or more rule-name constants. Suppress a finding
+inline with ``# graftcheck: disable=<rule>[,<rule>] -- <reason>`` on
+the flagged statement (or the comment line directly above it).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterator, List
+
+from tensorflow_distributed_tpu.analysis.rules import (
+    donation, effects, hostsync, jitloop, prngreuse)
+from tensorflow_distributed_tpu.analysis.rules.common import (  # noqa: F401
+    Finding, ModuleContext)
+
+# name -> (one-line description, check function). Checks are shared
+# per module: hostsync's check emits both of its rule names.
+CATALOG: Dict[str, str] = {
+    hostsync.RULE_TRACE:
+        "device_get/.item()/float()/np.asarray inside a traced "
+        "function (trace-time error or silently frozen constant)",
+    hostsync.RULE_LOOP:
+        "hidden host-device sync in the inner train/decode loops "
+        "(blocks dispatch every step)",
+    prngreuse.RULE:
+        "PRNGKey consumed twice without split/fold_in (identical "
+        "randomness)",
+    jitloop.RULE:
+        "jax.jit/pjit constructed inside a loop (retrace + recompile "
+        "per iteration)",
+    donation.RULE:
+        "buffer read after donate_argnums handed it to XLA "
+        "(use-after-free on device)",
+    effects.RULE:
+        "print/time.time/... under trace (runs per compile, not per "
+        "step)",
+}
+
+CHECKS: List[Callable[[ModuleContext], Iterator[Finding]]] = [
+    hostsync.check,
+    prngreuse.check,
+    jitloop.check,
+    donation.check,
+    effects.check,
+]
+
+
+def check_module(ctx: ModuleContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for check in CHECKS:
+        findings.extend(check(ctx))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings

@@ -1,0 +1,393 @@
+"""Shared AST scaffolding for graftcheck rules.
+
+Pure stdlib — the lint tier must run without importing jax (it lints
+the code that imports jax; it must never pay, or require, a jax
+initialization itself). Everything here is deliberately syntactic and
+conservative: name resolution walks lexical scopes only, call graphs
+are intra-module, and unresolvable constructs are treated as "not
+proven hazardous" rather than guessed at — a linter that cries wolf
+gets suppressed wholesale and then catches nothing.
+
+Core concepts:
+
+- **traced context**: code that executes under a jax trace. A function
+  is traced when it is decorated with jit/pjit (bare or via partial),
+  syntactically passed to a tracing entry point (``jax.jit``,
+  ``shard_map``, ``lax.scan``, ``jax.grad``, ...), referenced from the
+  body of a traced function (intra-module call graph), or lexically
+  nested inside one.
+- **hot context**: host-side code inside the inner train/decode loops.
+  A node is hot when it sits lexically inside a ``for``/``while`` loop
+  of a hot module, or inside a function transitively referenced from
+  such a loop (``cadence``/``_inspect`` in train/loop.py are the
+  canonical cases: no loop of their own, called every step).
+- **suppressions**: ``# graftcheck: disable=<rule>[,<rule>...]``
+  anywhere on the flagged statement's lines or on the comment line
+  directly above it; ``-- reason`` text after the rule list is
+  encouraged and ignored by the parser.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+SUPPRESS_RE = re.compile(
+    r"#\s*graftcheck:\s*disable=([A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)")
+
+# Entry points whose function-valued arguments run under trace.
+TRACING_CALLS = frozenset({
+    "jax.jit", "jit", "jax.pjit", "pjit", "jax.make_jaxpr", "make_jaxpr",
+    "jax.eval_shape", "eval_shape",
+    "jax.shard_map", "shard_map", "jax.experimental.shard_map.shard_map",
+    "jax.lax.scan", "lax.scan", "jax.lax.cond", "lax.cond",
+    "jax.lax.while_loop", "lax.while_loop", "jax.lax.fori_loop",
+    "lax.fori_loop", "jax.lax.map", "lax.map", "jax.lax.associative_scan",
+    "jax.vmap", "vmap", "jax.pmap", "pmap",
+    "jax.grad", "jax.value_and_grad", "jax.vjp", "jax.jvp",
+    "jax.linearize", "jax.linear_transpose",
+    "jax.checkpoint", "jax.remat", "jax.custom_vjp", "jax.custom_jvp",
+})
+
+# Decorators that make the decorated function a traced root.
+JIT_DECORATORS = frozenset({"jax.jit", "jit", "jax.pjit", "pjit"})
+
+
+def qualname(node: ast.AST) -> str:
+    """Dotted name of an expression (``jax.lax.scan``), or "" when the
+    expression is not a plain attribute chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+@dataclasses.dataclass
+class Finding:
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}: {self.message}"
+
+
+class FuncInfo:
+    """One function/lambda definition with its lexical scope link."""
+
+    __slots__ = ("node", "name", "scope", "traced", "hot", "refs",
+                 "loop_refs")
+
+    def __init__(self, node: ast.AST, name: str,
+                 scope: Optional["FuncInfo"]):
+        self.node = node
+        self.name = name
+        self.scope = scope          # enclosing FuncInfo (None = module)
+        self.traced = False
+        self.hot = False
+        self.refs: Set[str] = set()       # names referenced in body
+        self.loop_refs: Set[str] = set()  # ... within loop subtrees
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return (f"FuncInfo({self.name!r}, traced={self.traced}, "
+                f"hot={self.hot})")
+
+
+def _own_body_walk(fn_node: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function's body WITHOUT descending into nested function
+    definitions or lambdas (those are their own FuncInfos)."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class ModuleContext:
+    """Parsed module + the analyses every rule shares."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self._parent: Dict[int, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self._parent[id(child)] = node
+        self.functions: List[FuncInfo] = []
+        self._fn_by_node: Dict[int, FuncInfo] = {}
+        self._collect_functions()
+        self._collect_refs()
+        self._mark_traced()
+        self.suppressions = self._collect_suppressions()
+
+    # --- structure -----------------------------------------------------
+
+    def _collect_functions(self) -> None:
+        def visit(node: ast.AST, scope: Optional[FuncInfo]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    fi = FuncInfo(child, child.name, scope)
+                    self.functions.append(fi)
+                    self._fn_by_node[id(child)] = fi
+                    visit(child, fi)
+                elif isinstance(child, ast.Lambda):
+                    fi = FuncInfo(child, "<lambda>", scope)
+                    self.functions.append(fi)
+                    self._fn_by_node[id(child)] = fi
+                    visit(child, fi)
+                elif isinstance(child, ast.ClassDef):
+                    # Methods resolve through the class to the
+                    # enclosing function/module scope (graftcheck has
+                    # no instance-attribute call graph).
+                    visit(child, scope)
+                else:
+                    visit(child, scope)
+
+        visit(self.tree, None)
+
+    def _collect_refs(self) -> None:
+        for fi in self.functions:
+            for node in _own_body_walk(fi.node):
+                if isinstance(node, ast.Name) and isinstance(
+                        node.ctx, ast.Load):
+                    fi.refs.add(node.id)
+                if isinstance(node, (ast.For, ast.While)):
+                    for sub in ast.walk(node):
+                        if (isinstance(sub, ast.Name)
+                                and isinstance(sub.ctx, ast.Load)):
+                            fi.loop_refs.add(sub.id)
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parent.get(id(node))
+
+    def func_of(self, node: ast.AST) -> Optional[FuncInfo]:
+        """The innermost function/lambda containing ``node``."""
+        cur = self.parent(node)
+        while cur is not None:
+            fi = self._fn_by_node.get(id(cur))
+            if fi is not None:
+                return fi
+            cur = self.parent(cur)
+        return None
+
+    def resolve(self, name: str, scope: Optional[FuncInfo]
+                ) -> Optional[FuncInfo]:
+        """Lexical-scope name lookup: functions defined in ``scope``,
+        then outward to module level. First match wins."""
+        while True:
+            for fi in self.functions:
+                if fi.name == name and fi.scope is scope:
+                    return fi
+            if scope is None:
+                return None
+            scope = scope.scope
+
+    # --- traced contexts -----------------------------------------------
+
+    def _decorator_is_jit(self, dec: ast.AST) -> bool:
+        if qualname(dec) in JIT_DECORATORS:
+            return True
+        if isinstance(dec, ast.Call):
+            fq = qualname(dec.func)
+            if fq in JIT_DECORATORS:
+                return True
+            if fq in ("partial", "functools.partial") and dec.args:
+                return qualname(dec.args[0]) in JIT_DECORATORS
+        return False
+
+    def _fn_args_of_call(self, call: ast.Call) -> Iterator[ast.AST]:
+        """Expressions in a tracing call that may denote the traced
+        function: positional/keyword args directly, and through one
+        ``partial(...)`` wrapper."""
+        args = list(call.args) + [kw.value for kw in call.keywords]
+        for a in args:
+            yield a
+            if isinstance(a, ast.Call) and qualname(a.func) in (
+                    "partial", "functools.partial"):
+                yield from a.args
+
+    def _mark_traced(self) -> None:
+        # Roots: jit decorators and arguments of tracing entry points.
+        for fi in self.functions:
+            node = fi.node
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if any(self._decorator_is_jit(d)
+                       for d in node.decorator_list):
+                    fi.traced = True
+        for node in ast.walk(self.tree):
+            if not (isinstance(node, ast.Call)
+                    and qualname(node.func) in TRACING_CALLS):
+                continue
+            caller = self.func_of(node)
+            for arg in self._fn_args_of_call(node):
+                if isinstance(arg, ast.Lambda):
+                    fi = self._fn_by_node.get(id(arg))
+                    if fi is not None:
+                        fi.traced = True
+                elif isinstance(arg, ast.Name):
+                    fi = self.resolve(arg.id, caller)
+                    if fi is not None:
+                        fi.traced = True
+        # Propagate: functions referenced from a traced body, and
+        # functions nested inside a traced function, are traced.
+        changed = True
+        while changed:
+            changed = False
+            for fi in self.functions:
+                if fi.traced:
+                    continue
+                if fi.scope is not None and fi.scope.traced:
+                    fi.traced = True
+                    changed = True
+                    continue
+                for other in self.functions:
+                    if other.traced and fi.name in other.refs \
+                            and self.resolve(fi.name, other) is fi:
+                        fi.traced = True
+                        changed = True
+                        break
+
+    def in_traced_context(self, node: ast.AST) -> bool:
+        fi = self.func_of(node)
+        while fi is not None:
+            if fi.traced:
+                return True
+            fi = fi.scope
+        return False
+
+    # --- hot contexts ---------------------------------------------------
+
+    def in_loop(self, node: ast.AST) -> bool:
+        """Is ``node`` lexically inside a for/while loop (stopping at
+        the enclosing function boundary)?"""
+        cur = self.parent(node)
+        while cur is not None:
+            if isinstance(cur, (ast.For, ast.While)):
+                return True
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return False
+            cur = self.parent(cur)
+        return False
+
+    def mark_hot(self) -> None:
+        """Flag functions transitively referenced from loop bodies
+        (the host-side per-step helpers of the train/decode loops),
+        plus every METHOD: the intra-module resolver tracks plain
+        names only, so ``self.engine.step()`` inside a scheduler loop
+        can't be followed — in a hot module, assume any method may be
+        a per-step entry point (the serve engine's are) rather than
+        silently exempting them."""
+        for fi in self.functions:
+            if isinstance(self.parent(fi.node), ast.ClassDef) \
+                    and not fi.traced:
+                fi.hot = True
+            for name in fi.loop_refs:
+                target = self.resolve(name, fi)
+                if target is not None and not target.traced:
+                    target.hot = True
+        # Module-level loops (scripts) reference module-level functions.
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.For, ast.While)) \
+                    and self.func_of(node) is None:
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Name) and isinstance(
+                            sub.ctx, ast.Load):
+                        target = self.resolve(sub.id, None)
+                        if target is not None and not target.traced:
+                            target.hot = True
+        changed = True
+        while changed:
+            changed = False
+            for fi in self.functions:
+                if fi.hot or fi.traced:
+                    continue
+                if fi.scope is not None and fi.scope.hot:
+                    fi.hot = True
+                    changed = True
+                    continue
+                for other in self.functions:
+                    if other.hot and fi.name in other.refs \
+                            and self.resolve(fi.name, other) is fi:
+                        fi.hot = True
+                        changed = True
+                        break
+
+    def in_hot_context(self, node: ast.AST) -> bool:
+        """Inside a loop, or inside a function reachable from one."""
+        if self.in_loop(node):
+            return True
+        fi = self.func_of(node)
+        while fi is not None:
+            if fi.hot:
+                return True
+            fi = fi.scope
+        return False
+
+    # --- suppressions ---------------------------------------------------
+
+    def _collect_suppressions(self) -> Dict[int, Set[str]]:
+        out: Dict[int, Set[str]] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = SUPPRESS_RE.search(line)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",")}
+                out[i] = rules
+        return out
+
+    def suppressed(self, node: ast.AST, rule: str) -> bool:
+        """Suppressed when any line of the flagged STATEMENT — or the
+        contiguous comment block directly above it — carries the rule
+        (or "all"). Statement-level on purpose: a finding on an inner
+        expression of a multi-line call is silenced by annotating the
+        statement, like every other line-comment linter."""
+        stmt: ast.AST = node
+        cur = node
+        while cur is not None and not isinstance(cur, ast.stmt):
+            cur = self.parent(cur)
+        if cur is not None:
+            stmt = cur
+        first = getattr(stmt, "lineno", getattr(node, "lineno", 0))
+        last = getattr(stmt, "end_lineno", first) or first
+
+        def hit(ln: int) -> bool:
+            rules = self.suppressions.get(ln)
+            return bool(rules and (rule in rules or "all" in rules))
+
+        if any(hit(ln) for ln in range(first, last + 1)):
+            return True
+        # Walk the comment block above (a trailing suppression on a
+        # CODE line above belongs to that line, not to this statement).
+        ln = first - 1
+        while ln >= 1 and self.lines[ln - 1].strip().startswith("#"):
+            if hit(ln):
+                return True
+            ln -= 1
+        return False
+
+    def finding(self, node: ast.AST, rule: str, message: str
+                ) -> Finding:
+        return Finding(self.path, getattr(node, "lineno", 0),
+                       getattr(node, "col_offset", 0), rule, message)
+
+
+def call_qual(node: ast.AST) -> Tuple[Optional[ast.Call], str]:
+    """(call node, dotted callee) when ``node`` is a Call, else
+    (None, "")."""
+    if isinstance(node, ast.Call):
+        return node, qualname(node.func)
+    return None, ""

@@ -1,0 +1,54 @@
+"""effect-under-trace: Python side effects inside traced functions.
+
+A traced function's Python body runs ONCE, at trace time — and again
+at unpredictable retrace points (new shapes, cache eviction). A
+``print`` there logs once per compile, not once per step (use
+``jax.debug.print``); ``time.time()`` measures tracing, not execution,
+and freezes a host timestamp into the compiled program; ``input`` /
+``breakpoint`` hang remote compiles. All of them "work" on the first
+run and then lie.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tensorflow_distributed_tpu.analysis.rules.common import (
+    Finding, ModuleContext, qualname)
+
+RULE = "effect-under-trace"
+
+EFFECT_CALLS = frozenset({
+    "print", "input", "breakpoint",
+    "time.time", "time.perf_counter", "time.monotonic",
+    "time.process_time", "time.sleep",
+    "datetime.now", "datetime.datetime.now", "datetime.utcnow",
+    "datetime.datetime.utcnow",
+})
+
+HINTS = {
+    "print": "use jax.debug.print for per-execution output",
+    "time.time": "trace-time timestamp frozen into the program",
+    "time.perf_counter": "measures tracing, not device execution",
+    "time.monotonic": "measures tracing, not device execution",
+    "time.sleep": "sleeps once per compile, never per step",
+}
+
+
+def check(ctx: ModuleContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        q = qualname(node.func)
+        if q not in EFFECT_CALLS:
+            continue
+        if not ctx.in_traced_context(node):
+            continue
+        if ctx.suppressed(node, RULE):
+            continue
+        hint = HINTS.get(q, "runs at trace time, not per step")
+        yield ctx.finding(
+            node, RULE,
+            f"{q}() inside a traced function executes once per "
+            f"compile, not once per step ({hint})")

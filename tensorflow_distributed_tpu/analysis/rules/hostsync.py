@@ -1,0 +1,102 @@
+"""host-sync rules: hidden host↔device synchronization.
+
+Two contexts, two rules:
+
+- ``host-sync-under-trace``: ``jax.device_get`` / ``.item()`` /
+  ``float()``/``int()`` / ``np.asarray``/``np.array`` inside a traced
+  function. On a tracer these either raise at trace time
+  (``ConcretizationTypeError``) or silently freeze a value into the
+  compiled program — both are bugs, and the frozen-constant kind
+  compiles fine and corrupts quietly.
+
+- ``host-sync-in-loop``: the same device reads in the HOST-side inner
+  train/decode loops of the hot modules (``HOT_MODULES`` below). Each
+  one blocks the dispatch pipeline on the device stream — the classic
+  steps/sec cliff that profiles as "device idle". Intentional syncs
+  (cadence-gated logging, eval, checkpoints, the final report) carry a
+  ``# graftcheck: disable=host-sync-in-loop -- <why>`` suppression.
+
+``float()``/``int()`` are only flagged under trace (where any
+non-static argument is a hazard); in host loops they are ordinary
+scalar math and the unambiguous primitives (``jax.device_get``,
+``.item()``, ``np.asarray`` on device values) carry the signal.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List
+
+from tensorflow_distributed_tpu.analysis.rules.common import (
+    Finding, ModuleContext, qualname)
+
+RULE_TRACE = "host-sync-under-trace"
+RULE_LOOP = "host-sync-in-loop"
+
+# Modules whose for/while loops ARE the hot path (the inner train and
+# decode loops). Everywhere else, host-side device reads are assumed
+# cold (data loading, reporting, benchmarks' own timing harnesses).
+HOT_MODULES = (
+    "train/loop.py",
+    "train/multistep.py",
+    "serve/engine.py",
+    "serve/scheduler.py",
+    "serve/run.py",
+)
+
+DEVICE_GET_CALLS = frozenset({
+    "jax.device_get", "jax.block_until_ready",
+})
+NP_MATERIALIZE_CALLS = frozenset({
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "onp.asarray", "onp.array",
+})
+
+
+def _is_hot_module(path: str) -> bool:
+    # Separator-anchored: "observe/run.py" must not match the
+    # "serve/run.py" suffix.
+    p = path.replace("\\", "/")
+    return any(p == suffix or p.endswith("/" + suffix)
+               for suffix in HOT_MODULES)
+
+
+def _literal(node: ast.AST) -> bool:
+    return isinstance(node, (ast.Constant, ast.JoinedStr))
+
+
+def check(ctx: ModuleContext) -> Iterator[Finding]:
+    hot = _is_hot_module(ctx.path)
+    if hot:
+        ctx.mark_hot()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        q = qualname(node.func)
+        name = ""
+        if q in DEVICE_GET_CALLS:
+            name = q
+        elif q in NP_MATERIALIZE_CALLS:
+            name = q
+        elif (isinstance(node.func, ast.Attribute)
+              and node.func.attr == "item" and not node.args):
+            name = ".item()"
+        traced = ctx.in_traced_context(node)
+        if traced:
+            if not name and q in ("float", "int") and len(node.args) == 1 \
+                    and not node.keywords and not _literal(node.args[0]):
+                name = f"{q}()"
+            if name and not ctx.suppressed(node, RULE_TRACE):
+                yield ctx.finding(
+                    node, RULE_TRACE,
+                    f"{name} inside a traced function: materializes a "
+                    f"tracer (trace-time error) or freezes a host value "
+                    f"into the compiled program")
+            continue
+        if hot and name and ctx.in_hot_context(node):
+            if not ctx.suppressed(node, RULE_LOOP):
+                yield ctx.finding(
+                    node, RULE_LOOP,
+                    f"{name} in the inner train/decode loop blocks the "
+                    f"host on the device stream every step; gate it on "
+                    f"a cadence or move it off the hot path")

@@ -1,0 +1,51 @@
+"""jit-in-loop: building a jitted program inside a loop.
+
+``jax.jit`` / ``pjit`` return a NEW callable with an EMPTY compile
+cache each time they are called: constructing one inside a loop throws
+the cached executable away every iteration and retraces + recompiles —
+seconds of XLA work where the author expected microseconds of
+dispatch. (The C++ fast path also keys on the wrapper's identity, so
+even a warm persistent cache still pays tracing.) Hoist the ``jax.jit``
+call out of the loop; per-iteration shapes that genuinely need
+distinct programs should go through an explicit cache
+(``functools.lru_cache`` over a static key — see serve/engine.py).
+
+Also flagged: ``jax.named_call``-free tracing entry points that
+recompile per call when built in a loop (``jax.make_jaxpr``,
+``jax.eval_shape`` are cheap tracers, NOT flagged).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tensorflow_distributed_tpu.analysis.rules.common import (
+    Finding, ModuleContext, qualname)
+
+RULE = "jit-in-loop"
+
+JIT_BUILDERS = frozenset({
+    "jax.jit", "jit", "jax.pjit", "pjit",
+    "jax.experimental.pjit.pjit", "jax.pmap", "pmap",
+})
+
+
+def check(ctx: ModuleContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and qualname(node.func) in JIT_BUILDERS):
+            continue
+        if not ctx.in_loop(node):
+            continue
+        if ctx.in_traced_context(node):
+            # jit-under-jit inside a traced loop body is inlined at
+            # trace time, not recompiled per runtime iteration.
+            continue
+        if ctx.suppressed(node, RULE):
+            continue
+        yield ctx.finding(
+            node, RULE,
+            f"{qualname(node.func)} constructed inside a loop: a fresh "
+            f"wrapper retraces and recompiles every iteration — hoist "
+            f"it out of the loop (or cache it under a static key)")

@@ -1,0 +1,168 @@
+"""prng-reuse: the same PRNGKey consumed by more than one random op.
+
+JAX keys are not stateful seeds: feeding one key to two samplers gives
+correlated (usually identical) draws — silent statistical corruption,
+no error anywhere. The contract is one consumption per key; every
+further draw needs a ``jax.random.split`` / ``fold_in`` derivation.
+
+The analysis is per-function and straight-line: track names bound from
+key-producing expressions (``jax.random.key``/``PRNGKey``/``split``/
+``fold_in`` and the repo's ``prng.*`` helpers), count consumptions
+(the name fed to a ``jax.random`` sampler, or passed as a ``key=`` /
+``rng=`` / ``rngs=`` argument), and reset the count when the name is
+rebound. Loop bodies are visited twice — simulating the second
+iteration — so the canonical bug (one key drawn from on every
+iteration) counts as reuse unless the key is re-derived inside the
+loop. Control flow is otherwise approximated linearly — both branches
+of an ``if`` count, which can over-report mutually-exclusive
+consumptions; suppress those with
+``# graftcheck: disable=prng-reuse -- <why>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator
+
+from tensorflow_distributed_tpu.analysis.rules.common import (
+    Finding, ModuleContext, qualname)
+
+RULE = "prng-reuse"
+
+# jax.random.* that DERIVE keys rather than consume them.
+DERIVERS = frozenset({
+    "key", "PRNGKey", "split", "fold_in", "wrap_key_data", "key_data",
+    "clone", "key_impl",
+})
+KEY_PRODUCER_CALLS = frozenset({
+    "jax.random.key", "jax.random.PRNGKey", "random.key",
+    "random.PRNGKey", "jax.random.split", "random.split",
+    "jax.random.fold_in", "random.fold_in",
+    "prng.root_key", "prng.init_key", "prng.step_key",
+    "root_key", "init_key", "step_key",
+})
+KEY_KEYWORDS = frozenset({"key", "rng", "rngs", "dropout_key", "prng"})
+
+
+def _names_in(node: ast.AST) -> Iterator[str]:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            yield sub.id
+
+
+def _is_random_consumer(q: str) -> bool:
+    """A ``jax.random.<sampler>`` (or bare ``random.<sampler>``) call
+    that consumes its key argument."""
+    for prefix in ("jax.random.", "random."):
+        if q.startswith(prefix):
+            return q[len(prefix):] not in DERIVERS
+    return False
+
+
+def check(ctx: ModuleContext) -> Iterator[Finding]:
+    for fi in ctx.functions:
+        if isinstance(fi.node, ast.Lambda):
+            continue
+        yield from _check_function(ctx, fi.node)
+
+
+def _check_function(ctx: ModuleContext, fn: ast.AST) -> Iterator[Finding]:
+    # name -> consumption count since last (re)binding; only names we
+    # SAW bound from a key producer are tracked, so ordinary variables
+    # passed as key= (fresh per call, derived elsewhere) don't count.
+    uses: Dict[str, int] = {}
+    reported: set = set()   # call node ids (loop bodies visit twice)
+
+    def bind(target: ast.AST) -> None:
+        for name in _names_in(target):
+            uses[name] = 0
+
+    def consume(name_node: ast.Name, call: ast.Call) -> Iterator[Finding]:
+        name = name_node.id
+        if name not in uses:
+            return
+        uses[name] += 1
+        if uses[name] > 1 and id(call) not in reported \
+                and not ctx.suppressed(call, RULE):
+            reported.add(id(call))
+            yield ctx.finding(
+                call, RULE,
+                f"key {name!r} consumed again without an intervening "
+                f"split/fold_in — identical randomness on every use")
+
+    def visit(node: ast.AST) -> Iterator[Finding]:
+        # Nested defs have their own pass (fresh scope).
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and node is not fn:
+            return
+        if isinstance(node, ast.Assign):
+            # Value first: ``k = jax.random.normal(k)`` consumes the
+            # old binding before creating the new one.
+            yield from visit(node.value)
+            produced = (isinstance(node.value, ast.Call)
+                        and qualname(node.value.func)
+                        in KEY_PRODUCER_CALLS)
+            for target in node.targets:
+                if produced:
+                    bind(target)
+                else:
+                    # Any other rebinding clears tracking — we no
+                    # longer know the name holds the same key value.
+                    for name in _names_in(target):
+                        uses.pop(name, None)
+            return
+        if isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            if node.value is not None:
+                yield from visit(node.value)
+            uses.pop(getattr(node.target, "id", None), None)
+            return
+        if isinstance(node, ast.For):
+            # The iterable evaluates once; target/body run per
+            # iteration — visit them twice so a key bound OUTSIDE the
+            # loop and drawn from INSIDE it counts as reuse (a key
+            # re-derived in the body rebinds on the second pass and
+            # stays clean).
+            yield from visit(node.iter)
+            for _ in range(2):
+                for child in [node.target] + node.body:
+                    yield from visit(child)
+            for child in node.orelse:
+                yield from visit(child)
+            return
+        if isinstance(node, ast.While):
+            for _ in range(2):
+                yield from visit(node.test)
+                for child in node.body:
+                    yield from visit(child)
+            for child in node.orelse:
+                yield from visit(child)
+            return
+        if isinstance(node, ast.Name) and isinstance(
+                node.ctx, (ast.Store, ast.Del)):
+            # Any other binding form (for-target, with-as, unpack in
+            # comprehensions): the name no longer provably holds the
+            # same key.
+            uses.pop(node.id, None)
+            return
+        for child in ast.iter_child_nodes(node):
+            yield from visit(child)
+        if isinstance(node, ast.Call):
+            q = qualname(node.func)
+            # Consumptions: key fed to a sampler positionally, or to
+            # any call via a key-ish keyword (model.init/apply rngs).
+            if _is_random_consumer(q):
+                for arg in node.args:
+                    if isinstance(arg, ast.Name):
+                        yield from consume(arg, node)
+            for kw in node.keywords:
+                if kw.arg in KEY_KEYWORDS:
+                    if isinstance(kw.value, ast.Name):
+                        yield from consume(kw.value, node)
+                    elif isinstance(kw.value, ast.Dict):
+                        for v in kw.value.values:
+                            if isinstance(v, ast.Name):
+                                yield from consume(v, node)
+
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for stmt in body:
+        yield from visit(stmt)

@@ -20,6 +20,10 @@ Examples:
     # continuous-batching inference (serve/; README "Serving"):
     python -m tensorflow_distributed_tpu.cli --mode serve \
         --model gpt_lm --serve.num-slots 8 --serve.num-requests 32
+
+    # graftcheck runtime checks (analysis/runtime.py; README "Static
+    # analysis"): transfer guard + sharding-contract assertion
+    python -m tensorflow_distributed_tpu.cli --train-steps 100 --check true
 """
 
 from __future__ import annotations

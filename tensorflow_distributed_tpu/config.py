@@ -549,6 +549,19 @@ class TrainConfig:
     # --serve.decode-priority, --serve.requests...
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
 
+    # --- static analysis / runtime checks --------------------------------
+    # graftcheck's runtime mode (analysis/runtime.py): the inner train/
+    # decode loops run under jax.transfer_guard("disallow") — any
+    # IMPLICIT host<->device transfer raises at its source line instead
+    # of silently serializing the pipeline every step — and the
+    # sharding contract (layouts declared at state/cache creation vs
+    # actual leaf shardings) is asserted after the first step. The
+    # static layers are the CLI cousins:
+    #   python -m tensorflow_distributed_tpu.analysis.lint
+    #   python -m tensorflow_distributed_tpu.analysis.jaxprcheck
+    # Costs nothing when off.
+    check: bool = False
+
     # --- misc ------------------------------------------------------------
     seed: int = 0
     # "eval": restore the latest checkpoint from checkpoint_dir and run

@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tensorflow_distributed_tpu.analysis import runtime as graftcheck
 from tensorflow_distributed_tpu.models.generate import (
     decode_token, lookup_program, prefill_cache)
 from tensorflow_distributed_tpu.serve.buckets import (
@@ -98,7 +99,7 @@ class SlotDecodeEngine:
 
     def __init__(self, model, params, num_slots: int,
                  buckets: Optional[Sequence[int]] = None,
-                 min_bucket: int = 16):
+                 min_bucket: int = 16, check: bool = False):
         cfg = model.cfg
         if not cfg.causal:
             raise ValueError("SlotDecodeEngine needs a causal model")
@@ -128,6 +129,13 @@ class SlotDecodeEngine:
         self.prefills = 0
         self.decode_steps = 0
         self._step_fn = lookup_program(_compiled_step, self.model)
+        # --check (graftcheck's runtime layer): the decode step runs
+        # under jax.transfer_guard("disallow"), and the cache layout
+        # after the first step is asserted against the layout the
+        # cache was created with (analysis/runtime.py).
+        self._check = check
+        self._declared_cache = (graftcheck.sharding_tree(self.cache)
+                                if check else None)
 
     def _zero_cache(self):
         """A zeroed [num_slots, max_len, ...] cache pytree, shaped via
@@ -161,6 +169,8 @@ class SlotDecodeEngine:
     def prefill(self, prompt: np.ndarray, slot: int) -> int:
         """Admit a request into ``slot``: bucketed prefill, row insert,
         greedy first token. Returns the first generated token."""
+        # graftcheck: disable=host-sync-in-loop -- normalizes the HOST
+        # prompt the scheduler handed in; no device value involved
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         plen = len(prompt)
         if plen < 1:
@@ -176,6 +186,9 @@ class SlotDecodeEngine:
                         jnp.asarray(plen, jnp.int32))
         self.cache = _insert_row(self.cache, row,
                                  jnp.asarray(slot, jnp.int32))
+        # graftcheck: disable=host-sync-in-loop -- the TTFT point: the
+        # first token must reach the host to be streamed; one scalar
+        # per ADMISSION, not per decode step
         first_tok = int(jax.device_get(first)[0])
         self.tok[slot] = first_tok
         self.pos[slot] = plen
@@ -191,9 +204,24 @@ class SlotDecodeEngine:
             raise RuntimeError(
                 "an active slot is at max_len — the scheduler admitted "
                 "a request that cannot fit (fits() is the guard)")
-        self.cache, nxt = self._step_fn(
-            self.params, self.cache, jnp.asarray(self.tok),
-            jnp.asarray(self.pos))
+        # Host->device conversion of the slot scalars stays OUTSIDE the
+        # transfer guard: these two tiny explicit uploads are the
+        # engine's designed input path.
+        tok, pos = jnp.asarray(self.tok), jnp.asarray(self.pos)
+        with graftcheck.transfer_guard(self._check):
+            self.cache, nxt = self._step_fn(self.params, self.cache,
+                                            tok, pos)
+        if self._check and self.decode_steps == 0:
+            # First decode step: the cache must come back in the
+            # layout it was created with — sharding drift here
+            # re-lays-out every subsequent step.
+            graftcheck.assert_sharding_contract(
+                self.cache, self._declared_cache, what="decode cache")
+        # graftcheck: disable=host-sync-in-loop -- the engine's OUTPUT:
+        # tokens must land on host every step for EOS/budget
+        # termination and streaming; [num_slots] int32 per step is the
+        # contract, and the decode program itself stays dispatched
+        # ahead of it
         nxt = np.asarray(jax.device_get(nxt))
         act = self.active
         self.tok[act] = nxt[act]

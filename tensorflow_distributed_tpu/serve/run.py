@@ -57,6 +57,9 @@ def _workload(cfg: TrainConfig, vocab_size: int,
                 # vocab in serve_run (like generate_only): with
                 # synthetic_vocab unset the family default (e.g.
                 # 50257 for gpt_lm small) is the real bound.
+                # graftcheck: disable=host-sync-in-loop -- request-file
+                # parsing runs once, before the engine exists; this
+                # materializes host JSON, not device buffers
                 reqs.append(Request(
                     rid=len(reqs), prompt=np.asarray(ids, np.int32),
                     max_new_tokens=int(obj.get("max_new_tokens",
@@ -164,7 +167,7 @@ def serve_run(cfg: TrainConfig) -> Dict:
                   + (" <done>" if done else ""), flush=True)
 
     engine = SlotDecodeEngine(model, params, cfg.serve.num_slots,
-                              buckets=buckets)
+                              buckets=buckets, check=cfg.check)
     sched = Scheduler(engine, decode_priority=cfg.serve.decode_priority,
                       registry=registry, on_token=on_token)
     try:

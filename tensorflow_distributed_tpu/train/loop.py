@@ -16,6 +16,7 @@ from typing import Dict, Optional
 import jax
 import numpy as np
 
+from tensorflow_distributed_tpu.analysis import runtime as graftcheck
 from tensorflow_distributed_tpu.config import TrainConfig
 from tensorflow_distributed_tpu.data import prefetch_to_mesh
 from tensorflow_distributed_tpu.models import build_model
@@ -72,6 +73,10 @@ def evaluate(state: TrainState, eval_fn, task: Task, mesh, batch: int
         # aware: co-data-coordinate processes keep identical slices).
         b = shard_batch(mesh, process_slice(host_batch, mesh),
                         seq_axis=task.seq_axis)
+        # The totals reduce on host per eval batch by design; this loop
+        # runs only on the eval cadence (and at the end), never per
+        # train step.
+        # graftcheck: disable=host-sync-in-loop -- eval fetch, cadence-gated
         m = jax.device_get(eval_fn(state, b))
         for k, v in m.items():
             totals[k] = totals.get(k, 0.0) + float(v) * batch
@@ -469,6 +474,8 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
             """Periodic log/eval/checkpoint — applied to EVERY step
             including the warm-up compile step."""
             if cfg.log_every and step_now % cfg.log_every == 0:
+                # graftcheck: disable=host-sync-in-loop -- the log fetch,
+                # gated on log_every by the line above
                 host_metrics = jax.device_get(metrics)
                 logger.log(step_now, **host_metrics)
                 obs.log_step(step_now, host_metrics)
@@ -511,14 +518,31 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
             configured."""
             if policy is None and spikes is None:
                 return None
-            host_loss = float(jax.device_get(step_metrics["loss"]))
-            # The jitted step can skip on a non-finite GRAD NORM while
-            # the loss stays finite (backward-only overflow); the
-            # skipped_nonfinite metric it reports is the authority, so
-            # those skips charge the budget exactly like NaN losses.
-            skipped = step_metrics.get("skipped_nonfinite")
-            device_skipped = (skipped is not None
-                              and float(jax.device_get(skipped)) > 0)
+            if policy is None and cfg.log_every \
+                    and step_id % cfg.log_every:
+                # Spike detection WITHOUT a recovery policy is advisory
+                # telemetry: sample it on the log cadence instead of
+                # paying a per-step host fetch in the hot path. The
+                # trade is real and deliberate: a spike shorter than
+                # log_every can fall between samples, and the rolling
+                # window arms over window*log_every steps — acceptable
+                # for an advisory signal. A run that ACTS on losses
+                # (resilience.nonfinite != off) keeps full per-step
+                # inspection; set log_every=1 to sample every step.
+                return None
+            # One transfer for both policy scalars (loss + the step's
+            # skip flag) instead of two round trips. The jitted step
+            # can skip on a non-finite GRAD NORM while the loss stays
+            # finite (backward-only overflow); the skipped_nonfinite
+            # metric it reports is the authority, so those skips charge
+            # the budget exactly like NaN losses.
+            # graftcheck: disable=host-sync-in-loop -- per-step by the
+            # policy contract; _sync_retired already retired these
+            # arrays, so this is a scalar D2H copy, not a device stall
+            host_loss, host_skipped = map(float, jax.device_get(
+                (step_metrics["loss"],
+                 step_metrics.get("skipped_nonfinite", 0.0))))
+            device_skipped = host_skipped > 0
             if not np.isfinite(host_loss) or device_skipped:
                 if policy is None:
                     return None  # legacy path: cadence halt (or not)
@@ -570,6 +594,10 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
             if wdog is not None:
                 wdog.sync(m, sid)
             else:
+                # graftcheck: disable=host-sync-in-loop -- THE designed
+                # retirement point: the bounded in-flight window blocks
+                # on the oldest pending step on purpose (see the deque
+                # comment below); everything else overlaps with it
                 jax.block_until_ready(m)
 
         def _rewind(cur_state, bad_step: int):
@@ -589,6 +617,8 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
             # heap (same class as the async-ckpt SIGSEGV the repo
             # already documents). A rewind is off the hot path; a full
             # quiesce costs nothing that matters.
+            # graftcheck: disable=host-sync-in-loop -- deliberate full
+            # quiesce on the cold recovery path (see comment above)
             jax.block_until_ready(cur_state.params)
             ckpt.wait()
             ckpt.quarantine_from(
@@ -607,6 +637,14 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
                 # sole checkpoint on a mere suspicion, never
                 # restoring a poisoned one and burning the budget on
                 # an instant re-NaN.
+                # Hoisted OUT of the walk-back loop (graftcheck
+                # jit-in-loop): one verify program, reused for every
+                # candidate checkpoint instead of a fresh trace +
+                # compile per iteration.
+                params_finite = jax.jit(
+                    lambda p: jax.numpy.all(jax.numpy.array(
+                        [jax.numpy.all(jax.numpy.isfinite(x))
+                         for x in jax.tree_util.tree_leaves(p)])))
                 while True:
                     target = ckpt.latest_step(cfg.checkpoint_dir)
                     if target is None:
@@ -619,11 +657,11 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
                             f"every={cfg.checkpoint_every})")
                     new_state = ckpt.restore(cfg.checkpoint_dir,
                                              cur_state)
-                    finite = bool(jax.device_get(jax.jit(
-                        lambda p: jax.numpy.all(jax.numpy.array(
-                            [jax.numpy.all(jax.numpy.isfinite(x))
-                             for x in jax.tree_util.tree_leaves(p)]))
-                    )(new_state.params)))
+                    # graftcheck: disable=host-sync-in-loop -- the
+                    # walk-back must read each candidate's verdict on
+                    # host; rewind is the cold recovery path
+                    finite = bool(jax.device_get(
+                        params_finite(new_state.params)))
                     if finite:
                         break
                     ckpt.quarantine_from(
@@ -639,6 +677,12 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
             if spikes is not None:
                 spikes.reset()  # replayed steps re-approach the spike
             return new_state, rewound_to
+
+        # --check (graftcheck's runtime layer): snapshot the layout the
+        # state was CREATED with — the declared sharding contract the
+        # first step must hand back (analysis/runtime.py).
+        declared_shardings = (graftcheck.sharding_tree(state.params)
+                              if cfg.check else None)
 
         # Warm-up compile outside the timed steady-state span (the
         # reference's timings conflated graph setup with steps; ours don't).
@@ -660,6 +704,13 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
                         if wdog is not None else _fetch(start_step + 1))
                     state, metrics = step_fn(state, batch0)
                     jax.block_until_ready(metrics)
+                if declared_shardings is not None:
+                    # The first step's output is where a missing
+                    # with_sharding_constraint first shows: GSPMD
+                    # propagating an input sharding into the params
+                    # re-lays-out every later step silently.
+                    graftcheck.assert_sharding_contract(
+                        state.params, declared_shardings, what="params")
                 cadence(start_step + 1, state, metrics)
                 want_rewind = _inspect(start_step + 1, metrics)
         steps_done = 1 if cfg.train_steps > start_step else 0
@@ -683,7 +734,12 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
         # checkpoint dir to save into.
         guard = PreemptionGuard(enabled=bool(cfg.checkpoint_dir))
         try:
-            with Timer() as train_t:
+            # --check: every transfer in the steady-state loop is
+            # explicit by design (prefetch device_puts, cadence
+            # device_gets); an IMPLICIT one is a bug the guard turns
+            # into an error at its source line. Transparent when off.
+            with graftcheck.transfer_guard(cfg.check), \
+                    Timer() as train_t:
                 # The outer while exists for ONE flow: a policy-ordered
                 # rewind restores a checkpoint in-process and re-enters
                 # the step loop from the restored step. Every other
@@ -693,7 +749,15 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
                 next_start = start_step + steps_done
                 while True:
                     if want_rewind is not None:
-                        state, next_start = _rewind(state, want_rewind)
+                        # The restore inside _rewind does implicit
+                        # transfers by design (checkpoint._warm_runtime,
+                        # launder_buffers) — exempt the cold recovery
+                        # path from the steady-state --check guard or a
+                        # rewind under --check would crash instead of
+                        # recovering.
+                        with graftcheck.transfer_allowed(cfg.check):
+                            state, next_start = _rewind(state,
+                                                        want_rewind)
                         it = make_iterator(next_start)
                         want_rewind = None
                     for i in range(next_start, cfg.train_steps):
