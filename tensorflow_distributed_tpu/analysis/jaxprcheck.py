@@ -141,10 +141,17 @@ def _mesh(data: int = 1, pipe: int = 1):
     return make_mesh(MeshConfig(data=data, pipe=pipe), devs)
 
 
-def _train_jaxpr(model_name: str):
+def _train_jaxpr(model_name: str, health_every: int = 0,
+                 health_taps: bool = False):
     """The REAL jitted LM train step (same builders as train/loop.py),
     traced: bf16 compute so the upcast census watches the path that
-    matters, dropout 0 so the trace is rng-schedule-free."""
+    matters, dropout 0 so the trace is rng-schedule-free.
+
+    ``health_every``/``health_taps`` build the health-instrumented
+    variant (observe/health.py): its golden entry pins that enabling
+    telemetry adds NO collectives — the vitals are local reductions,
+    and a regression that sneaks an allreduce into the cadence branch
+    fails here, not in an ICI profile three sessions later."""
     import optax
 
     from tensorflow_distributed_tpu.models import transformer
@@ -157,19 +164,22 @@ def _train_jaxpr(model_name: str):
     factory = (transformer.moe_lm if model_name == "moe_lm"
                else transformer.gpt_lm)
     model = factory(mesh=mesh, size="tiny", dropout_rate=0.0,
-                    compute_dtype=jnp.bfloat16)
+                    compute_dtype=jnp.bfloat16,
+                    health_taps=health_taps)
     state = create_train_state(model, optax.adam(1e-3),
                                np.zeros((2, _L), np.int32), mesh, seed=0)
     loss = (make_moe_loss() if model_name == "moe_lm"
             else make_mlm_loss())
     step = make_train_step(mesh, loss=loss,
-                           batch_shardings=mlm_batch_shardings(mesh))
+                           batch_shardings=mlm_batch_shardings(mesh),
+                           health_every=health_every)
     return jax.make_jaxpr(step)(state, _clm_batch())
 
 
-def _pipelined_jaxpr():
+def _pipelined_jaxpr(health_every: int = 0):
     """The 1F1B pipelined step on a pipe=2 mesh — the program whose
-    ppermute schedule the census exists to pin."""
+    ppermute schedule the census exists to pin. The health variant
+    proves the telemetry adds zero ppermutes/psums to the schedule."""
     import optax
 
     from tensorflow_distributed_tpu.models.pipelined import pipelined_lm
@@ -183,7 +193,7 @@ def _pipelined_jaxpr():
                          max_len=_L)
     state = create_train_state(model, optax.adam(1e-3),
                                np.zeros((2, _L), np.int32), mesh)
-    step = make_1f1b_train_step(model, mesh)
+    step = make_1f1b_train_step(model, mesh, health_every=health_every)
     return jax.make_jaxpr(step)(state, _clm_batch())
 
 
@@ -223,6 +233,14 @@ PROGRAMS = {
     "moe_train": lambda: _train_jaxpr("moe_lm"),
     "pipelined_train": _pipelined_jaxpr,
     "serve_decode": _serve_decode_jaxpr,
+    # Health-instrumented variants (observe.health: cadence 10, taps
+    # on the dense family): the budgets pin that device telemetry
+    # adds NO collectives next to the plain entries above.
+    "gpt_train_health": lambda: _train_jaxpr(
+        "gpt_lm", health_every=10, health_taps=True),
+    "moe_train_health": lambda: _train_jaxpr(
+        "moe_lm", health_every=10),
+    "pipelined_train_health": lambda: _pipelined_jaxpr(health_every=10),
 }
 
 

@@ -24,6 +24,13 @@ Examples:
     # graftcheck runtime checks (analysis/runtime.py; README "Static
     # analysis"): transfer guard + sharding-contract assertion
     python -m tensorflow_distributed_tpu.cli --train-steps 100 --check true
+
+    # device telemetry (observe/device.py + observe/health.py; README
+    # "Device telemetry"): compiled-program cost/HBM records + per-layer
+    # health vitals in the metrics JSONL
+    python -m tensorflow_distributed_tpu.cli --model gpt_lm \
+        --model-size tiny --observe.metrics-jsonl /tmp/m.jsonl \
+        --observe.health true --observe.health-taps true
 """
 
 from __future__ import annotations
@@ -79,6 +86,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         table = result.logger.performance_table(cfg.learning_rate)
         if table.count("\n"):
             print(table)
+        # Compiled-program HBM budget table (observe/device.py) —
+        # printed when the run registered programs (a sink was
+        # configured and --observe.programs wasn't turned off).
+        from tensorflow_distributed_tpu.observe import (
+            device as observe_device)
+        budget = observe_device.budget_table()
+        if budget and cfg.observe.programs:
+            print(budget)
         # Point at the observe/ artifacts this run produced.
         if cfg.observe.metrics_jsonl:
             print(f"[observe] metrics: {cfg.observe.metrics_jsonl} "

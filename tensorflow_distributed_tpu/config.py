@@ -93,8 +93,45 @@ class ObserveConfig:
     # In-memory record ring-buffer cap (registry + MetricLogger) so
     # multi-million-step runs don't grow host memory unboundedly.
     max_records: int = 100_000
+    # Compiled-program registry (observe/device.py): every jit call
+    # site registers its program's cost_analysis/memory_analysis
+    # (flops, bytes accessed, peak-HBM estimate, donated bytes) plus
+    # lower/compile wall time, emitted as one "compile" record per
+    # program. Default on, but armed only when a sink is configured
+    # (the registration pass costs one extra trace + a persistent-
+    # cache-absorbed compile per program).
+    programs: bool = True
+    # On-device model-health telemetry (observe/health.py): per-top-
+    # level-module grad norm, update-to-param ratio, and param RMS
+    # computed INSIDE the jitted step, cadence-gated on device so
+    # off-cadence steps pay neither the norm reductions nor any extra
+    # host transfer. Emitted as per-module "health" records on the
+    # log cadence.
+    health: bool = False
+    # Health cadence in steps. 0 = ride log_every (the usual choice:
+    # the scalars travel in the metrics fetch the logger already
+    # does). A nonzero value must be a multiple of log_every — the
+    # host only LOOKS on the log cadence.
+    health_every: int = 0
+    # Optional activation-RMS taps: each transformer block sows the
+    # f32 RMS of its output (TransformerConfig.health_taps) into the
+    # same per-layer health records. Transformer families except
+    # pipelined_lm (its stages run inside a manual shard_map).
+    health_taps: bool = False
 
     def validate(self) -> None:
+        if self.health_every < 0:
+            raise ValueError(
+                f"observe.health_every must be >= 0, "
+                f"got {self.health_every}")
+        if self.health_every and not self.health:
+            raise ValueError(
+                "observe.health_every has no effect without "
+                "observe.health; add --observe.health true")
+        if self.health_taps and not self.health:
+            raise ValueError(
+                "observe.health_taps has no effect without "
+                "observe.health; add --observe.health true")
         if self.window < 1:
             raise ValueError(
                 f"observe.window must be >= 1, got {self.window}")
@@ -968,6 +1005,43 @@ class TrainConfig:
                     "resilience.nonfinite=skip_batch does not compose "
                     "with param_sync_every > 1 (the local-SGD step has "
                     "no skip path); use nonfinite=rewind or halt")
+        if self.observe.health and self.mode != "train":
+            # Same explicitness rule as the taps check below: health
+            # vitals are computed inside the TRAIN step — an observed
+            # serve/eval/generate run would silently produce zero
+            # health records.
+            raise ValueError(
+                f"observe.health is train-side telemetry (per-module "
+                f"grad/update vitals inside the train step); it has "
+                f"no effect under mode={self.mode!r} — drop the flag")
+        if self.observe.health and self.mode == "train":
+            if not self.log_every:
+                raise ValueError(
+                    "observe.health needs log_every > 0: the health "
+                    "scalars ride the log-cadence metrics fetch")
+            if (self.observe.health_every
+                    and self.observe.health_every % self.log_every):
+                raise ValueError(
+                    f"observe.health_every {self.observe.health_every} "
+                    f"must be a multiple of log_every {self.log_every} "
+                    f"(the host only looks on the log cadence)")
+            if self.param_sync_every > 1:
+                raise ValueError(
+                    "observe.health is implemented in the standard and "
+                    "1F1B steps; the local-SGD step (param_sync_every "
+                    "> 1) has no health path")
+        if self.observe.health_taps and self.model not in (
+                "bert_mlm", "gpt_lm", "moe_lm"):
+            # Same explicitness rule as every other no-op knob: the
+            # vision families have no tapped blocks, and pipelined_lm's
+            # stage forwards run inside a manual shard_map with no sow
+            # path out — a silently tap-less run would look like a
+            # telemetry bug.
+            raise ValueError(
+                f"observe.health_taps needs a non-pipelined "
+                f"transformer family (bert_mlm | gpt_lm | moe_lm), "
+                f"got model={self.model!r} — per-module health still "
+                f"works there, drop the taps flag")
         if self.halt_on_nonfinite and self.resilience.nonfinite != "off":
             raise ValueError(
                 "halt_on_nonfinite=true and resilience.nonfinite are "

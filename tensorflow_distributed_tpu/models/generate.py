@@ -18,6 +18,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from tensorflow_distributed_tpu.observe import device as observe_device
 from tensorflow_distributed_tpu.observe.registry import emit_event
 
 # --- compiled-program cache accounting ---------------------------------
@@ -155,7 +156,14 @@ def _compiled(model, max_new_tokens: int, temperature: float,
             None, length=max_new_tokens - 1)
         return jnp.concatenate([first[:, None], toks.T], axis=1)
 
-    return run
+    # The registry name carries the FULL lru key beyond the model:
+    # distinct sampler knobs are distinct resident executables, and
+    # aliasing them under one name would make the HBM budget rollup
+    # undercount what actually stays loaded.
+    name = f"generate_n{max_new_tokens}"
+    if temperature != 0.0:
+        name += f"_t{temperature:g}_k{top_k}_p{top_p:g}"
+    return observe_device.instrument(name, run)
 
 
 def generate(model, params, prompt: jax.Array, max_new_tokens: int, *,
@@ -296,7 +304,9 @@ def _compiled_beam(model, max_new_tokens: int, num_beams: int,
         seq = jnp.take_along_axis(seq, order[:, :, None], axis=1)
         return seq, jnp.take_along_axis(norm, order, axis=1)
 
-    return run
+    return observe_device.instrument(
+        f"beam_search_n{max_new_tokens}_k{num_beams}"
+        f"_lp{length_penalty:g}_eos{eos_id}", run)
 
 
 def beam_search(model, params, prompt: jax.Array, max_new_tokens: int,

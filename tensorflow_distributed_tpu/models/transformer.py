@@ -140,6 +140,15 @@ class TransformerConfig:
     # "rmsnorm" (scale-only, no mean subtraction — cheaper and the
     # modern default). Both run in f32.
     norm: str = "layernorm"  # layernorm | rmsnorm
+    # Model-health activation taps (--observe.health-taps): each block
+    # sows the f32 RMS of its output into the transient "health"
+    # collection; the train step folds it into the cadence-gated
+    # per-layer health metrics (observe/health.py). Off by default —
+    # a tap is one elementwise reduction per block per step, but it
+    # also pins the residual stream as a live value, so it is a knob,
+    # not a constant. Sown only when the "health" collection is
+    # mutable (training forward passes), so eval/decode never pay it.
+    health_taps: bool = False
 
 
 def bert_base_config(**overrides) -> TransformerConfig:
@@ -480,7 +489,17 @@ class Block(nn.Module):
         else:
             y = Mlp(cfg, name="mlp")(y.astype(cfg.compute_dtype))
         y = nn.Dropout(cfg.dropout_rate, deterministic=not train)(y)
-        return x + y
+        out = x + y
+        if cfg.health_taps:
+            # f32 RMS of the block's residual-stream output, sown into
+            # the transient "health" collection (a no-op unless the
+            # caller made it mutable — train.step.apply_model does
+            # during training). The per-layer activation-scale vital:
+            # a block whose output RMS runs away precedes the loss
+            # spike by many steps.
+            self.sow("health", "act_rms", jnp.sqrt(jnp.mean(
+                jnp.square(out.astype(jnp.float32)))))
+        return out
 
 
 class _LmHead(nn.Module):

@@ -14,6 +14,12 @@ package turns every run into structured, comparable data:
 - :mod:`observe.trace` — pure-Python Chrome-trace (Perfetto) spans for
   host phases, no TPU runtime required;
 - :mod:`observe.goodput` — productive vs. restore/drain/blocked time;
+- :mod:`observe.device` — compiled-program registry: every jit site's
+  cost_analysis/memory_analysis (flops, bytes, peak-HBM estimate,
+  donated bytes) + lower/compile wall time as ``compile`` records;
+- :mod:`observe.health` — on-device per-layer training vitals (grad
+  norm, update-to-param ratio, param RMS, activation-RMS taps),
+  cadence-gated inside the jitted step;
 - :mod:`observe.hub` — the :class:`Observatory` the train loop drives;
 - :mod:`observe.report` — ``python -m ...observe.report metrics.jsonl``
   summarizer.

@@ -1,0 +1,159 @@
+"""On-device model-health telemetry: per-layer training-vitals.
+
+One global ``grad_norm`` says a run diverged; it cannot say WHERE. The
+standard per-layer vitals big-model trainers watch are computed here,
+INSIDE the jitted step (train/step.py, train/pipeline_step.py call
+:func:`stats`), per top-level parameter module (``layer_3``,
+``tok_emb``, ``lm_head``, ...):
+
+- ``grad_norm``: the module's gradient norm — a layer whose gradients
+  vanish or explode shows before the global norm moves;
+- ``update_ratio``: ||optimizer update|| / ||params|| — the classic
+  learning-rate vital (healthy training sits around 1e-3; a layer
+  pinned at 0 is frozen, one at 1e-1 is being rewritten every step);
+- ``param_rms``: RMS of the module's parameters — slow drift here is
+  the norm-growth signature that precedes loss spikes;
+- optionally ``act_rms`` (``TransformerConfig.health_taps``): RMS of
+  each block's output, sown from inside the transformer into the
+  transient "health" collection and folded into the same records.
+
+Cadence discipline: the stats are CADENCE-GATED ON DEVICE — a
+``lax.cond`` on a traced ``(step + 1) % health_every == 0`` flag
+computes the norms only on emitting steps, and the scalars ride the
+EXISTING metrics pytree, so off-cadence steps pay neither compute nor
+any extra host transfer (the loop's single cadence ``device_get``
+already carries the whole dict). The ``health_emit`` metric tells the
+host which fetches hold real values.
+
+Host side, :func:`split` separates the health scalars from the task
+metrics (so stdout logs stay readable) and :func:`group` reshapes them
+into per-module ``health`` records for the registry/JSONL; the report
+tool's "Health" section summarizes worst update-ratios and grad-norm
+trends per module.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Iterator, Tuple
+
+PREFIX = "health/"
+EMIT_KEY = "health_emit"
+
+
+# --- inside-jit (device) ------------------------------------------------
+
+def _module_stats(params: Any, grads: Any, updates: Any
+                  ) -> Dict[str, Any]:
+    """The per-top-level-module vitals, as flat ``health/<module>/<stat>``
+    f32 scalars. Runs under jit (called from the step builders)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    stats: Dict[str, Any] = {}
+    if not isinstance(grads, dict) or not grads:
+        grads, params, updates = ({"params": grads}, {"params": params},
+                                  {"params": updates})
+    for key in sorted(grads):
+        g = optax.global_norm(grads[key]).astype(jnp.float32)
+        u = optax.global_norm(updates[key]).astype(jnp.float32)
+        p = optax.global_norm(params[key]).astype(jnp.float32)
+        n = sum(x.size for x in jax.tree_util.tree_leaves(params[key]))
+        stats[f"{PREFIX}{key}/grad_norm"] = g
+        stats[f"{PREFIX}{key}/update_ratio"] = u / (p + 1e-12)
+        stats[f"{PREFIX}{key}/param_rms"] = p / math.sqrt(max(n, 1))
+    return stats
+
+
+def stats(params: Any, grads: Any, updates: Any, step: Any,
+          health_every: int) -> Dict[str, Any]:
+    """Cadence-gated vitals for one step (traced context).
+
+    ``step`` is the state's PRE-increment counter, so the emit flag
+    fires exactly when the loop's 1-based step id hits the cadence.
+    The ``lax.cond`` puts the norm reductions inside the taken branch:
+    off-cadence steps compute a handful of zeros, not O(params) reads.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    modules = (sorted(grads) if isinstance(grads, dict) and grads
+               else ["params"])
+    keys = [f"{PREFIX}{m}/{s}" for m in modules
+            for s in ("grad_norm", "param_rms", "update_ratio")]
+    emit = ((step + 1) % health_every) == 0
+
+    def _zeros(p, g, u):
+        return {k: jnp.zeros((), jnp.float32) for k in keys}
+
+    def _live(p, g, u):
+        return _module_stats(p, g, u)
+
+    out = jax.lax.cond(emit, _live, _zeros, params, grads, updates)
+    out[EMIT_KEY] = emit.astype(jnp.float32)
+    return out
+
+
+def gate(metrics: Dict[str, Any], emit: Any) -> Dict[str, Any]:
+    """Zero every ``health/`` scalar off-cadence (the activation taps
+    are computed in the forward pass regardless — cheap elementwise
+    reductions — but must not emit stale values between cadences)."""
+    import jax.numpy as jnp
+
+    return {k: (jnp.where(emit, v, jnp.zeros_like(v))
+                if k.startswith(PREFIX) else v)
+            for k, v in metrics.items()}
+
+
+def flatten_taps(taps: Any) -> Dict[str, Any]:
+    """Sown "health" collection -> flat ``health/<module>/<stat>``
+    scalars. Sow appends a tuple per call; one forward sows once, so
+    the first element is the value (a scan/accum over microbatches
+    means the metrics pipeline averages them downstream)."""
+    import jax.numpy as jnp
+
+    flat: Dict[str, Any] = {}
+
+    def walk(node: Any, path: Tuple[str, ...]) -> None:
+        if isinstance(node, dict):
+            for k in sorted(node):
+                walk(node[k], path + (str(k),))
+            return
+        if isinstance(node, (tuple, list)):
+            node = node[0] if len(node) == 1 else sum(node) / len(node)
+        flat[PREFIX + "/".join(path)] = jnp.asarray(
+            node, jnp.float32).reshape(())
+
+    walk(taps, ())
+    return flat
+
+
+# --- host side ----------------------------------------------------------
+
+def split(host_metrics: Dict[str, float]
+          ) -> Tuple[Dict[str, float], Dict[str, float], bool]:
+    """(task metrics, health scalars, emitted?) from one fetched
+    metrics dict — the loop logs the first, records the second only
+    when the device's emit flag fired."""
+    plain = {k: v for k, v in host_metrics.items()
+             if not k.startswith(PREFIX) and k != EMIT_KEY}
+    health = {k: v for k, v in host_metrics.items()
+              if k.startswith(PREFIX)}
+    emitted = float(host_metrics.get(EMIT_KEY, 0.0)) > 0
+    return plain, health, emitted
+
+
+def group(health: Dict[str, float]
+          ) -> Iterator[Tuple[str, Dict[str, float]]]:
+    """``health/<module>/<stat>`` scalars -> per-module field dicts,
+    ready to emit as one ``health`` record per module."""
+    by_module: Dict[str, Dict[str, float]] = {}
+    for key, val in health.items():
+        rest = key[len(PREFIX):]
+        module, _, stat = rest.rpartition("/")
+        if not module:
+            module, stat = rest, "value"
+        by_module.setdefault(module, {})[stat] = float(val)
+    for module in sorted(by_module):
+        yield module, by_module[module]

@@ -18,6 +18,7 @@ import contextlib
 import time
 from typing import Any, Dict, Iterator, Optional
 
+from tensorflow_distributed_tpu.observe import device as device_mod
 from tensorflow_distributed_tpu.observe import goodput as goodput_mod
 from tensorflow_distributed_tpu.observe import mfu as mfu_mod
 from tensorflow_distributed_tpu.observe.goodput import GoodputCounter
@@ -65,11 +66,22 @@ class Observatory:
         self.items_per_step = items_per_step
         self._clock = clock
         self._last_log: Optional[tuple] = None  # (step, clock)
+        # Compiled-program registration (observe/device.py) arms only
+        # for runs with a SINK: the AOT pass costs one extra trace per
+        # program, which is worth paying exactly when a sink will
+        # carry the compile records (a trace-only run has nowhere
+        # durable for them — serve/run.py gates on the same
+        # condition).
+        self._programs = bool(sinks) and bool(
+            getattr(ocfg, "programs", True) if ocfg is not None
+            else True)
         if self.active:
             goodput_mod.set_active(self.goodput)
             # Library-level recovery events (checkpoint retries,
             # quarantines, watchdog stalls) flow to the same sinks.
             registry_mod.set_active(self.registry)
+        if self._programs:
+            device_mod.set_enabled(True)
 
     # -- construction -----------------------------------------------------
     @classmethod
@@ -204,6 +216,13 @@ class Observatory:
         caller-supplied run totals."""
         if not self.active:
             return
+        # Process-level HBM budget rollup over the registered compiled
+        # programs — the "how much must stay resident" companion to
+        # the per-program compile records.
+        if self._programs:
+            budget = device_mod.hbm_budget()
+            if budget:
+                self.registry.emit("hbm_budget", **budget)
         # Plain dict merge (caller fields win): the goodput ledger may
         # carry categories whose "<cat>_seconds" keys the caller also
         # reports (e.g. compile_seconds from the loop's Timer).
@@ -218,6 +237,8 @@ class Observatory:
             self.tracer.flush()
 
     def close(self) -> None:
+        if self._programs:
+            device_mod.set_enabled(False)
         if goodput_mod.get_active() is self.goodput:
             goodput_mod.set_active(None)
         if registry_mod.get_active() is self.registry:

@@ -14,11 +14,22 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+
+from tensorflow_distributed_tpu.observe import device as _device
 from typing import Any, Dict, List
 
 
 def load_records(path: str) -> List[Dict[str, Any]]:
+    """Parse a metrics JSONL, SKIPPING malformed lines.
+
+    A crashed or killed run leaves exactly the file this report exists
+    for — and possibly a truncated final line (the sink flushes per
+    record, but the OS can still cut a write mid-line at SIGKILL, and
+    NFS appends can interleave). Raising on one bad line would make the
+    report unavailable precisely when it matters: count-and-skip, note
+    it on stderr, summarize the rest."""
     records = []
+    bad, first_bad = 0, 0
     with open(path) as f:
         for i, line in enumerate(f, 1):
             line = line.strip()
@@ -26,8 +37,13 @@ def load_records(path: str) -> List[Dict[str, Any]]:
                 continue
             try:
                 records.append(json.loads(line))
-            except json.JSONDecodeError as e:
-                raise ValueError(f"{path}:{i}: not JSON ({e})") from None
+            except json.JSONDecodeError:
+                bad += 1
+                first_bad = first_bad or i
+    if bad:
+        print(f"observe.report: {path}: skipped {bad} malformed "
+              f"line(s) (first at line {first_bad}) — partial write "
+              f"from a crashed run?", file=sys.stderr)
     return records
 
 
@@ -96,7 +112,59 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         for key, val in final.items():
             if key.endswith("_seconds") or key == "goodput":
                 out[key] = val
+    # Compiled-program registry (observe/device.py "compile" records):
+    # latest record per program — name, flops, peak-HBM estimate,
+    # compile seconds — the device-side cost/memory inventory.
+    compiles = [r for r in records if r.get("event") == "compile"]
+    if compiles:
+        by_name: Dict[str, Dict[str, Any]] = {}
+        for r in compiles:
+            if r.get("program"):
+                by_name[r["program"]] = r
+        out["programs"] = [
+            {"program": name,
+             "flops": rec.get("flops"),
+             "peak_hbm_bytes": rec.get("peak_hbm_bytes"),
+             "donated_bytes": rec.get("donated_bytes"),
+             "compile_s": rec.get("compile_s")}
+            for name, rec in sorted(by_name.items())]
+        budgets = [r for r in records if r.get("event") == "hbm_budget"]
+        if budgets and "peak_hbm_bytes_sum" in budgets[-1]:
+            out["peak_hbm_bytes_sum"] = budgets[-1]["peak_hbm_bytes_sum"]
+    # Per-module health records (observe/health.py): worst update
+    # ratio over the run plus first->last grad-norm trend per module.
+    healths = [r for r in records if r.get("event") == "health"]
+    if healths:
+        by_module: Dict[str, List[Dict[str, Any]]] = {}
+        for r in healths:
+            if r.get("module"):
+                by_module.setdefault(r["module"], []).append(r)
+        health_out: Dict[str, Dict[str, Any]] = {}
+        for module, recs in sorted(by_module.items()):
+            entry: Dict[str, Any] = {"records": len(recs)}
+            ratios = [(float(r["update_ratio"]), int(r.get("step", 0)))
+                      for r in recs
+                      if isinstance(r.get("update_ratio"), (int, float))]
+            if ratios:
+                worst, at = max(ratios)
+                entry["worst_update_ratio"] = round(worst, 8)
+                entry["worst_update_ratio_step"] = at
+            gnorms = [float(r["grad_norm"]) for r in recs
+                      if isinstance(r.get("grad_norm"), (int, float))]
+            if gnorms:
+                entry["grad_norm_first"] = round(gnorms[0], 8)
+                entry["grad_norm_last"] = round(gnorms[-1], 8)
+            for key in ("param_rms", "act_rms"):
+                vals = [float(r[key]) for r in recs
+                        if isinstance(r.get(key), (int, float))]
+                if vals:
+                    entry[f"{key}_last"] = round(vals[-1], 8)
+            health_out[module] = entry
+        out["health"] = health_out
     return out
+
+
+
 
 
 def render(summary: Dict[str, Any]) -> str:
@@ -110,12 +178,47 @@ def render(summary: Dict[str, Any]) -> str:
              "serve_tok_ms_mean", "serve_tokens_per_sec",
              "serve_mean_slot_occupancy", "serve_total_new_tokens",
              "serve_prefill_compiles")
+    # programs/health render as their own sections below;
+    # peak_hbm_bytes_sum renders as the Programs TOTAL row.
+    sections = ("programs", "health", "peak_hbm_bytes_sum")
     for key in order:
         if key in summary:
             lines.append(f"  {key:<22} {summary[key]}")
-    extras = [k for k in sorted(summary) if k not in order]
+    extras = [k for k in sorted(summary)
+              if k not in order and k not in sections]
     for key in extras:
         lines.append(f"  {key:<22} {summary[key]}")
+    if "programs" in summary:
+        lines.append("Programs")
+        for p in summary["programs"]:
+            flops = ("-" if p.get("flops") is None
+                     else f"{p['flops']:.3g}")
+            comp = ("-" if p.get("compile_s") is None
+                    else f"{p['compile_s']:.3f}s")
+            lines.append(
+                f"  {p['program']:<28} flops={flops:<10} "
+                f"peak_hbm={_device.human_bytes(p.get('peak_hbm_bytes')):<10} "
+                f"compile={comp}")
+        if "peak_hbm_bytes_sum" in summary:
+            lines.append(f"  {'TOTAL (all resident)':<28} "
+                         f"peak_hbm="
+                         f"{_device.human_bytes(summary['peak_hbm_bytes_sum'])}")
+    if "health" in summary:
+        lines.append("Health")
+        for module, entry in summary["health"].items():
+            parts = []
+            if "worst_update_ratio" in entry:
+                parts.append(
+                    f"worst_update_ratio={entry['worst_update_ratio']:.2e}"
+                    f"@{entry['worst_update_ratio_step']}")
+            if "grad_norm_first" in entry:
+                parts.append(
+                    f"grad_norm={entry['grad_norm_first']:.3g}->"
+                    f"{entry['grad_norm_last']:.3g}")
+            for key in ("param_rms_last", "act_rms_last"):
+                if key in entry:
+                    parts.append(f"{key}={entry[key]:.3g}")
+            lines.append(f"  {module:<28} " + " ".join(parts))
     return "\n".join(lines)
 
 

@@ -39,6 +39,7 @@ import numpy as np
 from tensorflow_distributed_tpu.analysis import runtime as graftcheck
 from tensorflow_distributed_tpu.models.generate import (
     decode_token, lookup_program, prefill_cache)
+from tensorflow_distributed_tpu.observe import device as observe_device
 from tensorflow_distributed_tpu.serve.buckets import (
     default_buckets, pick_bucket)
 
@@ -58,7 +59,7 @@ def _compiled_prefill(model, bucket: int):
             logits, true_len - 1, axis=1, keepdims=False)   # [1, V]
         return cache, jnp.argmax(last, axis=-1).astype(jnp.int32)
 
-    return run
+    return observe_device.instrument(f"serve_prefill_b{bucket}", run)
 
 
 @functools.lru_cache(maxsize=8)
@@ -72,11 +73,11 @@ def _compiled_step(model):
         last, cache = decode_token(model, params, cache, tok, pos)
         return cache, jnp.argmax(last, axis=-1).astype(jnp.int32)
 
-    return run
+    return observe_device.instrument("serve_decode_step", run)
 
 
 @jax.jit
-def _insert_row(cache, row, slot):
+def _insert_row_jit(cache, row, slot):
     """Drop a prefilled [1, ...] cache row into ``slot`` of the engine
     cache — ``slot`` is traced, so all slots share the program. Scalar
     leaves (the compat ``index``) pass through: positions are the
@@ -89,6 +90,10 @@ def _insert_row(cache, row, slot):
         return c
 
     return jax.tree_util.tree_map(put, cache, row)
+
+
+_insert_row = observe_device.instrument("serve_insert_row",
+                                        _insert_row_jit)
 
 
 class SlotDecodeEngine:

@@ -89,6 +89,10 @@ def serve_run(cfg: TrainConfig) -> Dict:
     """Run the serve workload; returns the summary dict (per-request
     records ride the observe JSONL)."""
     cfg.validate()
+    from tensorflow_distributed_tpu.observe import (
+        device as observe_device)
+    from tensorflow_distributed_tpu.observe import (
+        registry as registry_mod)
     from tensorflow_distributed_tpu.observe.registry import (
         JsonlSink, MetricsRegistry, host_tags)
     from tensorflow_distributed_tpu.parallel.mesh import (
@@ -160,6 +164,15 @@ def serve_run(cfg: TrainConfig) -> Dict:
     registry = MetricsRegistry(sinks=sinks, enabled=is_chief(),
                                tags=host_tags(mesh, cfg),
                                max_records=cfg.observe.max_records)
+    # Install as the process's active registry so library-level events
+    # (the engine's compiled-program registrations, generate's
+    # compile-cache misses) land in this run's JSONL; arm the program
+    # registry under the same sink-configured condition the training
+    # Observatory uses.
+    registry_mod.set_active(registry)
+    programs_armed = bool(sinks) and cfg.observe.programs
+    if programs_armed:
+        observe_device.set_enabled(True)
     on_token = None
     if cfg.serve.stream and is_chief():
         def on_token(rid: int, tok: int, done: bool) -> None:
@@ -172,7 +185,15 @@ def serve_run(cfg: TrainConfig) -> Dict:
                       registry=registry, on_token=on_token)
     try:
         done = sched.run(requests)
+        if programs_armed:
+            budget = observe_device.hbm_budget()
+            if budget:
+                registry.emit("hbm_budget", **budget)
     finally:
+        if programs_armed:
+            observe_device.set_enabled(False)
+        if registry_mod.get_active() is registry:
+            registry_mod.set_active(None)
         registry.close()
     summary = dict(sched.summary)
     ttfts = np.asarray([c.ttft_s for c in done])

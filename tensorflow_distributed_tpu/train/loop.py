@@ -21,6 +21,7 @@ from tensorflow_distributed_tpu.config import TrainConfig
 from tensorflow_distributed_tpu.data import prefetch_to_mesh
 from tensorflow_distributed_tpu.models import build_model
 from tensorflow_distributed_tpu.observe import Observatory
+from tensorflow_distributed_tpu.observe import health as health_mod
 from tensorflow_distributed_tpu.observe.registry import host_tags
 from tensorflow_distributed_tpu.parallel import make_mesh
 from tensorflow_distributed_tpu.parallel.mesh import bootstrap, is_chief
@@ -149,6 +150,12 @@ def _build_model_and_state(cfg: TrainConfig, mesh, task):
             # (ring attention engages via mesh.seq; the data stream
             # gets the same length through train.tasks).
             size_kw["max_len"] = cfg.seq_len
+    if (cfg.observe.health and cfg.observe.health_taps
+            and cfg.model in ("bert_mlm", "gpt_lm", "moe_lm")):
+        # Activation-RMS taps in the transformer blocks (config
+        # rejects the pipelined combination — no sow path out of its
+        # manual shard_map).
+        size_kw["health_taps"] = True
     if cfg.model == "pipelined_lm":
         size_kw["num_microbatches"] = cfg.pipeline_microbatches
         if cfg.pipeline_virtual_stages > 1:
@@ -406,6 +413,13 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
         params_out = (jax.tree_util.tree_map(lambda a: a.sharding,
                                              state.params)
                       if cfg.param_partition == "zero1" else None)
+        # On-device health telemetry cadence (observe/health.py): the
+        # vitals ride the log-cadence metrics fetch, so the default
+        # cadence IS log_every (health_every must be a multiple —
+        # config.validate enforces it).
+        health_every = 0
+        if cfg.observe.health:
+            health_every = cfg.observe.health_every or cfg.log_every
         if cfg.model == "pipelined_lm" and cfg.pipeline_schedule == "1f1b":
             from tensorflow_distributed_tpu.train.pipeline_step import (
                 make_1f1b_train_step)
@@ -418,7 +432,8 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
                                            ema_decay=cfg.ema_decay,
                                            backward=cfg.pipeline_backward,
                                            ce_chunk=cfg.ce_chunk,
-                                           params_out_shardings=params_out)
+                                           params_out_shardings=params_out,
+                                           health_every=health_every)
         elif local_sgd:
             from tensorflow_distributed_tpu.train.local_sgd import (
                 make_local_sgd_train_step)
@@ -435,7 +450,8 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
                 ema_decay=cfg.ema_decay,
                 params_out_shardings=params_out,
                 skip_nonfinite=(policy is not None
-                                and policy.mode == "skip_batch"))
+                                and policy.mode == "skip_batch"),
+                health_every=health_every)
         eval_fn = make_eval_step(mesh, loss=task.eval_loss or task.loss,
                                  batch_shardings=task.batch_shardings)
         # 1F1B-recompute steps advertise their extra executed FLOPs
@@ -477,6 +493,18 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
                 # graftcheck: disable=host-sync-in-loop -- the log fetch,
                 # gated on log_every by the line above
                 host_metrics = jax.device_get(metrics)
+                # Health scalars travel in the SAME fetch but are
+                # per-module records, not step-log columns: split them
+                # off so stdout stays readable, and emit them only
+                # when the device's cadence flag says they're real
+                # (observe/health.py).
+                host_metrics, health, health_emitted = health_mod.split(
+                    host_metrics)
+                if health_emitted and health:
+                    for module, fields in health_mod.group(health):
+                        obs.emit("health", step=step_now, module=module,
+                                 **{k: round(v, 8)
+                                    for k, v in fields.items()})
                 logger.log(step_now, **host_metrics)
                 obs.log_step(step_now, host_metrics)
                 if cfg.halt_on_nonfinite and not np.isfinite(

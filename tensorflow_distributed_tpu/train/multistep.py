@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tensorflow_distributed_tpu.observe import device as observe_device
 from tensorflow_distributed_tpu.train.state import TrainState
 from tensorflow_distributed_tpu.train.step import (
     LossFn, Metrics, default_batch_shardings, loss_fn, make_train_step)
@@ -37,18 +38,23 @@ def stacked_batch_shardings(mesh: Mesh, batch_shardings: Any = None) -> Any:
 def make_multi_step(mesh: Mesh, seed: int = 0, loss: LossFn = loss_fn,
                     batch_shardings: Any = None,
                     preprocess: Optional[Callable[[Any], Any]] = None,
-                    accum_steps: int = 1
+                    accum_steps: int = 1,
+                    health_every: int = 0
                     ) -> Callable[[TrainState, Any],
                                   Tuple[TrainState, Metrics]]:
     """Build ``fn(state, stacked_batches) -> (state, metrics_of_last)``.
 
     ``stacked_batches`` leaves carry a leading K dim (any K; one compile
     per K). ``preprocess`` runs on-device on each scanned slice before
-    the step (e.g. u8 -> f32 normalize).
+    the step (e.g. u8 -> f32 normalize). ``health_every`` threads the
+    per-module health cadence into the inner step (train.step); the
+    returned metrics being the LAST scanned step's, a cadence that
+    divides K reports the vitals of that dispatch's final step.
     """
     base = make_train_step(mesh, seed=seed, loss=loss,
                            batch_shardings=batch_shardings,
-                           accum_steps=accum_steps, jit=False)
+                           accum_steps=accum_steps, jit=False,
+                           health_every=health_every)
 
     def run(state: TrainState, batches: Any) -> Tuple[TrainState, Metrics]:
         def body(s, b):
@@ -62,9 +68,9 @@ def make_multi_step(mesh: Mesh, seed: int = 0, loss: LossFn = loss_fn,
         return state, jax.tree_util.tree_map(lambda m: m[-1], metrics)
 
     with mesh:
-        return jax.jit(
+        return observe_device.instrument("multi_step", jax.jit(
             run,
             in_shardings=(None, stacked_batch_shardings(mesh,
                                                         batch_shardings)),
             donate_argnums=(0,),
-        )
+        ))

@@ -38,6 +38,8 @@ import optax
 from jax.sharding import Mesh
 
 from tensorflow_distributed_tpu.models.pipelined import PipelinedLM
+from tensorflow_distributed_tpu.observe import device as observe_device
+from tensorflow_distributed_tpu.observe import health as observe_health
 from tensorflow_distributed_tpu.ops.losses import masked_ce_sums
 from tensorflow_distributed_tpu.parallel.pipeline import (
     interleaved_pipeline_value_and_grad, pipeline_value_and_grad)
@@ -57,7 +59,8 @@ def make_1f1b_train_step(model: PipelinedLM, mesh: Mesh, seed: int = 0,
                          ema_decay: float = 0.0,
                          backward: str = "recompute",
                          ce_chunk: int = 0,
-                         params_out_shardings: Any = None
+                         params_out_shardings: Any = None,
+                         health_every: int = 0
                          ) -> Callable[[TrainState, Any],
                                        Tuple[TrainState, Dict]]:
     """Build the jitted 1F1B step for a PipelinedLM.
@@ -90,6 +93,12 @@ def make_1f1b_train_step(model: PipelinedLM, mesh: Mesh, seed: int = 0,
     pipe(/TP)-only param layout — without it the slot sharding
     propagates into the params and the next step's pipe shard_map
     pays per-use data-axis gathers (see train.step's twin note).
+
+    ``health_every`` (observe.health): cadence-gated per-top-module
+    vitals like the standard step's — here the modules are "shell"
+    (embedding + head) and "blocks" (the [S, ...] stage stack), the
+    partition the pipelined param tree actually has. Activation taps
+    are not available (the stage fn runs inside a manual shard_map).
     """
     if batch_shardings is None:
         batch_shardings = mlm_batch_shardings(mesh)
@@ -182,6 +191,9 @@ def make_1f1b_train_step(model: PipelinedLM, mesh: Mesh, seed: int = 0,
 
         updates, new_opt = state.tx.update(grads, state.opt_state,
                                            state.params)
+        health = (observe_health.stats(state.params, grads, updates,
+                                       state.step, health_every)
+                  if health_every else {})
         new_params = jax.tree_util.tree_map(
             lambda p, u: p + u.astype(p.dtype), state.params, updates)
         if params_out_shardings is not None:
@@ -190,7 +202,7 @@ def make_1f1b_train_step(model: PipelinedLM, mesh: Mesh, seed: int = 0,
                 params_out_shardings)
         metrics = {"loss": ce_sum / total,
                    "accuracy": sums["correct"] / jnp.maximum(
-                       sums["mask"], 1.0), **aux_metrics}
+                       sums["mask"], 1.0), **aux_metrics, **health}
         if grad_norm_metric:
             metrics["grad_norm"] = optax.global_norm(grads)
         new_ema = state.ema
@@ -205,14 +217,16 @@ def make_1f1b_train_step(model: PipelinedLM, mesh: Mesh, seed: int = 0,
         step.observe_hw_recompute = (backward == "recompute")
         return step
     with mesh:
-        jitted = jax.jit(
+        jitted = observe_device.instrument("pipelined_train_step", jax.jit(
             step,
             in_shardings=(None, batch_shardings),
             donate_argnums=(0,) if donate else (),
-        )
+        ))
     # Observability metadata: the recompute backward EXECUTES ~4x-forward
     # for the block stack while model-FLOPs accounting credits 3x;
     # observe.hub reads this to report hw_mfu alongside model MFU
-    # (observe.mfu.pipelined_hw_flops_per_token).
+    # (observe.mfu.pipelined_hw_flops_per_token). The instrument wrapper
+    # is a plain function, so the attribute rides it like it rode the
+    # PjitFunction.
     jitted.observe_hw_recompute = (backward == "recompute")
     return jitted

@@ -28,7 +28,7 @@ from tensorflow_distributed_tpu.utils import prng
 # re-feed it to apply() every step, where sow's tuple-append semantics
 # would stack fresh values on the stale constant (biasing e.g. the MoE
 # load-balance loss) and bloat every checkpoint.
-TRANSIENT_COLLECTIONS = ("moe_aux", "intermediates")
+TRANSIENT_COLLECTIONS = ("moe_aux", "intermediates", "health")
 
 
 @struct.dataclass

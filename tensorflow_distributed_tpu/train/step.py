@@ -33,6 +33,8 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh
 
+from tensorflow_distributed_tpu.observe import device as observe_device
+from tensorflow_distributed_tpu.observe import health as observe_health
 from tensorflow_distributed_tpu.ops.losses import accuracy, softmax_cross_entropy
 from tensorflow_distributed_tpu.parallel.sharding import batch_sharding, replicated
 from tensorflow_distributed_tpu.train.state import TrainState, ema_update
@@ -56,10 +58,17 @@ def apply_model(apply_fn: Callable, params: Any, extra: Any, inputs: Any,
     computed over the *global* (sharded) batch inside jit, so XLA inserts
     the cross-replica stats allreduce automatically — the SPMD analog of
     synchronized BatchNorm.
+
+    Training passes also open the transient "health" collection so the
+    transformer blocks' optional activation-RMS taps (``health_taps``,
+    observe/health.py) can sow; models without taps sow nothing and
+    the collection never materializes. When present it rides
+    ``new_extra`` to the step builder, which folds it into the metrics
+    (``_pop_taps``) — it is never fed back into the model.
     """
     variables = {"params": params, **extra}
     rngs = {"dropout": dropout_key} if train else {}
-    mutable = list(extra) if (train and extra) else False
+    mutable = (list(extra) + ["health"]) if train else False
     if mutable:
         out, new_vars = apply_fn(variables, inputs, train=train, rngs=rngs,
                                  mutable=mutable)
@@ -85,6 +94,19 @@ def default_batch_shardings(mesh: Mesh):
     return (batch_sharding(mesh, 4), batch_sharding(mesh, 1))
 
 
+def _pop_taps(metrics: Metrics, new_extra: Any) -> Tuple[Metrics, Any]:
+    """Fold the sown "health" collection (activation-RMS taps) out of
+    the forward's mutated collections and into the metrics dict — the
+    taps are per-step telemetry, not state, and must never persist
+    into TrainState.extra (state.TRANSIENT_COLLECTIONS agrees)."""
+    if isinstance(new_extra, dict) and "health" in new_extra:
+        new_extra = dict(new_extra)
+        taps = new_extra.pop("health")
+        metrics = dict(metrics,
+                       **observe_health.flatten_taps(taps))
+    return metrics, new_extra
+
+
 def make_train_step(mesh: Mesh, seed: int = 0, donate: bool = True,
                     loss: LossFn = loss_fn,
                     batch_shardings: Any = None,
@@ -93,7 +115,8 @@ def make_train_step(mesh: Mesh, seed: int = 0, donate: bool = True,
                     grad_norm_metric: bool = False,
                     ema_decay: float = 0.0,
                     params_out_shardings: Any = None,
-                    skip_nonfinite: bool = False
+                    skip_nonfinite: bool = False,
+                    health_every: int = 0
                     ) -> Callable[[TrainState, Batch],
                                   Tuple[TrainState, Metrics]]:
     """Build the jitted train step for a mesh.
@@ -133,6 +156,14 @@ def make_train_step(mesh: Mesh, seed: int = 0, donate: bool = True,
     reports 1.0 on a skipped step. The select is replicated-by-
     construction (loss and grad norm are global reductions), so every
     device takes the same branch — multi-host safe.
+
+    ``health_every`` (observe.health): every that-many steps the step
+    computes per-top-level-module training vitals — grad norm,
+    update-to-param ratio, param RMS (observe/health.py) — ON DEVICE,
+    gated by a ``lax.cond`` on the traced step counter so off-cadence
+    steps pay neither the norm reductions nor any extra transfer (the
+    scalars ride the existing metrics pytree; ``health_emit`` flags
+    the real fetches). 0 = off (metric dict unchanged).
     """
 
     if batch_shardings is None:
@@ -143,6 +174,10 @@ def make_train_step(mesh: Mesh, seed: int = 0, donate: bool = True,
             partial(loss, state.apply_fn), has_aux=True)
         (_, (metrics, new_extra)), grads = grad_fn(
             state.params, state.extra, batch, dkey, True)
+        # Activation-RMS taps (sown "health" collection) become
+        # metrics HERE so the accum scan's carry keeps state.extra's
+        # structure.
+        metrics, new_extra = _pop_taps(metrics, new_extra)
         return grads, metrics, new_extra
 
     def step(state: TrainState, batch: Batch) -> Tuple[TrainState, Metrics]:
@@ -203,6 +238,16 @@ def make_train_step(mesh: Mesh, seed: int = 0, donate: bool = True,
             metrics = dict(metrics,
                            skipped_nonfinite=jnp.where(ok, 0.0, 1.0))
         updates, new_opt = state.tx.update(grads, state.opt_state, state.params)
+        if health_every:
+            # Per-module vitals, computed inside a lax.cond on the
+            # cadence flag (observe/health.py): off-cadence steps pay
+            # a few zeros. gate() also zeroes the activation taps
+            # between cadences so every health/ scalar shares one
+            # validity flag.
+            metrics = dict(metrics, **observe_health.stats(
+                state.params, grads, updates, state.step, health_every))
+            metrics = observe_health.gate(
+                metrics, metrics[observe_health.EMIT_KEY] > 0)
         new_params = jax.tree_util.tree_map(
             lambda p, u: (p + u.astype(p.dtype)), state.params, updates)
         if params_out_shardings is not None:
@@ -250,11 +295,11 @@ def make_train_step(mesh: Mesh, seed: int = 0, donate: bool = True,
         # program (train.multistep's scan).
         return step
     with mesh:
-        return jax.jit(
+        return observe_device.instrument("train_step", jax.jit(
             step,
             in_shardings=(None, batch_shardings),
             donate_argnums=(0,) if donate else (),
-        )
+        ))
 
 
 def make_eval_step(mesh: Mesh, loss: LossFn = loss_fn,
@@ -275,8 +320,8 @@ def make_eval_step(mesh: Mesh, loss: LossFn = loss_fn,
         return metrics
 
     with mesh:
-        return jax.jit(
+        return observe_device.instrument("eval_step", jax.jit(
             step,
             in_shardings=(None, batch_shardings),
             out_shardings=replicated(mesh),
-        )
+        ))

@@ -128,7 +128,11 @@ def make_mlm_loss(label_smoothing: float = 0.0, ce_chunk: int = 0,
         if ce_chunk:
             variables = {"params": params, **extra}
             rngs = {"dropout": dropout_key} if train else {}
-            mutable = list(extra) if (train and extra) else False
+            # "health" mirrors apply_model's contract: the transformer
+            # blocks' optional activation taps sow into it during
+            # training; the step builder pops it out of new_extra into
+            # the metrics (train.step._pop_taps).
+            mutable = (list(extra) + ["health"]) if train else False
             loss, acc, mut = _fused_lm_metrics(
                 apply_fn, variables, batch, rngs, train, label_smoothing,
                 ce_chunk, mutable=mutable, ce_impl=ce_impl, mesh=mesh)
@@ -170,14 +174,18 @@ def make_moe_loss(aux_weight: float = MOE_AUX_WEIGHT,
         variables = {"params": params,
                      **{k: v for k, v in extra.items() if k != "moe_aux"}}
         rngs = {"dropout": dropout_key} if train else {}
+        # "health" rides along like in apply_model so the activation
+        # taps (TransformerConfig.health_taps) reach the step builder;
+        # harmless at eval (nothing sows without a training pass).
+        mutable = ["moe_aux", "health"] if train else ["moe_aux"]
         if ce_chunk:
             loss, acc, mut = _fused_lm_metrics(
                 apply_fn, variables, batch, rngs, train, label_smoothing,
-                ce_chunk, mutable=["moe_aux"], ce_impl=ce_impl,
+                ce_chunk, mutable=mutable, ce_impl=ce_impl,
                 mesh=mesh)
         else:
             logits, mut = apply_fn(variables, batch["tokens"], train=train,
-                                   rngs=rngs, mutable=["moe_aux"])
+                                   rngs=rngs, mutable=mutable)
             loss = masked_softmax_cross_entropy(
                 logits, batch["targets"], batch["mask"], label_smoothing)
             acc = masked_accuracy(logits, batch["targets"], batch["mask"])
@@ -190,7 +198,13 @@ def make_moe_loss(aux_weight: float = MOE_AUX_WEIGHT,
             "dropped_frac": aux.get("dropped_fraction", 0.0),
             "accuracy": acc,
         }
-        return total, (metrics, extra)
+        new_extra = extra
+        if "health" in mut:
+            # Sown activation taps ride new_extra to the step builder
+            # (train.step._pop_taps strips them back out — they never
+            # persist into TrainState like moe_aux never does).
+            new_extra = {**extra, "health": mut["health"]}
+        return total, (metrics, new_extra)
 
     return moe_loss
 

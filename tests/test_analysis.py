@@ -599,12 +599,20 @@ def test_census_structure_sane():
 
     golden = jaxprcheck.load_golden()
     assert set(golden) == {"gpt_train", "moe_train", "pipelined_train",
-                           "serve_decode"}
+                           "serve_decode", "gpt_train_health",
+                           "moe_train_health",
+                           "pipelined_train_health"}
     assert golden["pipelined_train"]["collectives"].get("ppermute", 0) > 0
     assert golden["gpt_train"]["collectives"] == {}
     assert golden["serve_decode"]["collectives"] == {}
     for prog in golden.values():
         assert prog["upcasts"].get("bfloat16->float32", 0) > 0
+    # The device-telemetry invariant the health entries exist to pin:
+    # enabling per-layer vitals adds NO collectives to any schedule
+    # (the stats are local reductions riding the existing metrics).
+    for name in ("gpt_train", "moe_train", "pipelined_train"):
+        assert (golden[f"{name}_health"]["collectives"]
+                == golden[name]["collectives"]), name
 
 
 def test_census_drift_reporting():

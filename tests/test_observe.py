@@ -413,12 +413,18 @@ def test_report_summarizes_jsonl(tmp_path, capsys):
     assert s["first_loss"] == 3.0 and s["last_loss"] == 2.5
 
 
-def test_report_bad_file_exits_nonzero(tmp_path, capsys):
+def test_report_bad_lines_skipped_missing_file_exits_nonzero(
+        tmp_path, capsys):
+    """Malformed lines are counted-and-skipped with a stderr note —
+    crash-time metrics are exactly when the report matters (the old
+    behavior raised and reported nothing). A MISSING file is still a
+    hard error."""
     from tensorflow_distributed_tpu.observe import report
 
     bad = tmp_path / "bad.jsonl"
     bad.write_text("{not json\n")
-    assert report.main([str(bad)]) == 1
+    assert report.main([str(bad)]) == 0
+    assert "skipped 1 malformed line(s)" in capsys.readouterr().err
     assert report.main([str(tmp_path / "missing.jsonl")]) == 1
 
 
