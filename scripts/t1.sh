@@ -49,9 +49,45 @@ if ! has_summary_line; then
 fi
 
 echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | wc -c)"
+
+# Firebench smoke (serve-under-fire: slot-NaN containment, live weight
+# swap, SIGKILL + journal resume — benchmarks/firebench.py): tiny
+# config, 2 slots, CPU. The smoke gates CORRECTNESS (zero lost
+# requests, 100% token identity, every drill actually fired) plus a
+# 0.3 sanity floor on goodput — at smoke scale (~0.3 s of serving)
+# the injected stall alone dominates the wall, so the real >= 0.8
+# goodput gate lives in the committed FIREBENCH.json run, not here.
+# Same abort-guard shape as the pytest rerun: a run that dies to the
+# known container XLA:CPU abort prints no fire_checks line and is
+# retried once; a genuine gate failure prints one and is NOT retried.
+FIRELOG="${FIRELOG:-/tmp/_t1_fire.log}"
+run_firebench() {
+  rm -f "$FIRELOG"
+  timeout -k 10 300 env JAX_PLATFORMS=cpu python -m \
+    tensorflow_distributed_tpu.benchmarks.firebench \
+    --requests 12 --new-tokens 32 --seq-len 48 --stall-s 0.2 \
+    --min-goodput 0.3 --out "" 2>&1 | tee "$FIRELOG"
+  return "${PIPESTATUS[0]}"
+}
+run_firebench
+fire_rc=$?
+if ! grep -qa '"metric": "fire_checks"' "$FIRELOG"; then
+  echo "[t1] no fire_checks line in $FIRELOG (known container" \
+       "XLA:CPU abort) — rerunning firebench once" >&2
+  run_firebench
+  fire_rc=$?
+fi
+if [ "$fire_rc" -ne 0 ]; then
+  echo "[t1] firebench smoke FAILED (fire_rc=$fire_rc) — see" \
+       "$FIRELOG" >&2
+fi
+
 if [ "$rc" -eq 0 ] && [ "$lint_rc" -ne 0 ]; then
   echo "[t1] suite green but graftcheck red (lint_rc=$lint_rc) — see" \
        "scripts/lint.sh output above" >&2
   exit "$lint_rc"
+fi
+if [ "$rc" -eq 0 ] && [ "$fire_rc" -ne 0 ]; then
+  exit "$fire_rc"
 fi
 exit "$rc"

@@ -220,8 +220,13 @@ def _serve_decode_jaxpr():
         lambda s: jnp.zeros(s.shape, s.dtype), shapes)
 
     def run(params, cache, tok, pos):
+        # Mirrors serve/engine.py::_compiled_step: greedy token + the
+        # per-slot finiteness flag (NaN containment sensor) — the
+        # golden pins that the flag adds ZERO collectives.
         last, cache = decode_token(model, params, cache, tok, pos)
-        return cache, jnp.argmax(last, axis=-1).astype(jnp.int32)
+        ok = jnp.isfinite(last).all(axis=-1)
+        return (cache, jnp.argmax(last, axis=-1).astype(jnp.int32),
+                ok)
 
     return jax.make_jaxpr(run)(params, cache,
                                jnp.zeros((num_slots,), jnp.int32),

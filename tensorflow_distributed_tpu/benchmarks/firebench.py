@@ -1,0 +1,283 @@
+"""Serve-under-fire benchmark: the SAME workload, fault-free vs under
+the standard fault plan, with availability gates.
+
+The serving claim this pins (ISSUE 6 / ROADMAP item 5): the
+continuous-batching engine keeps answering through a decode stall, a
+slot-level NaN, a live weight swap, and a SIGKILL-and-supervise — at
+>= ``--min-goodput`` of the fault-free tokens/s, with ZERO lost
+requests, and with every surviving token IDENTICAL to the fault-free
+run (greedy decode + swap-to-the-same-checkpoint + journal-exact
+continuations make bitwise identity the correct bar, not a soft
+similarity).
+
+Procedure (all runs are CLI subprocesses, so process death is real):
+
+1. train 2 steps of the tiny GPT -> a checkpoint (the swap source AND
+   the serving weights, so fault-free and fire legs share params);
+2. BASELINE: ``--mode serve`` on a seeded synthetic workload (bursty
+   arrivals), journaled;
+3. FIRE: the same command under ``resilience.supervisor`` with
+   ``decode_stall@A:0.5s,slot_nan@B:0,reload@C,sigkill@D`` and the
+   decode watchdog armed — the kill costs a restart whose journal
+   resume re-admits in-flight requests as continuations;
+4. gates: goodput (useful tokens / SERVING wall, legs summed via the
+   journal's per-leg time segments — process startup is excluded on
+   both sides identically) >= min-goodput x baseline; 0 lost; 100%
+   token-identical; >= 1 slot retry, >= 1 weight swap, >= 1 restart
+   actually happened (a drill that never fired proves nothing).
+
+Emits one JSON line per metric plus a checks line; ``--out`` writes
+FIREBENCH.json (overwritten per run, like the sibling benchmarks);
+exit 1 on any failed gate (``--no-check`` to report without gating).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+
+def _leg_walls(journal_path: str):
+    """Per-leg serving wall times from the journal's token timestamps:
+    ``s`` is scheduler-run-relative and monotone within a leg, so a
+    drop marks the restart boundary. Returns a list of leg walls."""
+    walls, cur = [], 0.0
+    with open(journal_path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            s = rec.get("s")
+            if s is None:
+                continue
+            if s < cur - 1e-6:          # restart: the clock reset
+                walls.append(cur)
+                cur = 0.0
+            cur = max(cur, float(s))
+    walls.append(cur)
+    return walls
+
+
+def _run(cmd, env, timeout, what):
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=timeout)
+    if proc.returncode != 0:
+        print(f"firebench: {what} failed rc={proc.returncode}\n"
+              f"{proc.stdout[-3000:]}\n{proc.stderr[-2000:]}",
+              file=sys.stderr)
+        raise SystemExit(1)
+    return proc
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--size", default="tiny")
+    parser.add_argument("--requests", type=int, default=32)
+    parser.add_argument("--num-slots", type=int, default=2)
+    parser.add_argument("--prompt-len-min", type=int, default=4)
+    parser.add_argument("--prompt-len-max", type=int, default=12)
+    parser.add_argument("--new-tokens", type=int, default=192)
+    parser.add_argument("--seq-len", type=int, default=208)
+    parser.add_argument("--arrival-rate", type=float, default=32.0)
+    parser.add_argument("--trace", default="bursty")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--min-goodput", type=float, default=0.8)
+    parser.add_argument("--stall-s", type=float, default=0.3)
+    parser.add_argument("--no-warmup", action="store_true",
+                        help="skip the untimed warmup pass (first-use "
+                        "XLA compiles then land inside the measured "
+                        "serving walls)")
+    parser.add_argument("--timeout", type=float, default=420.0,
+                        help="per-subprocess timeout (s)")
+    parser.add_argument("--workdir", default="",
+                        help="scratch dir (default: a fresh tempdir, "
+                        "removed on success)")
+    parser.add_argument("--no-check", action="store_true")
+    parser.add_argument("--out", default="FIREBENCH.json")
+    args = parser.parse_args(argv)
+    if args.requests < 2 or args.num_slots < 1:
+        parser.error("--requests >= 2 and --num-slots >= 1")
+
+    work = args.workdir or tempfile.mkdtemp(prefix="firebench-")
+    os.makedirs(work, exist_ok=True)
+    ckpt = os.path.join(work, "ckpt")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYTHONUNBUFFERED"] = "1"
+
+    total_tokens = args.requests * args.new_tokens
+    # Decode-step budget ~ total tokens / slots; key the faults well
+    # inside it so every drill actually fires before the work runs dry
+    # (gated below — a plan that never fired proves nothing).
+    est_steps = max(8, total_tokens // args.num_slots)
+    k_stall = max(2, est_steps // 8)
+    k_nan = max(3, est_steps // 5)
+    k_reload = max(4, est_steps // 3)
+    k_kill = max(5, est_steps // 2)
+    plan = (f"decode_stall@{k_stall}:{args.stall_s}s,"
+            f"slot_nan@{k_nan}:0,reload@{k_reload},sigkill@{k_kill}")
+
+    common = [
+        "--model", "gpt_lm", "--model-size", args.size,
+        "--seq-len", str(args.seq_len), "--seed", str(args.seed),
+        "--compute-dtype", "float32",
+    ]
+    serve_common = common + [
+        "--mode", "serve", "--checkpoint-dir", ckpt,
+        "--serve.num-slots", str(args.num_slots),
+        "--serve.num-requests", str(args.requests),
+        "--serve.prompt-len-min", str(args.prompt_len_min),
+        "--serve.prompt-len-max", str(args.prompt_len_max),
+        "--serve.max-new-tokens", str(args.new_tokens),
+        "--serve.trace", args.trace,
+        "--serve.arrival-rate", str(args.arrival_rate),
+        # ONE prefill bucket at the cache length: continuation
+        # re-prefills (slot retry, journal resume) share the original
+        # admissions' program, so no leg ever pays a first-use XLA
+        # compile mid-measurement. Bucket-ladder economics are
+        # servebench's subject, not this bench's.
+        "--serve.buckets", str(args.seq_len),
+    ]
+
+    # 1. The checkpoint both runs serve (and the fire run swaps to).
+    _run([sys.executable, "-m", "tensorflow_distributed_tpu.cli",
+          *common, "--dataset", "synthetic", "--train-steps", "2",
+          "--batch-size", "8", "--eval-every", "0", "--log-every", "0",
+          "--checkpoint-dir", ckpt, "--checkpoint-every", "2"],
+         env, args.timeout, "checkpoint prep")
+
+    # 1b. Untimed warmup: one small serve exercises every program the
+    # measured runs dispatch (the single prefill bucket, the decode
+    # step, the row insert), so the persistent compile cache is hot
+    # and the measured walls compare SERVING, not first-use XLA
+    # compiles — which would otherwise land inside whichever leg
+    # happened to run first.
+    if not args.no_warmup:
+        warm = [a for a in serve_common]
+        warm[warm.index("--serve.num-requests") + 1] = "4"
+        warm[warm.index("--serve.max-new-tokens") + 1] = "8"
+        _run([sys.executable, "-m", "tensorflow_distributed_tpu.cli",
+              *warm], env, args.timeout, "warmup")
+
+    # 2. Fault-free baseline.
+    base_journal = os.path.join(work, "base.journal")
+    base_jsonl = os.path.join(work, "base.jsonl")
+    _run([sys.executable, "-m", "tensorflow_distributed_tpu.cli",
+          *serve_common, "--serve.journal", base_journal,
+          "--observe.metrics-jsonl", base_jsonl],
+         env, args.timeout, "baseline serve")
+
+    # 3. Serve under fire, supervised.
+    fire_journal = os.path.join(work, "fire.journal")
+    fire_jsonl = os.path.join(work, "fire.jsonl")
+    fire = _run([sys.executable, "-m",
+                 "tensorflow_distributed_tpu.resilience.supervisor",
+                 "--max-restarts", "2", "--backoff-base-s", "0.2",
+                 "--", *serve_common,
+                 "--serve.journal", fire_journal,
+                 "--observe.metrics-jsonl", fire_jsonl,
+                 "--resilience.sync-timeout-s", "120",
+                 "--resilience.fault-plan", plan],
+                env, args.timeout, "fire serve")
+    restarts = fire.stdout.count('"kind": "restart"')
+
+    # 4. Gates.
+    from tensorflow_distributed_tpu.observe.report import (
+        load_records, summarize)
+    from tensorflow_distributed_tpu.serve import journal as journal_mod
+
+    base_sum = summarize(load_records(base_jsonl))
+    fire_sum = summarize(load_records(fire_jsonl))
+    base_play = journal_mod.replay(base_journal)
+    fire_play = journal_mod.replay(fire_journal)
+
+    lost = [rid for rid in range(args.requests)
+            if not fire_play.get(rid, {}).get("done")]
+    mismatched = [rid for rid in range(args.requests)
+                  if fire_play.get(rid, {}).get("tokens")
+                  != base_play.get(rid, {}).get("tokens")]
+    base_wall = sum(_leg_walls(base_journal))
+    fire_wall = sum(_leg_walls(fire_journal))
+    base_tps = total_tokens / max(base_wall, 1e-9)
+    fire_tps = total_tokens / max(fire_wall, 1e-9)
+    goodput = fire_tps / max(base_tps, 1e-9)
+    # Whole-file truth (the LAST serve_summary is the resumed leg's,
+    # which saw no faults): count the recovery events themselves.
+    rec_counts = fire_sum.get("recovery_counts", {})
+    retries = rec_counts.get("slot_quarantine", 0)
+    swaps = rec_counts.get("weight_swap", 0)
+
+    common_tags = {
+        "model": f"gpt_lm/{args.size}",
+        "requests": args.requests, "new_tokens": args.new_tokens,
+        "num_slots": args.num_slots, "trace": args.trace,
+        "arrival_rate": args.arrival_rate, "seed": args.seed,
+        "fault_plan": plan,
+    }
+    lines = [
+        {"metric": "fire_faultfree_tokens_per_sec",
+         "value": round(base_tps, 1), "unit": "tokens/sec"},
+        {"metric": "fire_tokens_per_sec",
+         "value": round(fire_tps, 1), "unit": "tokens/sec"},
+        {"metric": "fire_goodput", "value": round(goodput, 4),
+         "unit": "fraction of fault-free"},
+        {"metric": "fire_serving_wall", "value": round(fire_wall, 3),
+         "unit": "s", "faultfree_wall": round(base_wall, 3)},
+        {"metric": "fire_retries", "value": retries, "unit": "slot"
+         " quarantines"},
+        {"metric": "fire_swaps", "value": swaps, "unit": "live weight"
+         " swaps",
+         "swap_seconds": fire_sum.get("swap_seconds_total",
+                                      fire_sum.get("serve_swap_seconds",
+                                                   0))},
+        {"metric": "fire_restarts", "value": restarts,
+         "unit": "supervised restarts"},
+        {"metric": "fire_ttft_ms_p99",
+         "value": fire_sum.get("serve_ttft_ms_p99"), "unit": "ms",
+         "faultfree_p99": base_sum.get("serve_ttft_ms_p99")},
+        {"metric": "fire_ttft_ms_p99_recovery",
+         "value": fire_sum.get("serve_ttft_ms_p99_recovery"),
+         "unit": "ms",
+         "recovery_requests": fire_sum.get("serve_recovery_requests",
+                                           0)},
+        {"metric": "fire_recovery_counts",
+         "value": fire_sum.get("recovery_counts", {}), "unit": ""},
+    ]
+    checks = {
+        "metric": "fire_checks",
+        "goodput_ok": bool(goodput >= args.min_goodput),
+        "min_goodput": args.min_goodput,
+        "lost_requests": len(lost),
+        "token_identical": args.requests - len(mismatched),
+        "of": args.requests,
+        "drills_fired_ok": bool(retries >= 1 and swaps >= 1
+                                and restarts >= 1),
+    }
+    lines.append(checks)
+    lines = [dict(ln, **common_tags) for ln in lines]
+    print("\n".join(json.dumps(ln) for ln in lines))
+    if args.out:
+        from tensorflow_distributed_tpu.observe.registry import (
+            write_jsonl)
+        write_jsonl(args.out, lines)
+    ok = (checks["goodput_ok"] and not lost and not mismatched
+          and checks["drills_fired_ok"])
+    if not args.no_check and not ok:
+        print(f"firebench: checks FAILED: {checks}", file=sys.stderr)
+        return 1
+    if not args.workdir:
+        shutil.rmtree(work, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

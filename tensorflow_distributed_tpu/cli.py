@@ -21,6 +21,17 @@ Examples:
     python -m tensorflow_distributed_tpu.cli --mode serve \
         --model gpt_lm --serve.num-slots 8 --serve.num-requests 32
 
+    # serve under fire (README "Serving under faults"): bursty
+    # arrivals, slot-NaN containment + live weight swap drills, a
+    # crash-durable request journal, decode watchdog; run under
+    # resilience.supervisor for SIGKILL coverage
+    python -m tensorflow_distributed_tpu.cli --mode serve \
+        --model gpt_lm --checkpoint-dir /tmp/ckpt \
+        --serve.trace bursty --serve.arrival-rate 8 \
+        --serve.journal /tmp/serve.journal \
+        --resilience.sync-timeout-s 60 \
+        --resilience.fault-plan "slot_nan@6:1,reload@10,sigkill@14"
+
     # graftcheck runtime checks (analysis/runtime.py; README "Static
     # analysis"): transfer guard + sharding-contract assertion
     python -m tensorflow_distributed_tpu.cli --train-steps 100 --check true
@@ -48,9 +59,11 @@ from tensorflow_distributed_tpu.utils.compilecache import (
 
 # Distinct exit codes for the failure classes a supervisor (e.g.
 # resilience.supervisor) or scheduler wants to tell apart in logs:
-# 2 = training diverged (non-finite halt / recovery budget exhausted —
-# a restart will usually re-diverge), 3 = stall watchdog fired (a
-# restart is exactly the remedy). Clean completion and graceful
+# 2 = diverged (train: non-finite halt / recovery budget exhausted;
+# serve: a request slot-quarantined past its retry budget — either
+# way a restart re-diverges), 3 = stall watchdog fired (train data/
+# sync or serve decode — a restart is exactly the remedy; serve legs
+# resume from the request journal). Clean completion and graceful
 # preemption both exit 0.
 EXIT_DIVERGED = 2
 EXIT_STALLED = 3
@@ -69,8 +82,25 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # Continuous-batching inference over a request workload
         # (serve/run.py): slots join/leave one hot compiled decode
         # step, prompts prefill through a bounded bucket ladder.
+        # Same exit-code contract as training, serve-shaped: a
+        # request slot-quarantined past its retry budget is serve's
+        # divergence (2 — deterministic decode would re-poison; the
+        # supervisor must NOT hot-loop restarts), a decode watchdog
+        # breach is a stall (3 — a restart + journal resume is
+        # exactly the remedy).
         from tensorflow_distributed_tpu.serve.run import serve_run
-        serve_run(cfg)
+        from tensorflow_distributed_tpu.serve.scheduler import (
+            SlotRetryExhausted)
+        try:
+            serve_run(cfg)
+        except SlotRetryExhausted as e:
+            print(f"[resilience] serve diverged: {e}", file=sys.stderr,
+                  flush=True)
+            return EXIT_DIVERGED
+        except StallError as e:
+            print(f"[resilience] serve stalled: {e}", file=sys.stderr,
+                  flush=True)
+            return EXIT_STALLED
         return 0
     try:
         result = train(cfg)

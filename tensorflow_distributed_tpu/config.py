@@ -181,6 +181,25 @@ class ServeConfig:
     prompt_len_min: int = 8
     prompt_len_max: int = 64
     arrival_rate: float = 0.0
+    # Arrival-trace shape for the synthetic workload (serve/run.py):
+    # "" = uniformly spaced at arrival_rate, "poisson" = exponential
+    # interarrivals, "bursty" = whole bursts land at once, "diurnal" =
+    # sinusoidally modulated rate, or a .jsonl file of per-request
+    # {"arrival_s": t} offsets. Non-"" shapes (except a file) need
+    # arrival_rate > 0.
+    trace: str = ""
+    # Request journal path (serve/journal.py): admits/tokens/
+    # completions append here, flushed per decode step, so a killed
+    # serving process resumes at token granularity — a non-empty
+    # journal at startup means RESUME (finished requests skip,
+    # in-flight ones re-admit as continuations). The supervisor's
+    # serve-mode restart story; "" = off.
+    journal: str = ""
+    # Per-request slot-retry budget: how many times one request may be
+    # quarantined (NaN logits -> free the slot, re-prefill prompt +
+    # good tokens) before the run halts with SlotRetryExhausted (exit
+    # 2 — serve's DIVERGED equivalent; the supervisor won't hot-loop).
+    slot_retries: int = 2
     # Print each streamed token as it retires (chief only).
     stream: bool = False
 
@@ -214,6 +233,26 @@ class ServeConfig:
             raise ValueError(
                 f"serve.arrival_rate must be >= 0, "
                 f"got {self.arrival_rate}")
+        if self.slot_retries < 0:
+            raise ValueError(
+                f"serve.slot_retries must be >= 0, "
+                f"got {self.slot_retries}")
+        if self.trace and not self.trace.endswith(".jsonl"):
+            if self.trace not in ("poisson", "bursty", "diurnal"):
+                raise ValueError(
+                    f"unknown serve.trace {self.trace!r}; have "
+                    f"('poisson', 'bursty', 'diurnal') or a .jsonl "
+                    f"file of arrival offsets")
+            if not self.arrival_rate:
+                raise ValueError(
+                    f"serve.trace={self.trace!r} shapes the arrival "
+                    f"process around serve.arrival_rate — set a rate "
+                    f"> 0")
+        if self.trace and self.requests:
+            raise ValueError(
+                "serve.trace shapes the SYNTHETIC workload's "
+                "arrivals; a request file carries its own arrival_s "
+                "— drop one of the flags")
 
 
 @dataclasses.dataclass
@@ -233,7 +272,12 @@ class ResilienceConfig:
     # data_stall (:duration, e.g. 5s, slept inside the batch fetch so
     # the watchdog sees it), sigterm / sigkill (self-signal when the
     # step is dispatched; first-leg only, so a supervised restart
-    # terminates). Test/drill harness — empty in production runs.
+    # terminates). Under mode=serve the step key counts DECODE steps
+    # and the kinds are decode_stall (:duration, slept inside the
+    # decode watchdog's window), slot_nan (:slot, NaN-poisons one
+    # slot's KV row -> quarantine + re-prefill of only that slot),
+    # reload (live weight swap from --checkpoint-dir), plus sigterm/
+    # sigkill. Test/drill harness — empty in production runs.
     fault_plan: str = ""
     # Non-finite-loss policy, checked per step on the metrics the loop
     # already retires: "off" (legacy: train on, unless the separate
@@ -861,6 +905,40 @@ class TrainConfig:
                     "mode=serve requires a pure data mesh (model/seq/"
                     "pipe/expert == 1): the slot engine's single-token "
                     "steps can't be model-sharded yet")
+        if self.resilience.fault_plan:
+            # Phase check: a fault keyed to a phase that never consults
+            # it would sit silently unfired — reject at startup
+            # (resilience/faults.py TRAIN_KINDS/SERVE_KINDS).
+            from tensorflow_distributed_tpu.resilience.faults import (
+                SERVE_KINDS, TRAIN_KINDS, parse_fault_plan)
+            kinds = parse_fault_plan(self.resilience.fault_plan).kinds()
+            if self.mode == "serve":
+                bad = sorted(kinds - set(SERVE_KINDS))
+                if bad:
+                    raise ValueError(
+                        f"fault kinds {bad} are train-phase only; "
+                        f"mode=serve consults {sorted(SERVE_KINDS)} "
+                        f"on the decode-step clock")
+                if "reload" in kinds and not self.checkpoint_dir:
+                    raise ValueError(
+                        "fault kind 'reload' performs a live weight "
+                        "swap from --checkpoint-dir; set one (serve "
+                        "needs a swap source)")
+            elif self.mode == "train":
+                bad = sorted(kinds - set(TRAIN_KINDS))
+                if bad:
+                    raise ValueError(
+                        f"fault kinds {bad} are serve-phase only; "
+                        f"mode=train consults {sorted(TRAIN_KINDS)} "
+                        f"on the train-step clock")
+            else:
+                raise ValueError(
+                    f"resilience.fault_plan has no injection points "
+                    f"under mode={self.mode!r}; drop the flag")
+        if self.serve.journal and self.mode != "serve":
+            raise ValueError(
+                "serve.journal is written by the mode=serve "
+                "scheduler; drop the flag")
         if self.mode == "generate":
             if self.model not in ("gpt_lm", "moe_lm"):
                 raise ValueError(

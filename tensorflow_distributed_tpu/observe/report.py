@@ -76,6 +76,19 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         if ttfts:
             out["serve_ttft_ms_p50"] = round(_percentile(ttfts, 50), 3)
             out["serve_ttft_ms_p95"] = round(_percentile(ttfts, 95), 3)
+            out["serve_ttft_ms_p99"] = round(_percentile(ttfts, 99), 3)
+        # Requests whose arrival->first-token window overlapped a
+        # recovery event (slot quarantine / weight swap) — the
+        # availability population FIREBENCH's p99-TTFT-during-recovery
+        # gate reads.
+        rec_ttfts = sorted(
+            float(r["ttft_ms"]) for r in serve_reqs
+            if r.get("recovery_window")
+            and isinstance(r.get("ttft_ms"), (int, float)))
+        if rec_ttfts:
+            out["serve_recovery_requests"] = len(rec_ttfts)
+            out["serve_ttft_ms_p99_recovery"] = round(
+                _percentile(rec_ttfts, 99), 3)
         toks = [float(r["tok_ms"]) for r in serve_reqs
                 if isinstance(r.get("tok_ms"), (int, float))]
         if toks:
@@ -83,7 +96,8 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     if serve_sums:
         final = serve_sums[-1]
         for key in ("tokens_per_sec", "mean_slot_occupancy",
-                    "total_new_tokens", "prefill_compiles"):
+                    "total_new_tokens", "prefill_compiles", "retries",
+                    "swaps", "swap_seconds", "seed", "trace"):
             if key in final:
                 out[f"serve_{key}"] = final[key]
     if steps:
@@ -112,6 +126,21 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         for key, val in final.items():
             if key.endswith("_seconds") or key == "goodput":
                 out[key] = val
+    # Recovery events (resilience/ + serve fire paths): count by kind
+    # plus the rewind/swap time totals, so ONE report shows traffic
+    # and faults together.
+    recoveries = [r for r in records if r.get("event") == "recovery"]
+    if recoveries:
+        counts: Dict[str, int] = {}
+        for r in recoveries:
+            kind = str(r.get("kind", "?"))
+            counts[kind] = counts.get(kind, 0) + 1
+        out["recovery_counts"] = dict(sorted(counts.items()))
+        swap_s = sum(float(r.get("seconds", 0.0)) for r in recoveries
+                     if r.get("kind") == "weight_swap"
+                     and isinstance(r.get("seconds"), (int, float)))
+        if swap_s:
+            out["swap_seconds_total"] = round(swap_s, 4)
     # Compiled-program registry (observe/device.py "compile" records):
     # latest record per program — name, flops, peak-HBM estimate,
     # compile seconds — the device-side cost/memory inventory.
@@ -175,12 +204,16 @@ def render(summary: Dict[str, Any]) -> str:
              "mean_items_per_sec", "mean_model_tflops", "mean_mfu",
              "mean_hw_mfu", "first_loss", "last_loss", "goodput",
              "serve_requests", "serve_ttft_ms_p50", "serve_ttft_ms_p95",
+             "serve_ttft_ms_p99", "serve_recovery_requests",
+             "serve_ttft_ms_p99_recovery",
              "serve_tok_ms_mean", "serve_tokens_per_sec",
              "serve_mean_slot_occupancy", "serve_total_new_tokens",
-             "serve_prefill_compiles")
-    # programs/health render as their own sections below;
+             "serve_prefill_compiles", "serve_retries", "serve_swaps",
+             "serve_swap_seconds", "serve_seed", "serve_trace")
+    # programs/health/recovery render as their own sections below;
     # peak_hbm_bytes_sum renders as the Programs TOTAL row.
-    sections = ("programs", "health", "peak_hbm_bytes_sum")
+    sections = ("programs", "health", "peak_hbm_bytes_sum",
+                "recovery_counts", "swap_seconds_total")
     for key in order:
         if key in summary:
             lines.append(f"  {key:<22} {summary[key]}")
@@ -203,6 +236,13 @@ def render(summary: Dict[str, Any]) -> str:
             lines.append(f"  {'TOTAL (all resident)':<28} "
                          f"peak_hbm="
                          f"{_device.human_bytes(summary['peak_hbm_bytes_sum'])}")
+    if "recovery_counts" in summary:
+        lines.append("Recovery")
+        for kind, n in summary["recovery_counts"].items():
+            lines.append(f"  {kind:<28} {n}")
+        if "swap_seconds_total" in summary:
+            lines.append(f"  {'swap_seconds_total':<28} "
+                         f"{summary['swap_seconds_total']}")
     if "health" in summary:
         lines.append("Health")
         for module, entry in summary["health"].items():

@@ -23,6 +23,23 @@ points real faults strike:
   only (``bind(start_step=0)``): a resumed leg IS the recovery under
   test, and re-firing would kill a supervised run forever.
 
+Under ``--mode serve`` the step key counts DECODE steps (the serving
+engine's clock — serve/scheduler.py consults the plan between steps),
+and three serve-phase kinds exist alongside ``sigterm``/``sigkill``:
+
+- ``decode_stall@K[:Ds]`` — sleep D seconds (default 1) inside decode
+  step K's device sync, exactly where a wedged device manifests; the
+  decode watchdog (``--resilience.sync-timeout-s``) sees the hang and
+  raises StallError instead of letting the engine freeze.
+- ``slot_nan@K[:slot]`` — NaN-poison one slot's KV-cache row (default
+  slot 0) before decode step K, so that slot's logits are genuinely
+  non-finite through the real attention math; the engine's on-device
+  per-slot finiteness check flags it and the scheduler quarantines +
+  re-prefills ONLY that slot.
+- ``reload@K`` — force a live weight swap before decode step K: params
+  reload from the newest verifiable checkpoint between decode steps,
+  without draining slots or recompiling.
+
 Every injection emits an ``event="recovery", kind="fault_injected"``
 record through the observe registry. Events are one-shot per plan
 object, so an in-process rewind past an injected NaN does not re-poison
@@ -42,7 +59,15 @@ import numpy as np
 
 from tensorflow_distributed_tpu.observe.registry import emit_event
 
-KINDS = ("nan_grad", "ckpt_io_fail", "data_stall", "sigterm", "sigkill")
+KINDS = ("nan_grad", "ckpt_io_fail", "data_stall", "sigterm", "sigkill",
+         "decode_stall", "slot_nan", "reload")
+# Phase validity (config.validate rejects cross-phase plans at startup
+# so a train-only fault never sits silently unfired in a serve run):
+# signals fire in both phases, keyed on the phase's own step clock.
+TRAIN_KINDS = ("nan_grad", "ckpt_io_fail", "data_stall", "sigterm",
+               "sigkill")
+SERVE_KINDS = ("decode_stall", "slot_nan", "reload", "sigterm",
+               "sigkill")
 
 _EVENT_RE = re.compile(
     r"^(?P<kind>[a-z_]+)@(?P<step>\d+)(?::(?P<arg>[0-9.]+s?))?$")
@@ -77,11 +102,17 @@ def parse_fault_plan(spec: str) -> "FaultPlan":
         arg_s = m.group("arg")
         arg: Optional[float] = None
         if arg_s is not None:
-            if kind == "data_stall":
+            if kind in ("data_stall", "decode_stall"):
                 arg = float(arg_s[:-1] if arg_s.endswith("s") else arg_s)
                 if arg <= 0:
                     raise ValueError(
-                        f"data_stall duration must be > 0 in {token!r}")
+                        f"{kind} duration must be > 0 in {token!r}")
+            elif kind == "slot_nan":
+                arg = float(arg_s)
+                if arg != int(arg) or arg < 0:
+                    raise ValueError(
+                        f"slot_nan slot must be a non-negative int "
+                        f"in {token!r}")
             elif kind == "ckpt_io_fail":
                 arg = float(arg_s)
                 if arg != int(arg) or arg < 1:
@@ -107,6 +138,12 @@ class FaultPlan:
 
     def __bool__(self) -> bool:
         return bool(self._by_step)
+
+    def kinds(self) -> set:
+        """Distinct fault kinds in the plan (config.validate's phase
+        check: a kind the run's mode never consults is rejected at
+        startup, not silently unfired)."""
+        return {kind for (kind, _step) in self._by_step}
 
     def bind(self, start_step: int) -> None:
         """Pin the leg's resume point: events at or before it are
@@ -204,3 +241,39 @@ class FaultPlan:
                 emit_event("recovery", kind="fault_injected",
                            fault=kind, step=step)
                 os.kill(os.getpid(), signum)
+
+    # -- serve-phase injection points (step = the engine's decode step;
+    #    serve/scheduler.py consults these between steps, the engine
+    #    consumes decode_stall inside its watched device sync) ----------
+    def decode_stall_sleep(self, step: int) -> None:
+        """Sleep the injected stall inside the decode step's device
+        sync (the engine runs this under the decode watchdog, so the
+        deadline sees exactly the hang it guards against)."""
+        ev = self._take("decode_stall", step)
+        if ev is not None:
+            emit_event("recovery", kind="fault_injected",
+                       fault="decode_stall", step=step,
+                       seconds=ev.arg or 1.0)
+            time.sleep(ev.arg if ev.arg is not None else 1.0)
+
+    def take_slot_nan(self, step: int) -> Optional[int]:
+        """The slot to NaN-poison before decode step ``step`` (None
+        off-plan). The engine poisons that slot's KV row on device, so
+        the non-finite logits flow through the real attention math."""
+        ev = self._take("slot_nan", step)
+        if ev is None:
+            return None
+        slot = int(ev.arg) if ev.arg is not None else 0
+        emit_event("recovery", kind="fault_injected", fault="slot_nan",
+                   step=step, slot=slot)
+        return slot
+
+    def take_reload(self, step: int) -> bool:
+        """True when a live weight swap (checkpoint reload under
+        traffic) is due before decode step ``step``."""
+        ev = self._take("reload", step)
+        if ev is not None:
+            emit_event("recovery", kind="fault_injected",
+                       fault="reload", step=step)
+            return True
+        return False

@@ -16,6 +16,28 @@ periodic checkpoint and silently lost everything since
 preemption guard (SIGTERM legs exit 0 after a durable save and are
 NOT restarts) and the checkpoint layer's integrity fallback.
 
+Serve-aware: a ``--mode serve`` child restarts WITHOUT ``--resume``
+(that flag is the train loop's checkpoint resume); its continuity
+comes from the request journal instead — the identical restart
+command finds the journal non-empty, skips finished requests, and
+re-admits in-flight ones as continuations (serve/journal.py). Pass
+``--serve.journal`` in the child args or restarts re-serve the whole
+workload from scratch (warned at startup).
+
+Exit-code semantics (cli.py), both phases:
+
+- **0**: clean completion or graceful preemption drain — stop.
+- **2** DIVERGED: train halted on a non-finite loss / exhausted
+  recovery budget, or serve quarantined the SAME request past its
+  slot-retry budget (SlotRetryExhausted). Deterministic inputs
+  re-diverge identically, so restarting just burns the budget: NOT
+  restarted unless ``--restart-on-diverge``; the supervisor exits 2.
+- **3** STALLED: a watchdog deadline fired (train data/sync stall or
+  serve decode stall). A restart is exactly the remedy — restarted
+  like any crash, and rc 3 propagates out only when the restart
+  budget is exhausted.
+- anything else (crash, OOM, SIGKILL): restarted with capped backoff.
+
 Stops on: clean child exit (rc 0), or restart-budget exhaustion
 (exits with the child's last rc). SIGTERM/SIGINT to the supervisor is
 forwarded to the child, so a preemption notice drains the whole tree
@@ -46,6 +68,23 @@ def _child_flag_value(args: Sequence[str], flag: str) -> Optional[str]:
         if a.startswith(flag + "="):
             return a.split("=", 1)[1]
     return None
+
+
+def build_leg_args(child_args: Sequence[str], restarts: int
+                   ) -> List[str]:
+    """The child argv for leg ``restarts``. Train children gain
+    ``--resume true`` from the second leg on (never overriding an
+    explicit user setting, either spelling); serve children restart
+    with the UNCHANGED command — their continuity is the request
+    journal, which the identical ``--serve.journal`` path makes a
+    resume by construction."""
+    args = list(child_args)
+    serve = _child_flag_value(args, "--mode") == "serve"
+    ckpt_dir = _child_flag_value(args, "--checkpoint-dir")
+    if (restarts > 0 and not serve and ckpt_dir
+            and _child_flag_value(args, "--resume") is None):
+        args += ["--resume", "true"]
+    return args
 
 
 def _append_event(path: Optional[str], record: dict) -> None:
@@ -85,7 +124,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     ckpt_dir = _child_flag_value(child_args, "--checkpoint-dir")
     jsonl = _child_flag_value(child_args, "--observe.metrics-jsonl")
-    if not ckpt_dir:
+    serve = _child_flag_value(child_args, "--mode") == "serve"
+    if serve and not _child_flag_value(child_args, "--serve.journal"):
+        print("[supervisor] WARNING: serve child has no "
+              "--serve.journal — restarts will re-serve the whole "
+              "workload from scratch (in-flight and even finished "
+              "requests replay)", flush=True)
+    elif not serve and not ckpt_dir:
         print("[supervisor] WARNING: no --checkpoint-dir in child args"
               " — restarts will repeat from step 0 (the reference "
               "Supervisor's lose-everything behavior)", flush=True)
@@ -93,13 +138,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     restarts = 0
     rc = 1
     while True:
-        args = list(child_args)
-        # _child_flag_value handles both "--resume true" and
-        # "--resume=true" forms — an explicit user setting (either
-        # spelling, either value) is never overridden.
-        if (restarts > 0 and ckpt_dir
-                and _child_flag_value(args, "--resume") is None):
-            args += ["--resume", "true"]
+        args = build_leg_args(child_args, restarts)
         cmd = [sys.executable, "-m", "tensorflow_distributed_tpu.cli",
                *args]
         print(f"[supervisor] leg {restarts}: {' '.join(cmd)}",
@@ -145,7 +184,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     opts.backoff_max_s)
         record = {"event": "recovery", "kind": "restart",
                   "leg": restarts, "rc": rc,
-                  "backoff_s": round(delay, 3), "resume": bool(ckpt_dir)}
+                  "backoff_s": round(delay, 3),
+                  "resume": bool(_child_flag_value(
+                      child_args, "--serve.journal")) if serve
+                  else bool(ckpt_dir)}
         print(f"[supervisor] {json.dumps(record)}", flush=True)
         _append_event(jsonl, record)
         time.sleep(delay)

@@ -108,6 +108,17 @@ class Watchdog:
         return self._watched(lambda: jax.block_until_ready(value),
                              "device sync", step, self.sync_timeout_s)
 
+    def decode(self, fn: Callable[[], Any], step: int) -> Any:
+        """Run a serving engine's decode-step sync (token fetch) under
+        the sync deadline — the serve-mode twin of :meth:`sync`, taking
+        a callable so the engine can fold its injected decode_stall
+        INSIDE the watched region (the watchdog must see exactly the
+        hang a wedged device would produce). ``step`` is the decode
+        step. Raises StallError instead of letting the engine freeze;
+        the CLI maps it to exit 3 for the supervisor to restart."""
+        return self._watched(fn, "decode step", step,
+                             self.sync_timeout_s)
+
     def close(self) -> None:
         """Drop the worker reference; the daemon thread dies with the
         process (it blocks forever on a queue nobody feeds)."""

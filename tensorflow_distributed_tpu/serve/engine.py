@@ -25,6 +25,16 @@ Greedy sampling only: the engine's contract (pinned in
 tests/test_serve.py) is token-identical output to one-shot greedy
 ``generate()`` per request — continuous batching must not change
 results.
+
+Serve-under-fire surface (README "Serving under faults"; all optional,
+zero cost unconfigured): the decode program carries a per-slot
+finiteness flag (``take_bad_slots`` — the scheduler's quarantine
+signal), ``poison_slot`` injects a genuinely-NaN KV row for drills,
+``swap_params`` installs fresh weights between steps without draining
+slots or recompiling (structure/shape/dtype/sharding asserted), the
+token fetch runs under an optional decode watchdog, and ``warmup``
+moves every program's first-dispatch cost out of the first requests'
+TTFT.
 """
 
 from __future__ import annotations
@@ -65,13 +75,19 @@ def _compiled_prefill(model, bucket: int):
 @functools.lru_cache(maxsize=8)
 def _compiled_step(model):
     """THE decode program: one greedy token for every slot at its own
-    depth. Compiled once per (model, num_slots) — the shapes come from
-    the arguments, so one engine reuses one executable forever."""
+    depth, plus a per-slot ``ok`` flag — logits fully finite. The flag
+    is the engine's NaN containment sensor: a poisoned KV row (or a
+    genuinely diverged slot) shows up HERE, on device, as part of the
+    same program and the same host fetch, costing one row-wise
+    reduction and zero extra transfers or collectives (census-pinned).
+    Compiled once per (model, num_slots) — the shapes come from the
+    arguments, so one engine reuses one executable forever."""
 
     @jax.jit
     def run(params, cache, tok, pos):
         last, cache = decode_token(model, params, cache, tok, pos)
-        return cache, jnp.argmax(last, axis=-1).astype(jnp.int32)
+        ok = jnp.isfinite(last).all(axis=-1)
+        return cache, jnp.argmax(last, axis=-1).astype(jnp.int32), ok
 
     return observe_device.instrument("serve_decode_step", run)
 
@@ -96,6 +112,26 @@ _insert_row = observe_device.instrument("serve_insert_row",
                                         _insert_row_jit)
 
 
+@jax.jit
+def _poison_row_jit(cache, slot):
+    """NaN-fill the float leaves of ``slot``'s cache row (the slot_nan
+    fault drill): the poison flows through the REAL attention math, so
+    that slot's next logits are genuinely non-finite — exactly what a
+    corrupted KV row or a diverged slot produces. ``slot`` is traced,
+    so every slot shares one program; integer leaves (token ids, the
+    compat index) pass through untouched."""
+
+    def bad(c):
+        if (getattr(c, "ndim", 0)
+                and jnp.issubdtype(c.dtype, jnp.floating)):
+            row = jnp.full((1,) + c.shape[1:], jnp.nan, c.dtype)
+            return jax.lax.dynamic_update_slice(
+                c, row, (slot,) + (0,) * (c.ndim - 1))
+        return c
+
+    return jax.tree_util.tree_map(bad, cache)
+
+
 class SlotDecodeEngine:
     """The slot cache + the three programs (prefill/insert/step),
     with host-side slot bookkeeping. The scheduler (serve/scheduler.py)
@@ -104,7 +140,8 @@ class SlotDecodeEngine:
 
     def __init__(self, model, params, num_slots: int,
                  buckets: Optional[Sequence[int]] = None,
-                 min_bucket: int = 16, check: bool = False):
+                 min_bucket: int = 16, check: bool = False,
+                 fault_plan=None, watchdog=None):
         cfg = model.cfg
         if not cfg.causal:
             raise ValueError("SlotDecodeEngine needs a causal model")
@@ -133,6 +170,15 @@ class SlotDecodeEngine:
         self._buckets_used: set = set()
         self.prefills = 0
         self.decode_steps = 0
+        self.swaps = 0
+        # Serve-under-fire hooks (both optional; zero cost when None):
+        # the fault plan's decode_stall is consumed INSIDE the watched
+        # token fetch so the decode watchdog sees exactly the hang a
+        # wedged device produces, and _last_ok carries the decode
+        # program's per-slot finiteness flags for take_bad_slots().
+        self._plan = fault_plan
+        self._watchdog = watchdog
+        self._last_ok: Optional[np.ndarray] = None
         self._step_fn = lookup_program(_compiled_step, self.model)
         # --check (graftcheck's runtime layer): the decode step runs
         # under jax.transfer_guard("disallow"), and the cache layout
@@ -159,6 +205,32 @@ class SlotDecodeEngine:
     def prefill_compiles(self) -> int:
         """Distinct prefill programs invoked (one per bucket used)."""
         return len(self._buckets_used)
+
+    def warmup(self) -> None:
+        """Dispatch every engine program once — each bucket's prefill,
+        the row insert, the decode step — against throwaway inputs,
+        then roll the cache reference back. First-dispatch cost
+        (trace/compile or persistent-cache deserialize, ~hundreds of
+        ms per program on this box) moves to startup instead of
+        landing in the first requests' TTFT — and, under a restart,
+        inside the recovery window. Host bookkeeping is untouched and
+        the pre-warmup cache object is restored, so a warmed engine is
+        byte-identical to a fresh one."""
+        cache0 = self.cache
+        for b in self.buckets:
+            fn = lookup_program(_compiled_prefill, self.model, b)
+            row, _ = fn(self.params, jnp.zeros((1, b), jnp.int32),
+                        jnp.asarray(1, jnp.int32))
+            self.cache = _insert_row(self.cache, row,
+                                     jnp.asarray(0, jnp.int32))
+        out = self._step_fn(self.params, self.cache,
+                            jnp.asarray(self.tok),
+                            jnp.asarray(self.pos))
+        # graftcheck: disable=host-sync-in-loop -- startup-only drain
+        # of the warmup dispatches; runs once per process, never in
+        # the decode loop
+        jax.block_until_ready(out)
+        self.cache = cache0
 
     def free_slots(self):
         return [s for s in range(self.num_slots) if not self.active[s]]
@@ -214,20 +286,35 @@ class SlotDecodeEngine:
         # engine's designed input path.
         tok, pos = jnp.asarray(self.tok), jnp.asarray(self.pos)
         with graftcheck.transfer_guard(self._check):
-            self.cache, nxt = self._step_fn(self.params, self.cache,
-                                            tok, pos)
+            self.cache, nxt, ok = self._step_fn(
+                self.params, self.cache, tok, pos)
         if self._check and self.decode_steps == 0:
             # First decode step: the cache must come back in the
             # layout it was created with — sharding drift here
             # re-lays-out every subsequent step.
             graftcheck.assert_sharding_contract(
                 self.cache, self._declared_cache, what="decode cache")
-        # graftcheck: disable=host-sync-in-loop -- the engine's OUTPUT:
-        # tokens must land on host every step for EOS/budget
-        # termination and streaming; [num_slots] int32 per step is the
-        # contract, and the decode program itself stays dispatched
-        # ahead of it
-        nxt = np.asarray(jax.device_get(nxt))
+        step_no = self.decode_steps + 1
+
+        def fetch():
+            # An injected decode_stall sleeps here, INSIDE the watched
+            # region, so the watchdog sees exactly the hang a wedged
+            # device would produce.
+            if self._plan:
+                self._plan.decode_stall_sleep(step_no)
+            # graftcheck: disable=host-sync-in-loop -- the engine's
+            # OUTPUT: tokens + per-slot ok flags must land on host
+            # every step for EOS/budget termination, streaming, and
+            # NaN containment; ONE [num_slots] fetch per step is the
+            # contract, and the decode program stays dispatched ahead
+            return jax.device_get((nxt, ok))
+
+        if (self._watchdog is not None
+                and self._watchdog.sync_timeout_s > 0):
+            nxt, ok = self._watchdog.decode(fetch, step_no)
+        else:
+            nxt, ok = fetch()
+        self._last_ok = ok
         act = self.active
         self.tok[act] = nxt[act]
         self.pos[act] += 1
@@ -240,3 +327,81 @@ class SlotDecodeEngine:
         self.active[slot] = False
         self.tok[slot] = 0
         self.pos[slot] = 0
+
+    # -- serve-under-fire surface (scheduler-facing) ----------------------
+
+    def take_bad_slots(self):
+        """ACTIVE slots whose last decode step produced non-finite
+        logits — the containment signal the scheduler acts on
+        (quarantine + re-prefill of ONLY those slots). Rides the decode
+        program's per-slot ok flags; no extra device work. Inactive
+        rows are excluded by construction: a freed slot's stale NaN row
+        keeps flagging until the next insert overwrites it, and that is
+        garbage nobody reads."""
+        if self._last_ok is None:
+            return []
+        return [s for s in range(self.num_slots)
+                if self.active[s] and not self._last_ok[s]]
+
+    def poison_slot(self, slot: int) -> None:
+        """slot_nan fault drill: NaN-fill ``slot``'s KV-cache row ON
+        DEVICE, so the next decode step's logits for that slot are
+        genuinely non-finite through the real attention math (not a
+        spoofed flag)."""
+        if not 0 <= slot < self.num_slots:
+            raise ValueError(
+                f"slot_nan slot {slot} out of range [0, "
+                f"{self.num_slots})")
+        floats = sum(
+            1 for c in jax.tree_util.tree_leaves(self.cache)
+            if getattr(c, "ndim", 0)
+            and jnp.issubdtype(c.dtype, jnp.floating))
+        if not floats:
+            raise ValueError(
+                "slot_nan: the decode cache has no float leaves to "
+                "poison")
+        self.cache = _poison_row_jit(self.cache,
+                                     jnp.asarray(slot, jnp.int32))
+
+    def swap_params(self, new_params) -> None:
+        """LIVE WEIGHT SWAP: replace the serving params between decode
+        steps without draining slots or recompiling. The contract that
+        makes this safe — identical tree structure, leaf shapes/dtypes,
+        and sharding layout — is asserted here (shapes/dtypes by direct
+        comparison, placement via the graftcheck sharding-contract
+        checker), because any mismatch would silently retrace the hot
+        decode program instead of hitting its jit cache. In-flight KV
+        caches are untouched: swapping to the same checkpoint is
+        token-identical by construction (pinned in
+        tests/test_serve_fire.py)."""
+        if (jax.tree_util.tree_structure(new_params)
+                != jax.tree_util.tree_structure(self.params)):
+            raise ValueError(
+                "live weight swap: new params tree structure differs "
+                "from the serving params (different architecture?)")
+        mismatches = []
+
+        def cmp(path, old, new):
+            if (getattr(old, "shape", None) != getattr(new, "shape",
+                                                       None)
+                    or getattr(old, "dtype", None) != getattr(
+                        new, "dtype", None)):
+                mismatches.append(
+                    f"  {jax.tree_util.keystr(path)}: "
+                    f"{getattr(old, 'shape', '?')}/"
+                    f"{getattr(old, 'dtype', '?')} -> "
+                    f"{getattr(new, 'shape', '?')}/"
+                    f"{getattr(new, 'dtype', '?')}")
+            return old
+
+        jax.tree_util.tree_map_with_path(cmp, self.params, new_params)
+        if mismatches:
+            raise ValueError(
+                "live weight swap: leaf shape/dtype drift (the hot "
+                "decode program would retrace):\n"
+                + "\n".join(mismatches[:10]))
+        graftcheck.assert_sharding_contract(
+            new_params, graftcheck.sharding_tree(self.params),
+            what="swapped params")
+        self.params = new_params
+        self.swaps += 1

@@ -8,11 +8,24 @@ when ``--dataset text`` supplies a tokenizer) or, with no file, a
 synthetic open-loop workload: ``--serve.num-requests`` random prompts
 with mixed lengths in [``--serve.prompt-len-min``,
 ``--serve.prompt-len-max``], arriving at ``--serve.arrival-rate``
-req/s (0 = all queued at t=0).
+req/s (0 = all queued at t=0). ``--serve.trace`` reshapes the
+synthetic arrival process: ``poisson`` (exponential interarrivals),
+``bursty`` (whole bursts land at once), ``diurnal`` (sinusoidally
+modulated rate — a day compressed into the run), or a ``.jsonl`` file
+of per-request ``{"arrival_s": t}`` offsets.
 
 ``--checkpoint-dir`` restores trained weights (EMA preferred, like
 mode=eval/generate); without one the model serves FRESH-INIT params —
 a load-testing/benchmarking mode, clearly labeled in the output.
+
+Serve-under-fire wiring (README "Serving under faults"): a
+``--resilience.fault-plan`` with serve kinds drives the scheduler's
+containment paths, ``--resilience.sync-timeout-s`` arms the decode
+watchdog, ``--serve.journal`` makes progress crash-durable (an
+existing non-empty journal means RESUME: finished requests skip,
+in-flight ones re-admit as continuations), and ``--checkpoint-dir``
+doubles as the live-weight-swap source (``reload@K`` faults, via
+train.checkpoint.restore_params).
 """
 
 from __future__ import annotations
@@ -24,10 +37,60 @@ from typing import Dict, List
 import numpy as np
 
 from tensorflow_distributed_tpu.config import TrainConfig
+from tensorflow_distributed_tpu.serve import journal as journal_mod
 from tensorflow_distributed_tpu.serve.buckets import (
     default_buckets, parse_buckets)
 from tensorflow_distributed_tpu.serve.engine import SlotDecodeEngine
 from tensorflow_distributed_tpu.serve.scheduler import Request, Scheduler
+
+
+def _arrivals(serve, n: int, rng) -> List[float]:
+    """Arrival offsets for the synthetic workload, shaped by
+    ``serve.trace`` (all deterministic under the run seed):
+
+    - ``""``: uniformly spaced at ``arrival_rate`` (0 = all at t=0);
+    - ``poisson``: exponential interarrivals at the same mean rate —
+      the memoryless open-loop process real traffic approximates;
+    - ``bursty``: bursts of ~4 requests landing TOGETHER, bursts
+      spaced to keep the mean rate — the pathological arrival shape a
+      starvation bound exists for;
+    - ``diurnal``: rate modulated sinusoidally between 0.25x and
+      1.75x over the workload span — a traffic day compressed into
+      one run;
+    - ``*.jsonl``: explicit per-request ``{"arrival_s": t}`` lines
+      (row i feeds request i; the file must cover the workload).
+    """
+    rate = serve.arrival_rate
+    trace = serve.trace
+    if trace.endswith(".jsonl"):
+        offs = []
+        with open(trace) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    offs.append(float(json.loads(line)["arrival_s"]))
+        if len(offs) < n:
+            raise ValueError(
+                f"--serve.trace {trace}: {len(offs)} arrival rows < "
+                f"{n} requests")
+        return offs[:n]
+    if not rate:
+        return [0.0] * n
+    if trace == "poisson":
+        return list(np.cumsum(rng.exponential(1.0 / rate, size=n)))
+    if trace == "bursty":
+        burst = 4
+        return [(i // burst) * (burst / rate) for i in range(n)]
+    if trace == "diurnal":
+        out, t = [], 0.0
+        for i in range(n):
+            # Instantaneous rate sweeps one full "day" over the
+            # workload: 1.75x at the peak, 0.25x in the trough.
+            lam = rate * (1.0 + 0.75 * np.sin(2 * np.pi * i / max(n, 1)))
+            out.append(t)
+            t += 1.0 / lam
+        return out
+    return [i / rate for i in range(n)]
 
 
 def _workload(cfg: TrainConfig, vocab_size: int,
@@ -70,19 +133,21 @@ def _workload(cfg: TrainConfig, vocab_size: int,
             raise ValueError(f"{serve.requests} names no requests")
         return reqs
     # Synthetic open-loop workload: mixed lengths, deterministic by
-    # seed, uniformly spaced arrivals at the configured rate.
+    # seed, arrivals shaped by the trace (prompt draws happen BEFORE
+    # the arrival draws so the token content is identical across
+    # traces — a trace A/B compares arrival shape, nothing else).
     rng = np.random.default_rng(cfg.seed)
-    reqs = []
-    for i in range(serve.num_requests):
+    prompts = []
+    for _ in range(serve.num_requests):
         plen = int(rng.integers(serve.prompt_len_min,
                                 serve.prompt_len_max + 1))
-        prompt = rng.integers(0, vocab_size, size=plen).astype(np.int32)
-        arrival = (i / serve.arrival_rate if serve.arrival_rate > 0
-                   else 0.0)
-        reqs.append(Request(rid=i, prompt=prompt,
-                            max_new_tokens=serve.max_new_tokens,
-                            eos_id=serve.eos_id, arrival_s=arrival))
-    return reqs
+        prompts.append(
+            rng.integers(0, vocab_size, size=plen).astype(np.int32))
+    arrivals = _arrivals(serve, serve.num_requests, rng)
+    return [Request(rid=i, prompt=p,
+                    max_new_tokens=serve.max_new_tokens,
+                    eos_id=serve.eos_id, arrival_s=float(a))
+            for i, (p, a) in enumerate(zip(prompts, arrivals))]
 
 
 def serve_run(cfg: TrainConfig) -> Dict:
@@ -113,6 +178,40 @@ def serve_run(cfg: TrainConfig) -> Dict:
         vocab = cfg.synthetic_vocab or 64
     requests = _workload(cfg, vocab, encode)
 
+    # Journal resume: a non-empty journal at the configured path means
+    # a previous leg died mid-traffic (the supervisor re-runs the SAME
+    # command) — finished requests drop, in-flight ones re-admit as
+    # continuations (prompt + journaled tokens, remaining budget), so
+    # the kill cost is re-decoding at most the unflushed in-flight
+    # tokens.
+    resumed_journal = False
+    if cfg.serve.journal:
+        played = journal_mod.replay(cfg.serve.journal)
+        if played:
+            requests = journal_mod.apply_replay(requests, played)
+            resumed_journal = True
+            if is_chief():
+                done_n = sum(1 for e in played.values() if e["done"])
+                print(f"[serve] journal resume: {done_n} requests "
+                      f"already complete, {len(requests)} to serve "
+                      f"({cfg.serve.journal})", flush=True)
+    if not requests:
+        if is_chief():
+            print("[serve] journal resume: every request already "
+                  "complete — nothing to serve", flush=True)
+        return {"requests": 0, "total_new_tokens": 0,
+                "resumed": resumed_journal}
+
+    from tensorflow_distributed_tpu.resilience.faults import (
+        FaultPlan, parse_fault_plan)
+    plan = (parse_fault_plan(cfg.resilience.fault_plan)
+            if cfg.resilience.fault_plan else FaultPlan())
+    if resumed_journal and plan:
+        # The restarted leg IS the recovery under test: consume every
+        # planned event (same contract as the train loop's
+        # bind(start_step) — a resumed leg must terminate).
+        plan.bind(1 << 30)
+
     max_prompt = max(len(r.prompt) for r in requests)
     # Per-request trajectory bound (what actually has to fit the
     # cache); bucket padding is prefill-only slack and is clamped to
@@ -128,8 +227,13 @@ def serve_run(cfg: TrainConfig) -> Dict:
         # checkpointed model's max_len is pinned by training — set
         # --seq-len to the trained length explicitly.
         cfg = dataclasses.replace(cfg, seq_len=max(need, 32))
+    # With a fault plan armed (or a resumed journal), slot-retry /
+    # replay continuations can carry prompts up to prompt+new-1
+    # tokens — size the default ladder to the full trajectory so a
+    # re-prefill never outgrows the largest bucket.
+    cover = need if (plan or resumed_journal) else max_prompt
     buckets = (parse_buckets(cfg.serve.buckets) if cfg.serve.buckets
-               else default_buckets(max_prompt, cap=cfg.seq_len))
+               else default_buckets(cover, cap=cfg.seq_len))
 
     shim = _GenTask(vocab_size=vocab, sample_input=np.zeros(
         (max(2, dict(mesh.shape).get("data", 1)), cfg.seq_len),
@@ -160,7 +264,11 @@ def serve_run(cfg: TrainConfig) -> Dict:
 
     sinks = []
     if cfg.observe.metrics_jsonl:
-        sinks.append(JsonlSink(cfg.observe.metrics_jsonl))
+        # A journal-resumed leg APPENDS: the dead leg's serve_request/
+        # recovery records are part of the same serving story (exactly
+        # the train-side --resume convention in observe.hub).
+        sinks.append(JsonlSink(cfg.observe.metrics_jsonl,
+                               append=resumed_journal))
     registry = MetricsRegistry(sinks=sinks, enabled=is_chief(),
                                tags=host_tags(mesh, cfg),
                                max_records=cfg.observe.max_records)
@@ -179,10 +287,40 @@ def serve_run(cfg: TrainConfig) -> Dict:
             print(f"[serve] rid={rid} tok={tok}"
                   + (" <done>" if done else ""), flush=True)
 
+    watchdog = None
+    if cfg.resilience.sync_timeout_s > 0:
+        from tensorflow_distributed_tpu.resilience.watchdog import (
+            Watchdog)
+        watchdog = Watchdog(sync_timeout_s=cfg.resilience.sync_timeout_s)
     engine = SlotDecodeEngine(model, params, cfg.serve.num_slots,
-                              buckets=buckets, check=cfg.check)
+                              buckets=buckets, check=cfg.check,
+                              fault_plan=plan if plan else None,
+                              watchdog=watchdog)
+    # Every program dispatches once BEFORE the scheduler's clock
+    # starts: first-request TTFT (and, on a supervised restart, the
+    # recovery window) pays compute, not compile/cache-load.
+    engine.warmup()
+    reload_fn = None
+    if cfg.checkpoint_dir:
+        def reload_fn():
+            # Live weight swap source: newest VERIFIABLE checkpoint
+            # (sha256 + finite-params walk-back), placed with the live
+            # params' shardings so the engine's swap is a jit cache
+            # hit.
+            return ckpt.restore_params(cfg.checkpoint_dir,
+                                       engine.params)
+    journal = (journal_mod.RequestJournal(cfg.serve.journal)
+               if cfg.serve.journal else None)
+    trace_name = cfg.serve.trace or (
+        "file" if cfg.serve.requests else "uniform")
     sched = Scheduler(engine, decode_priority=cfg.serve.decode_priority,
-                      registry=registry, on_token=on_token)
+                      registry=registry, on_token=on_token,
+                      fault_plan=plan if plan else None,
+                      journal=journal, reload_fn=reload_fn,
+                      slot_retries=cfg.serve.slot_retries,
+                      summary_extra={"seed": cfg.seed,
+                                     "trace": trace_name,
+                                     "resumed": resumed_journal})
     try:
         done = sched.run(requests)
         if programs_armed:
@@ -190,6 +328,10 @@ def serve_run(cfg: TrainConfig) -> Dict:
             if budget:
                 registry.emit("hbm_budget", **budget)
     finally:
+        if journal is not None:
+            journal.close()
+        if watchdog is not None:
+            watchdog.close()
         if programs_armed:
             observe_device.set_enabled(False)
         if registry_mod.get_active() is registry:
@@ -199,6 +341,7 @@ def serve_run(cfg: TrainConfig) -> Dict:
     ttfts = np.asarray([c.ttft_s for c in done])
     summary["ttft_ms_p50"] = round(1e3 * float(np.percentile(ttfts, 50)), 3)
     summary["ttft_ms_p95"] = round(1e3 * float(np.percentile(ttfts, 95)), 3)
+    summary["ttft_ms_p99"] = round(1e3 * float(np.percentile(ttfts, 99)), 3)
     summary["tok_ms_mean"] = round(
         float(np.mean([c.tok_ms for c in done])), 4)
     summary["params"] = "checkpoint" if restored else "fresh-init"
@@ -213,6 +356,12 @@ def serve_run(cfg: TrainConfig) -> Dict:
               f"{summary['prefill_compiles']} prefill programs "
               f"(buckets {summary['buckets']}), "
               f"{summary['params']} params", flush=True)
+        if plan or resumed_journal:
+            print(f"[serve] fire: retries={summary['retries']} "
+                  f"swaps={summary['swaps']} "
+                  f"swap_s={summary['swap_seconds']} "
+                  f"resumed={summary['resumed']} "
+                  f"ttft p99 {summary['ttft_ms_p99']}ms", flush=True)
         if cfg.observe.metrics_jsonl:
             print(f"[observe] serve metrics: "
                   f"{cfg.observe.metrics_jsonl} (summarize: python -m "

@@ -14,6 +14,29 @@ lifecycle lands in the observe registry: ``serve_request`` records
 (TTFT, per-token latency, queue steps) plus one final
 ``serve_summary`` (aggregate tokens/s, mean slot occupancy) —
 summarized by ``observe.report`` next to the training numbers.
+
+Serve-under-fire (all optional; zero cost unconfigured):
+
+- **fault plan**: consulted between decode steps on the engine's
+  decode-step clock — slot_nan poisons a KV row, reload triggers a
+  live weight swap, sigterm/sigkill self-signal (resilience/faults.py;
+  decode_stall is consumed inside the engine's watched fetch).
+- **slot-level retry**: a slot whose decode step produced non-finite
+  logits is quarantined — freed and its request re-queued at the head
+  as a CONTINUATION (prompt + the good tokens so far, remaining
+  budget) — so one poisoned slot costs one re-prefill, never an
+  engine restart, and greedy determinism keeps the final token stream
+  identical. A per-request retry budget (``slot_retries``) turns
+  repeated quarantine of the SAME request into
+  :class:`SlotRetryExhausted` — the serve-mode divergence signal
+  (exit 2; the supervisor does not hot-loop restarts on it).
+- **journal**: admits/tokens/completions append to a
+  :class:`serve.journal.RequestJournal`, flushed per scheduler
+  iteration, so a SIGKILL'd leg is resumable at token granularity.
+- **live weight swap**: ``reload_fn`` (serve/run.py wires it to
+  train.checkpoint.restore_params) supplies fresh params; the engine
+  swaps them in between steps with slots live; swap latency lands in
+  the summary and a ``weight_swap`` recovery event.
 """
 
 from __future__ import annotations
@@ -26,6 +49,14 @@ from typing import Callable, List, Optional, Sequence
 import numpy as np
 
 from tensorflow_distributed_tpu.serve.engine import SlotDecodeEngine
+
+
+class SlotRetryExhausted(RuntimeError):
+    """The same request was slot-quarantined past its retry budget —
+    serve mode's DIVERGED equivalent (deterministic greedy decode will
+    poison the same way again; restarting would hot-loop). The CLI
+    maps this to exit code 2, which the supervisor refuses to
+    restart."""
 
 
 @dataclasses.dataclass
@@ -52,11 +83,23 @@ class Completion:
     ttft_s: float             # arrival -> first token (queue + prefill)
     decode_s: float           # first token -> last token
     queue_steps: int          # decode steps endured while admittable
+    retries: int = 0          # slot quarantines this request survived
+    recovery_window: bool = False  # a recovery event (quarantine/
+    #                                swap/restart continuation) fell
+    #                                inside arrival->first token —
+    #                                firebench's p99-TTFT-during-
+    #                                recovery population
+    decoded: int = 0          # tokens decoded THIS leg (excludes a
+    #                           continuation's journal-replayed base —
+    #                           those were decoded by the dead leg)
 
     @property
     def tok_ms(self) -> float:
-        """Mean inter-token latency (ms) over the decode phase."""
-        return 1e3 * self.decode_s / max(1, len(self.tokens) - 1)
+        """Mean inter-token latency (ms) over THIS leg's decode phase
+        (a continuation's base tokens were decoded by the dead leg —
+        charging them here would deflate the latency)."""
+        n = self.decoded or len(self.tokens)
+        return 1e3 * self.decode_s / max(1, n - 1)
 
 
 @dataclasses.dataclass
@@ -66,6 +109,9 @@ class _Live:
     tokens: List[int]
     t_first: float
     queue_steps: int
+    base: List[int]           # tokens from before a continuation
+    #                           (journal replay or slot retry) — the
+    #                           completion reports base + tokens
 
 
 class Scheduler:
@@ -74,15 +120,28 @@ class Scheduler:
     def __init__(self, engine: SlotDecodeEngine, decode_priority: int = 8,
                  registry=None,
                  on_token: Optional[Callable[[int, int, bool], None]] = None,
-                 clock=time.perf_counter):
+                 clock=time.perf_counter, fault_plan=None, journal=None,
+                 reload_fn=None, slot_retries: int = 2,
+                 summary_extra=None):
         if decode_priority < 1:
             raise ValueError(
                 f"decode_priority must be >= 1, got {decode_priority}")
+        if slot_retries < 0:
+            raise ValueError(
+                f"slot_retries must be >= 0, got {slot_retries}")
         self.engine = engine
         self.decode_priority = decode_priority
         self.registry = registry
         self.on_token = on_token
         self.clock = clock
+        self.fault_plan = fault_plan
+        self.journal = journal
+        self.reload_fn = reload_fn    # () -> (params, ckpt_step)
+        self.slot_retries = slot_retries
+        # Run-identity fields (seed, trace name) merged into the
+        # serve_summary RECORD so the JSONL artifact is reproducible
+        # standalone (FIREBENCH re-derives workloads from it).
+        self.summary_extra = dict(summary_extra or {})
 
     def _emit(self, event: str, **fields) -> None:
         if self.registry is not None:
@@ -92,6 +151,7 @@ class Scheduler:
         """Serve every request to completion; returns completions in
         finish order (sort by ``rid`` for submission order)."""
         eng = self.engine
+        plan = self.fault_plan
         for r in requests:
             if not eng.fits(len(r.prompt), r.max_new_tokens):
                 raise ValueError(
@@ -113,6 +173,13 @@ class Scheduler:
         run_steps = 0  # THIS run's decode steps (the engine counter
         #                spans its whole lifetime — reuse would skew
         #                the occupancy mean)
+        retries: dict = {}            # rid -> quarantines survived
+        first_seen: dict = {}         # rid -> first-token time (the
+        #                               TTFT point survives retries)
+        total_retries = 0
+        self._swap_seconds = 0.0
+        recovery_ts: List[float] = []  # quarantine/swap times, for the
+        #                                recovery-window TTFT flag
 
         def now() -> float:
             return self.clock() - t0
@@ -121,18 +188,39 @@ class Scheduler:
             t = now()
             eng.free(lv.slot)
             del live[lv.slot]
+            tokens = lv.base + lv.tokens
+            t_first = first_seen.get(lv.req.rid, lv.t_first)
+            n_retries = retries.get(lv.req.rid, 0)
+            # Recovery population: a quarantine/swap fell inside this
+            # request's arrival->first-token window, OR the request is
+            # a restart continuation (its base tokens crossed a
+            # process death — the resumed leg consumed the plan, so
+            # recovery_ts alone would miss exactly the requests the
+            # restart hit).
+            window = (any(lv.req.arrival_s <= rt <= t_first
+                          for rt in recovery_ts)
+                      or bool(lv.base))
             comp = Completion(
-                rid=lv.req.rid, prompt_len=len(lv.req.prompt),
-                tokens=lv.tokens, finish=why,
-                ttft_s=lv.t_first - lv.req.arrival_s,
-                decode_s=t - lv.t_first, queue_steps=lv.queue_steps)
+                rid=lv.req.rid,
+                prompt_len=len(lv.req.prompt) - len(lv.base),
+                tokens=tokens, finish=why,
+                ttft_s=t_first - lv.req.arrival_s,
+                decode_s=t - t_first, queue_steps=lv.queue_steps,
+                retries=n_retries, recovery_window=window,
+                decoded=len(lv.tokens))
             done.append(comp)
             self._emit("serve_request", rid=comp.rid,
                        prompt_len=comp.prompt_len,
                        new_tokens=len(comp.tokens), finish=why,
                        ttft_ms=round(1e3 * comp.ttft_s, 3),
                        tok_ms=round(comp.tok_ms, 4),
-                       queue_steps=comp.queue_steps)
+                       queue_steps=comp.queue_steps,
+                       retries=n_retries,
+                       recovery_window=window,
+                       arrival_s=round(lv.req.arrival_s, 4),
+                       t_first_s=round(t_first, 4))
+            if self.journal is not None:
+                self.journal.done(comp.rid)
             if self.on_token is not None:
                 self.on_token(comp.rid, comp.tokens[-1], True)
 
@@ -140,9 +228,20 @@ class Scheduler:
             req = queue.popleft()
             slot = eng.free_slots()[0]
             first = eng.prefill(req.prompt, slot)
+            base = list(getattr(req, "_base_tokens", ()))
             lv = _Live(req=req, slot=slot, tokens=[first],
-                       t_first=now(), queue_steps=req._waited)
+                       t_first=now(), queue_steps=req._waited,
+                       base=base)
             live[slot] = lv
+            if req.rid not in first_seen:
+                if not base and self.journal is not None:
+                    # First-ever admission of this request (a replayed
+                    # continuation was journaled by the previous leg).
+                    self.journal.admit(req.rid, req.prompt,
+                                       req.max_new_tokens, req.eos_id)
+                first_seen[req.rid] = lv.t_first
+            if self.journal is not None:
+                self.journal.token(req.rid, first, now())
             if self.on_token is not None and not (
                     first == req.eos_id or req.max_new_tokens == 1):
                 self.on_token(req.rid, first, False)
@@ -150,6 +249,57 @@ class Scheduler:
                 finish(lv, "eos")
             elif req.max_new_tokens == 1:
                 finish(lv, "length")
+
+        def quarantine(lv: _Live) -> None:
+            """Contain one poisoned slot: free it, re-queue the
+            request as a continuation at the head (prompt + good
+            tokens, remaining budget). Greedy decode is deterministic,
+            so the re-prefilled continuation emits exactly the tokens
+            the poisoned step would have — token identity is preserved
+            (pinned in tests/test_serve_fire.py)."""
+            nonlocal total_retries, steps_since_admit
+            eng.free(lv.slot)
+            del live[lv.slot]
+            rid = lv.req.rid
+            n = retries[rid] = retries.get(rid, 0) + 1
+            if n > self.slot_retries:
+                raise SlotRetryExhausted(
+                    f"request {rid} slot-quarantined {n} times "
+                    f"(budget {self.slot_retries}): repeated NaN on "
+                    f"the same request is a divergence, not a "
+                    f"transient — halting instead of hot-looping "
+                    f"re-prefills")
+            total_retries += 1
+            t = now()
+            recovery_ts.append(t)
+            self._emit("recovery", kind="slot_quarantine", rid=rid,
+                       slot=lv.slot, retry=n, t_s=round(t, 4))
+            good = lv.base + lv.tokens
+            # graftcheck: disable=host-sync-in-loop -- builds the
+            # continuation prompt from HOST token lists (no device
+            # value involved); runs once per quarantine, not per step
+            cont = Request(
+                rid=rid,
+                prompt=np.concatenate(
+                    [np.asarray(lv.req.prompt, np.int32),
+                     np.asarray(lv.tokens, np.int32)])
+                if lv.tokens else np.asarray(lv.req.prompt, np.int32),
+                max_new_tokens=lv.req.max_new_tokens - len(lv.tokens),
+                eos_id=lv.req.eos_id, arrival_s=lv.req.arrival_s)
+            if len(cont.prompt) > max(eng.buckets):
+                raise ValueError(
+                    f"request {rid}: continuation prompt "
+                    f"{len(cont.prompt)} exceeds the largest bucket "
+                    f"{max(eng.buckets)} — slot retry needs the "
+                    f"ladder sized to prompt+new tokens (serve/run.py "
+                    f"does this when a fault plan is armed; with "
+                    f"--serve.buckets, cover the full trajectory)")
+            cont._base_tokens = good
+            cont._waited = lv.queue_steps
+            queue.appendleft(cont)
+            # Re-admit without waiting out the decode-priority clock:
+            # the request was already being served.
+            steps_since_admit = self.decode_priority
 
         while pending or queue or live:
             # Open-loop arrivals: everything whose time has come.
@@ -162,6 +312,8 @@ class Scheduler:
                     >= self.decode_priority):
                 admit()
                 steps_since_admit = 0
+                if self.journal is not None:
+                    self.journal.flush()
                 continue
             if not live:
                 if pending:
@@ -170,6 +322,26 @@ class Scheduler:
                     time.sleep(max(0.0, pending[0].arrival_s - now()))
                     continue
                 break  # queue must be empty too (free slots exist)
+            if plan:
+                # The serve-phase fault points, on the decode-step
+                # clock (resilience/faults.py): poison, swap, signal.
+                # decode_stall is consumed inside the engine's watched
+                # fetch.
+                nstep = eng.decode_steps + 1
+                bad_slot = plan.take_slot_nan(nstep)
+                if bad_slot is not None:
+                    if bad_slot not in live:
+                        # The drill wants a SERVING slot: the named one
+                        # is momentarily empty (freed last step, next
+                        # insert pending — whose full-row overwrite
+                        # would neutralize the poison), so redirect to
+                        # the lowest live slot. live is non-empty here
+                        # (the not-live branch above already continued).
+                        bad_slot = min(live)
+                    eng.poison_slot(bad_slot)
+                if plan.take_reload(nstep):
+                    self._swap(now, recovery_ts)
+                plan.maybe_signal(nstep)
             nxt = eng.step()
             occupancy_sum += eng.occupancy()
             run_steps += 1
@@ -181,24 +353,41 @@ class Scheduler:
                 # within decode_priority such steps.
                 steps_since_admit += 1
                 queue[0]._waited += 1
+            # Containment BEFORE token retirement: a poisoned slot's
+            # token is garbage — quarantine drops it (never appended,
+            # never journaled) and the continuation re-derives it.
+            for slot in getattr(eng, "take_bad_slots", lambda: [])():
+                if slot in live:
+                    quarantine(live[slot])
             for slot in list(live):
                 lv = live[slot]
                 tok = int(nxt[slot])
                 lv.tokens.append(tok)
+                if self.journal is not None:
+                    self.journal.token(lv.req.rid, tok, now())
                 if tok == lv.req.eos_id:
                     finish(lv, "eos")
                 elif len(lv.tokens) >= lv.req.max_new_tokens:
                     finish(lv, "length")
                 elif self.on_token is not None:
                     self.on_token(lv.req.rid, tok, False)
+            if self.journal is not None:
+                self.journal.flush()
 
         wall = now()
         total_new = sum(len(c.tokens) for c in done)
+        # Throughput counts only tokens DECODED this leg: a resumed
+        # leg's continuations deliver their journal-replayed base
+        # tokens too (total_new_tokens — the user-facing count), but
+        # those were the dead leg's work; dividing them by this leg's
+        # wall would overstate tokens/s exactly when it matters.
+        decoded = sum(c.decoded or len(c.tokens) for c in done)
         summary = {
             "requests": len(done),
             "total_new_tokens": total_new,
+            "decoded_tokens": decoded,
             "wall_s": round(wall, 4),
-            "tokens_per_sec": round(total_new / max(wall, 1e-9), 2),
+            "tokens_per_sec": round(decoded / max(wall, 1e-9), 2),
             "mean_slot_occupancy": round(
                 occupancy_sum / max(1, run_steps), 4),
             "decode_steps": run_steps,
@@ -207,7 +396,34 @@ class Scheduler:
             "buckets": ",".join(str(b) for b in eng.buckets),
             "num_slots": eng.num_slots,
             "decode_priority": self.decode_priority,
+            "retries": total_retries,
+            "swaps": getattr(eng, "swaps", 0),
+            "swap_seconds": round(self._swap_seconds, 4),
+            **self.summary_extra,
         }
         self._emit("serve_summary", **summary)
         self.summary = summary
+        if self.journal is not None:
+            self.journal.flush()
         return done
+
+    def _swap(self, now, recovery_ts: List[float]) -> None:
+        """One live weight swap: fetch fresh params via ``reload_fn``
+        (integrity-verified, fallback-to-newest-verifiable —
+        train.checkpoint.restore_params), hand them to the engine
+        between decode steps, account the latency."""
+        if self.reload_fn is None:
+            raise ValueError(
+                "fault plan requests a reload but no reload_fn is "
+                "wired (mode=serve needs --checkpoint-dir for live "
+                "weight swap)")
+        t0 = self.clock()
+        params, ckpt_step = self.reload_fn()
+        self.engine.swap_params(params)
+        dt = self.clock() - t0
+        self._swap_seconds += dt
+        t = now()
+        recovery_ts.append(t)
+        self._emit("recovery", kind="weight_swap",
+                   seconds=round(dt, 4), ckpt_step=ckpt_step,
+                   t_s=round(t, 4))

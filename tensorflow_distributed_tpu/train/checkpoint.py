@@ -514,29 +514,7 @@ def restore_averaged(ckpt_dir: str, state: Any,
     steps = available_steps(ckpt_dir)
 
     def read_raw(s: int):
-        sd = _step_dir(ckpt_dir, s)
-        opath = os.path.join(sd, _ORBAX_DIRNAME)
-        if os.path.exists(os.path.join(sd, _ORBAX_MARKER)):
-            # Orbax OCDBT layout, detected via the COMMIT MARKER
-            # exactly like restore() — a crashed orbax re-save into a
-            # dir holding an intact native state.msgpack must fall
-            # through to the msgpack, not dispatch onto unmarked
-            # shard debris. Template-free restore reads the SAVED
-            # (replica-stacked) tree as host numpy — the shapes come
-            # from the checkpoint, which is the point (the stacked
-            # leaves don't match the plain template until after the
-            # mean below). Warning-free topology safety doesn't
-            # apply: host arrays carry no sharding to mismatch.
-            import warnings
-
-            with warnings.catch_warnings():
-                warnings.simplefilter("ignore")
-                return opath, jax.tree_util.tree_map(
-                    np.asarray, _orbax().restore(opath))
-        # Same read+verify path as restore(): a checksum-mismatched
-        # or truncated blob raises CheckpointCorruptError.
-        return (os.path.join(sd, "state.msgpack"),
-                _load_native_raw(sd))
+        return _read_raw(_step_dir(ckpt_dir, s))
 
     if step is not None:
         if step not in steps:
@@ -582,6 +560,142 @@ def restore_averaged(ckpt_dir: str, state: Any,
         if key in raw:
             raw[key] = jax.tree_util.tree_map(mean0, raw[key])
     return _restore_from_raw(raw, state)
+
+
+def _read_raw(step_path: str):
+    """Read one checkpoint's state dict to HOST numpy, either backend
+    (orbax OCDBT via the commit marker, else native msgpack with
+    checksum verification). Returns (path, raw). Shared by
+    restore_averaged and restore_params — the paths that need the raw
+    tree rather than a templated restore."""
+    opath = os.path.join(step_path, _ORBAX_DIRNAME)
+    if os.path.exists(os.path.join(step_path, _ORBAX_MARKER)):
+        # Orbax OCDBT layout, detected via the COMMIT MARKER exactly
+        # like restore() — a crashed orbax re-save into a dir holding
+        # an intact native state.msgpack must fall through to the
+        # msgpack, not dispatch onto unmarked shard debris.
+        # Template-free restore reads the SAVED tree as host numpy:
+        # the shapes come from the checkpoint, which is the point.
+        # Warning-free topology safety doesn't apply: host arrays
+        # carry no sharding to mismatch.
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            return opath, jax.tree_util.tree_map(
+                np.asarray, _orbax().restore(opath))
+    # Same read+verify path as restore(): a checksum-mismatched or
+    # truncated blob raises CheckpointCorruptError.
+    return os.path.join(step_path, "state.msgpack"), _load_native_raw(
+        step_path)
+
+
+def _host_finite(tree: Any) -> bool:
+    """True when every float leaf of a HOST tree is fully finite."""
+    for leaf in jax.tree_util.tree_leaves(tree):
+        arr = np.asarray(leaf)
+        if (np.issubdtype(arr.dtype, np.floating)
+                and not np.isfinite(arr).all()):
+            return False
+    return True
+
+
+@_goodput.accounted("restore")
+def restore_params(ckpt_dir: str, params: Any,
+                   step: Optional[int] = None,
+                   prefer_ema: bool = True):
+    """PARAMS-ONLY restore for live weight swap: read the newest
+    verifiable checkpoint's params (EMA preferred, matching the serve/
+    eval restore convention) into the structure and shardings of the
+    LIVE ``params`` tree, without touching optimizer state or needing a
+    full TrainState template. Returns ``(new_params, step)``.
+
+    The serving engine swaps these in BETWEEN decode steps: same
+    shapes/dtypes/shardings as the running params (the engine asserts
+    the sharding contract), so the hot decode program is a jit cache
+    hit — no drain, no recompile, in-flight KV caches untouched.
+
+    Integrity contract mirrors restore(): ``step=None`` walks back from
+    the newest step past anything that fails the sha256/decode check
+    (quarantined) or carries NON-FINITE params (skipped with a recovery
+    event, NOT quarantined — the bytes are intact and a training-side
+    rewind may still want to forensically inspect them); an explicit
+    ``step`` is exact and raises instead of recovering around damage.
+    Replica-stacked (local SGD) checkpoints are averaged over the
+    replica dim, like restore_averaged."""
+    _warm_runtime()
+    steps = available_steps(ckpt_dir)
+    candidates = ([step] if step is not None else list(reversed(steps)))
+    if step is not None and step not in steps:
+        raise FileNotFoundError(
+            f"no checkpoint for step {step} under {ckpt_dir}; "
+            f"available steps: {steps if steps else 'none'}")
+    if not steps:
+        raise FileNotFoundError(
+            f"no checkpoints under {ckpt_dir} — live weight swap needs "
+            f"at least one completed save")
+    last_err: Optional[Exception] = None
+    got = None
+    for s in candidates:
+        try:
+            path, raw = _read_raw(_step_dir(ckpt_dir, s))
+        except CheckpointCorruptError as e:
+            if step is not None:
+                raise
+            _quarantine(ckpt_dir, s, str(e))
+            last_err = e
+            continue
+        tree = raw.get("ema") if (prefer_ema and isinstance(raw, dict)
+                                  and raw.get("ema") is not None) \
+            else raw.get("params") if isinstance(raw, dict) else None
+        if tree is None:
+            raise ValueError(
+                f"checkpoint at {path} carries no params tree")
+        if (isinstance(raw.get("step"), np.ndarray)
+                and raw["step"].ndim == 1):
+            # Replica-stacked local-SGD save: average the replica dim
+            # (float leaves mean, ints take replica 0), matching
+            # restore_averaged's convention.
+            tree = jax.tree_util.tree_map(
+                lambda x: (x.mean(axis=0)
+                           if np.issubdtype(x.dtype, np.floating)
+                           else x[0])
+                if isinstance(x, np.ndarray) and x.ndim else x, tree)
+        if not _host_finite(tree):
+            msg = (f"params at step {s} are non-finite — not a swap "
+                   f"target")
+            if step is not None:
+                raise ValueError(msg)
+            emit_event("recovery", kind="swap_skip", step=s,
+                       reason="non-finite params")
+            last_err = ValueError(msg)
+            continue
+        got = (s, tree)
+        break
+    if got is None:
+        raise CheckpointCorruptError(
+            f"no verifiable swap target under {ckpt_dir}; last error: "
+            f"{last_err}")
+    s, tree = got
+    skeleton = jax.tree_util.tree_map(
+        lambda leaf: np.zeros(leaf.shape, leaf.dtype)
+        if isinstance(leaf, jax.Array) else leaf, params)
+    host = serialization.from_state_dict(skeleton, tree)
+
+    def place(tmpl, val):
+        if (isinstance(tmpl, jax.Array)
+                and np.shape(val) != tmpl.shape):
+            raise ValueError(
+                f"checkpoint param shape {np.shape(val)} != live "
+                f"{tmpl.shape}: live weight swap needs an identical "
+                f"architecture (same config, same sharding)")
+        if isinstance(tmpl, jax.Array) and not tmpl.is_fully_addressable:
+            arr = np.asarray(val)
+            return jax.make_array_from_callback(
+                arr.shape, tmpl.sharding, lambda idx: arr[idx])
+        return jax.device_put(val, getattr(tmpl, "sharding", None))
+
+    return jax.tree_util.tree_map(place, params, host), s
 
 
 def _plus_zero(tree: Any) -> Any:
