@@ -82,6 +82,37 @@ if [ "$fire_rc" -ne 0 ]; then
        "$FIRELOG" >&2
 fi
 
+# Elasticbench smoke (elastic restarts: device_loss -> supervisor
+# --elastic shrinks mesh 2 -> 1 -> resharded resume continues —
+# benchmarks/elasticbench.py): tiny CPU run, CORRECTNESS-gated (loss
+# identical to the uninterrupted baseline within 1e-3, zero completed
+# steps lost, the reshard actually happened); the committed
+# ELASTICBENCH.json run carries the full 4->2 and 4->8 matrix. Same
+# abort-guard shape as the smokes above: a run that dies to the known
+# container XLA:CPU abort prints no elastic_checks line and is
+# retried once; a genuine gate failure prints one and is NOT retried.
+ELASTICLOG="${ELASTICLOG:-/tmp/_t1_elastic.log}"
+run_elasticbench() {
+  rm -f "$ELASTICLOG"
+  timeout -k 10 300 env JAX_PLATFORMS=cpu python -m \
+    tensorflow_distributed_tpu.benchmarks.elasticbench \
+    --devices 2 --lose 1 --grow-to 0 --steps 8 --ckpt-every 2 \
+    --out "" 2>&1 | tee "$ELASTICLOG"
+  return "${PIPESTATUS[0]}"
+}
+run_elasticbench
+elastic_rc=$?
+if ! grep -qa '"metric": "elastic_checks"' "$ELASTICLOG"; then
+  echo "[t1] no elastic_checks line in $ELASTICLOG (known container" \
+       "XLA:CPU abort) — rerunning elasticbench once" >&2
+  run_elasticbench
+  elastic_rc=$?
+fi
+if [ "$elastic_rc" -ne 0 ]; then
+  echo "[t1] elasticbench smoke FAILED (elastic_rc=$elastic_rc) —" \
+       "see $ELASTICLOG" >&2
+fi
+
 if [ "$rc" -eq 0 ] && [ "$lint_rc" -ne 0 ]; then
   echo "[t1] suite green but graftcheck red (lint_rc=$lint_rc) — see" \
        "scripts/lint.sh output above" >&2
@@ -89,5 +120,8 @@ if [ "$rc" -eq 0 ] && [ "$lint_rc" -ne 0 ]; then
 fi
 if [ "$rc" -eq 0 ] && [ "$fire_rc" -ne 0 ]; then
   exit "$fire_rc"
+fi
+if [ "$rc" -eq 0 ] && [ "$elastic_rc" -ne 0 ]; then
+  exit "$elastic_rc"
 fi
 exit "$rc"

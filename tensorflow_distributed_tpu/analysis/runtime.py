@@ -46,6 +46,22 @@ def _describe(sharding: Any) -> str:
     return str(spec) if spec is not None else str(sharding)
 
 
+def sharding_spec_strings(tree: Any) -> dict:
+    """``{"/"-joined leaf path: str(PartitionSpec)}`` for every sharded
+    leaf — the serializable layout record the checkpoint layer writes
+    into its mesh manifest (train/checkpoint.py), kept here so the
+    contract checker and the manifest agree on how a layout is
+    described."""
+    out = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is not None:
+            key = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                           for k in path)
+            out[key] = _describe(sharding)
+    return out
+
+
 def assert_sharding_contract(tree: Any, declared: Any,
                              what: str = "params") -> None:
     """Raise ShardingContractError listing every leaf whose actual

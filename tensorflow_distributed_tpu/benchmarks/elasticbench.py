@@ -1,0 +1,265 @@
+"""Elastic-restart benchmark: train, lose chips, continue on a
+different mesh — same loss, zero lost steps.
+
+The robustness claim this pins (ISSUE 7 / ROADMAP item 4): a
+checkpoint written on mesh A resumes on mesh B — shrinking after a
+``device_loss`` under ``supervisor --elastic``, or growing onto
+returned capacity with a plain ``--resume`` — with the loss
+trajectory matching a never-interrupted run (same global batch, same
+data order; per-device batch re-derives from the new data-axis
+width), ZERO completed steps lost, and the resharded restore verified
+by the sharding-contract checker (``--check`` on every child plus
+``restore_resharded``'s own assertion).
+
+Procedure (all runs are CLI subprocesses, so the kill is real):
+
+1. BASELINE: an uninterrupted run on the initial mesh.
+2. SHRINK: the same run under ``resilience.supervisor --elastic``
+   with ``device_loss@K:L`` — at step K the drill writes the
+   device-mask file and SIGKILLs; the supervisor probes the
+   survivors, degrades the mesh, and the resharded resume continues
+   to the horizon. K defaults to one step past a checkpoint cadence,
+   so the resume replays nothing: zero completed steps lost.
+3. GROW: a first leg trains to the same kill point on the initial
+   mesh and exits cleanly (final save); a second leg resumes with
+   MORE devices — the capacity-comeback direction of the same
+   resharded restore.
+4. Gates: both elastic runs reach the full horizon, resume exactly at
+   the pre-kill checkpoint, emit a ``reshard_restore`` recovery event
+   (its ``seconds`` is the reported resharded-restore wall), and land
+   a final loss within ``--loss-tol`` of the baseline's.
+
+Emits one JSON line per metric plus an ``elastic_checks`` line;
+``--out`` writes ELASTICBENCH.json; exit 1 on any failed gate
+(``--no-check`` to report without gating).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+
+def _env(n_devices: int) -> dict:
+    env = dict(os.environ)
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if not f.startswith(
+                 "--xla_force_host_platform_device_count")]
+    flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYTHONUNBUFFERED"] = "1"
+    return env
+
+
+def _run(cmd, env, timeout, what):
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=timeout)
+    if proc.returncode != 0:
+        print(f"elasticbench: {what} failed rc={proc.returncode}\n"
+              f"{proc.stdout[-3000:]}\n{proc.stderr[-2000:]}",
+              file=sys.stderr)
+        raise SystemExit(1)
+    return proc
+
+
+def _facts(jsonl: str) -> dict:
+    """The gate-relevant facts of one run's metrics JSONL: final loss,
+    steps completed, resume point, and the reshard event."""
+    from tensorflow_distributed_tpu.observe.report import load_records
+    recs = load_records(jsonl)
+    steps = [r for r in recs if r.get("event") == "step"]
+    summaries = [r for r in recs if r.get("event") == "summary"]
+    resumed = [r for r in recs if r.get("event") == "resumed"]
+    reshard = [r for r in recs if r.get("event") == "recovery"
+               and r.get("kind") == "reshard_restore"]
+    return {
+        "last_loss": (float(steps[-1]["loss"])
+                      if steps and "loss" in steps[-1] else None),
+        "steps": (int(summaries[-1].get("steps", 0))
+                  if summaries else None),
+        "resumed_step": (int(resumed[-1]["step"]) if resumed else None),
+        "reshard": reshard[-1] if reshard else None,
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--devices", type=int, default=4,
+                        help="initial mesh data width (and visible "
+                        "device count for those legs)")
+    parser.add_argument("--lose", type=int, default=2,
+                        help="chips the device_loss drill takes")
+    parser.add_argument("--grow-to", type=int, default=8,
+                        help="mesh width of the capacity-comeback "
+                        "resume (0 = skip the grow run)")
+    parser.add_argument("--steps", type=int, default=24)
+    parser.add_argument("--batch", type=int, default=64)
+    parser.add_argument("--ckpt-every", type=int, default=6)
+    parser.add_argument("--kill-step", type=int, default=0,
+                        help="device_loss step (default: one past the "
+                        "second checkpoint cadence, so the resume "
+                        "replays zero completed steps)")
+    parser.add_argument("--loss-tol", type=float, default=1e-3)
+    parser.add_argument("--timeout", type=float, default=420.0,
+                        help="per-subprocess timeout (s)")
+    parser.add_argument("--workdir", default="",
+                        help="scratch dir (default: a fresh tempdir, "
+                        "removed on success)")
+    parser.add_argument("--no-check", action="store_true")
+    parser.add_argument("--out", default="ELASTICBENCH.json")
+    args = parser.parse_args(argv)
+    if not 0 < args.lose < args.devices:
+        parser.error("--lose must leave at least one device alive")
+    if args.batch % args.devices or (
+            args.grow_to and args.batch % args.grow_to):
+        parser.error("--batch must divide by --devices and --grow-to")
+    kill = args.kill_step or 2 * args.ckpt_every + 1
+    if not args.ckpt_every < kill <= args.steps:
+        parser.error("--kill-step must land after the first "
+                     "checkpoint and within --steps")
+
+    work = args.workdir or tempfile.mkdtemp(prefix="elasticbench-")
+    os.makedirs(work, exist_ok=True)
+    common = [
+        "--dataset", "synthetic", "--batch-size", str(args.batch),
+        "--train-steps", str(args.steps), "--eval-every", "0",
+        "--log-every", "1", "--eval-batch-size", str(args.batch),
+        "--compute-dtype", "float32", "--seed", "0",
+    ]
+
+    # 1. Uninterrupted baseline on the initial mesh.
+    base_jsonl = os.path.join(work, "base.jsonl")
+    _run([sys.executable, "-m", "tensorflow_distributed_tpu.cli",
+          *common, "--mesh.data", str(args.devices),
+          "--observe.metrics-jsonl", base_jsonl],
+         _env(args.devices), args.timeout, "baseline")
+
+    # 2. SHRINK: device_loss under the elastic supervisor. --check on
+    # the children runs the sharding-contract assertion and transfer
+    # guard through the resize.
+    shrink_ckpt = os.path.join(work, "ckpt_shrink")
+    shrink_jsonl = os.path.join(work, "shrink.jsonl")
+    shrink = _run(
+        [sys.executable, "-m",
+         "tensorflow_distributed_tpu.resilience.supervisor",
+         "--elastic", "--max-restarts", "2", "--backoff-base-s", "0.2",
+         "--", *common, "--mesh.data", str(args.devices),
+         "--check", "true",
+         "--checkpoint-dir", shrink_ckpt,
+         "--checkpoint-every", str(args.ckpt_every),
+         "--observe.metrics-jsonl", shrink_jsonl,
+         "--resilience.fault-plan", f"device_loss@{kill}:{args.lose}"],
+        _env(args.devices), args.timeout, "shrink (elastic supervisor)")
+    shrink_restarts = shrink.stdout.count('"kind": "restart"')
+    shrink_changes = shrink.stdout.count('"kind": "mesh_change"')
+
+    # 3. GROW: train to the kill point, exit cleanly, resume wider.
+    grow_facts = None
+    if args.grow_to:
+        grow_ckpt = os.path.join(work, "ckpt_grow")
+        grow_jsonl = os.path.join(work, "grow.jsonl")
+        leg1 = [a for a in common]
+        leg1[leg1.index("--train-steps") + 1] = str(kill - 1)
+        _run([sys.executable, "-m", "tensorflow_distributed_tpu.cli",
+              *leg1, "--mesh.data", str(args.devices),
+              "--checkpoint-dir", grow_ckpt,
+              "--checkpoint-every", str(args.ckpt_every)],
+             _env(args.devices), args.timeout, "grow leg 1")
+        _run([sys.executable, "-m", "tensorflow_distributed_tpu.cli",
+              *common, "--mesh.data", str(args.grow_to),
+              "--check", "true", "--resume", "true",
+              "--checkpoint-dir", grow_ckpt,
+              "--checkpoint-every", str(args.ckpt_every),
+              "--observe.metrics-jsonl", grow_jsonl],
+             _env(args.grow_to), args.timeout, "grow leg 2 (resume)")
+        grow_facts = _facts(grow_jsonl)
+
+    # 4. Gates.
+    base = _facts(base_jsonl)
+    shr = _facts(shrink_jsonl)
+
+    def _delta(facts):
+        if facts is None or facts["last_loss"] is None \
+                or base["last_loss"] is None:
+            return None
+        return abs(facts["last_loss"] - base["last_loss"])
+
+    shrink_delta, grow_delta = _delta(shr), _delta(grow_facts)
+    common_tags = {
+        "model": "mnist_cnn/synthetic", "steps": args.steps,
+        "batch": args.batch, "devices": args.devices,
+        "lose": args.lose, "grow_to": args.grow_to,
+        "kill_step": kill, "ckpt_every": args.ckpt_every,
+    }
+    lines = [
+        {"metric": "elastic_baseline_last_loss",
+         "value": base["last_loss"], "unit": "loss"},
+        {"metric": "elastic_shrink_last_loss",
+         "value": shr["last_loss"], "unit": "loss",
+         "delta_vs_baseline": shrink_delta,
+         "mesh": f"{args.devices}->{args.devices - args.lose}",
+         "resumed_step": shr["resumed_step"],
+         "restarts": shrink_restarts, "mesh_changes": shrink_changes},
+        {"metric": "elastic_shrink_reshard_seconds",
+         "value": (shr["reshard"] or {}).get("seconds"), "unit": "s",
+         "from_mesh": (shr["reshard"] or {}).get("from_mesh"),
+         "to_mesh": (shr["reshard"] or {}).get("to_mesh")},
+    ]
+    if grow_facts is not None:
+        lines += [
+            {"metric": "elastic_grow_last_loss",
+             "value": grow_facts["last_loss"], "unit": "loss",
+             "delta_vs_baseline": grow_delta,
+             "mesh": f"{args.devices}->{args.grow_to}",
+             "resumed_step": grow_facts["resumed_step"]},
+            {"metric": "elastic_grow_reshard_seconds",
+             "value": (grow_facts["reshard"] or {}).get("seconds"),
+             "unit": "s"},
+        ]
+    checks = {
+        "metric": "elastic_checks",
+        "loss_tol": args.loss_tol,
+        "shrink_loss_ok": bool(shrink_delta is not None
+                               and shrink_delta <= args.loss_tol),
+        "shrink_zero_lost_steps": bool(
+            shr["steps"] == args.steps
+            and shr["resumed_step"] == kill - 1),
+        "shrink_resharded_ok": bool(
+            shr["reshard"] is not None and shrink_changes >= 1
+            and shrink_restarts >= 1),
+        "grow_loss_ok": bool(args.grow_to == 0 or (
+            grow_delta is not None and grow_delta <= args.loss_tol)),
+        "grow_zero_lost_steps": bool(args.grow_to == 0 or (
+            grow_facts is not None
+            and grow_facts["steps"] == args.steps
+            and grow_facts["resumed_step"] == kill - 1)),
+        "grow_resharded_ok": bool(
+            args.grow_to == 0 or (grow_facts is not None
+                                  and grow_facts["reshard"]
+                                  is not None)),
+    }
+    lines.append(checks)
+    lines = [dict(ln, **common_tags) for ln in lines]
+    print("\n".join(json.dumps(ln) for ln in lines))
+    if args.out:
+        from tensorflow_distributed_tpu.observe.registry import (
+            write_jsonl)
+        write_jsonl(args.out, lines)
+    ok = all(v for k, v in checks.items()
+             if k.endswith("_ok") or k.endswith("_steps"))
+    if not args.no_check and not ok:
+        print(f"elasticbench: checks FAILED: {checks}", file=sys.stderr)
+        return 1
+    if not args.workdir:
+        shutil.rmtree(work, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -36,6 +36,15 @@ Examples:
     # analysis"): transfer guard + sharding-contract assertion
     python -m tensorflow_distributed_tpu.cli --train-steps 100 --check true
 
+    # elastic restarts (README "Elastic restarts"): supervise with
+    # --elastic and a chip-loss drill — the restart probes the
+    # surviving devices, degrades the mesh, and the resharded restore
+    # continues training instead of crash-looping
+    python -m tensorflow_distributed_tpu.resilience.supervisor \
+        --elastic -- --mesh.data 8 --checkpoint-dir /tmp/ckpt \
+        --checkpoint-every 50 \
+        --resilience.fault-plan "device_loss@120:4"
+
     # device telemetry (observe/device.py + observe/health.py; README
     # "Device telemetry"): compiled-program cost/HBM records + per-layer
     # health vitals in the metrics JSONL

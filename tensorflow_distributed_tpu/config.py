@@ -272,7 +272,12 @@ class ResilienceConfig:
     # data_stall (:duration, e.g. 5s, slept inside the batch fetch so
     # the watchdog sees it), sigterm / sigkill (self-signal when the
     # step is dispatched; first-leg only, so a supervised restart
-    # terminates). Under mode=serve the step key counts DECODE steps
+    # terminates), device_loss (:N lost chips, default 1 — writes the
+    # device-mask file under checkpoint_dir and hard-kills the
+    # process; under resilience.supervisor --elastic the restart
+    # degrades onto the best mesh that fits the survivors and
+    # CONTINUES via the resharded restore). Under mode=serve the step
+    # key counts DECODE steps
     # and the kinds are decode_stall (:duration, slept inside the
     # decode watchdog's window), slot_nan (:slot, NaN-poisons one
     # slot's KV row -> quarantine + re-prefill of only that slot),
@@ -931,6 +936,12 @@ class TrainConfig:
                         f"fault kinds {bad} are serve-phase only; "
                         f"mode=train consults {sorted(TRAIN_KINDS)} "
                         f"on the train-step clock")
+                if "device_loss" in kinds and not self.checkpoint_dir:
+                    raise ValueError(
+                        "fault kind 'device_loss' writes the device-"
+                        "mask file under --checkpoint-dir (and the "
+                        "elastic restart resumes from there); set "
+                        "one")
             else:
                 raise ValueError(
                     f"resilience.fault_plan has no injection points "

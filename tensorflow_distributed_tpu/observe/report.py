@@ -141,6 +141,34 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                      and isinstance(r.get("seconds"), (int, float)))
         if swap_s:
             out["swap_seconds_total"] = round(swap_s, 4)
+        # Elastic restarts: mesh_change (the supervisor's resize
+        # decision) and reshard_restore (the loop's resharded resume,
+        # which carries the resize window's wall time). A supervised
+        # resize emits both — the transition path prefers the
+        # supervisor's records, the seconds come from the restores.
+        mesh_moves = [r for r in recoveries
+                      if r.get("kind") in ("mesh_change",
+                                           "reshard_restore")]
+        if mesh_moves:
+            def _fmt(shape):
+                if not isinstance(shape, dict):
+                    return "?"
+                parts = [f"{k}={v}" for k, v in shape.items()
+                         if v != 1]
+                return ",".join(parts) if parts else "single-device"
+
+            changes = [r for r in mesh_moves
+                       if r.get("kind") == "mesh_change"] or mesh_moves
+            out["mesh_changes"] = len(changes)
+            out["mesh_change_path"] = ", ".join(
+                f"{_fmt(r.get('from_mesh'))} -> {_fmt(r.get('to_mesh'))}"
+                for r in changes)
+            reshard_s = sum(
+                float(r["seconds"]) for r in mesh_moves
+                if r.get("kind") == "reshard_restore"
+                and isinstance(r.get("seconds"), (int, float)))
+            if reshard_s:
+                out["reshard_seconds_total"] = round(reshard_s, 4)
     # Compiled-program registry (observe/device.py "compile" records):
     # latest record per program — name, flops, peak-HBM estimate,
     # compile seconds — the device-side cost/memory inventory.
@@ -213,7 +241,9 @@ def render(summary: Dict[str, Any]) -> str:
     # programs/health/recovery render as their own sections below;
     # peak_hbm_bytes_sum renders as the Programs TOTAL row.
     sections = ("programs", "health", "peak_hbm_bytes_sum",
-                "recovery_counts", "swap_seconds_total")
+                "recovery_counts", "swap_seconds_total",
+                "mesh_changes", "mesh_change_path",
+                "reshard_seconds_total")
     for key in order:
         if key in summary:
             lines.append(f"  {key:<22} {summary[key]}")
@@ -243,6 +273,13 @@ def render(summary: Dict[str, Any]) -> str:
         if "swap_seconds_total" in summary:
             lines.append(f"  {'swap_seconds_total':<28} "
                          f"{summary['swap_seconds_total']}")
+        if "mesh_changes" in summary:
+            lines.append(f"  {'mesh_changes':<28} "
+                         f"{summary['mesh_changes']} "
+                         f"({summary['mesh_change_path']})")
+        if "reshard_seconds_total" in summary:
+            lines.append(f"  {'reshard_seconds_total':<28} "
+                         f"{summary['reshard_seconds_total']}")
     if "health" in summary:
         lines.append("Health")
         for module, entry in summary["health"].items():

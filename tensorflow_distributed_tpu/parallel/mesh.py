@@ -160,6 +160,34 @@ def process_axis_range(mesh: Mesh, axis: str, dim: int):
     return coord0 * rows, (coord0 + count) * rows
 
 
+def alive_devices() -> list:
+    """``jax.devices()`` minus the drill mask.
+
+    ``TFD_DEVICE_MASK=N`` hides the LAST N devices from mesh
+    construction — the mechanism by which an elastic-restart drill
+    (resilience/faults.py ``device_loss``, resilience/supervisor.py
+    ``--elastic``) models dead chips on a host whose runtime still
+    enumerates them. A real preemption needs no mask: the lost chips
+    are simply absent from ``jax.devices()`` on the restarted leg.
+    Unset (the default) this is exactly ``jax.devices()``.
+    """
+    devs = list(jax.devices())
+    mask = int(os.environ.get("TFD_DEVICE_MASK", "0") or 0)
+    if mask < 0:
+        raise ValueError(f"TFD_DEVICE_MASK must be >= 0, got {mask}")
+    if mask >= len(devs):
+        raise ValueError(
+            f"TFD_DEVICE_MASK={mask} masks every device "
+            f"({len(devs)} visible) — nothing left to run on")
+    return devs[:len(devs) - mask] if mask else devs
+
+
+def mesh_shape_dict(mesh: Mesh) -> dict:
+    """``{axis: size}`` in MESH_AXES order — the serializable mesh
+    identity the checkpoint layer's mesh manifest records."""
+    return {a: int(mesh.shape[a]) for a in mesh.axis_names}
+
+
 def make_mesh(cfg: Optional[MeshConfig] = None,
               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     """Build a ``(data, pipe, seq, model)`` mesh over the given devices.
@@ -167,11 +195,12 @@ def make_mesh(cfg: Optional[MeshConfig] = None,
     ``cfg.data == -1`` means "all devices not consumed by
     pipe/seq/model". A 1-device mesh is valid and is exactly the
     reference's single-device path (mnist_single.py): same train step,
-    mesh of one.
+    mesh of one. Defaults to :func:`alive_devices` — the full device
+    set unless an elastic drill masked some.
     """
     cfg = cfg or MeshConfig()
     cfg.validate()
-    devices = list(devices if devices is not None else jax.devices())
+    devices = list(devices if devices is not None else alive_devices())
     n = len(devices)
     denom = cfg.model * cfg.seq * cfg.pipe * cfg.expert
     if n % denom != 0:

@@ -22,6 +22,16 @@ points real faults strike:
   supervisor must restart from. Signal events fire on the FIRST leg
   only (``bind(start_step=0)``): a resumed leg IS the recovery under
   test, and re-firing would kill a supervised run forever.
+- ``device_loss@K[:N]`` — lose N devices (default 1) at step K: the
+  drill writes the lost count to the device-mask file (under the
+  checkpoint dir) and hard-kills the process — a chip preemption,
+  which never says goodbye. An elastic supervisor
+  (``supervisor --elastic``) reads the mask, picks the best mesh
+  that fits the surviving devices, and restarts onto it (the restart
+  masks the "dead" chips via ``TFD_DEVICE_MASK`` —
+  parallel.mesh.alive_devices; real losses need no mask, the chips
+  are simply gone from ``jax.devices()``). First-leg-only like the
+  signals.
 
 Under ``--mode serve`` the step key counts DECODE steps (the serving
 engine's clock — serve/scheduler.py consults the plan between steps),
@@ -49,6 +59,7 @@ the replayed step.
 from __future__ import annotations
 
 import dataclasses
+import json
 import os
 import re
 import signal
@@ -60,14 +71,25 @@ import numpy as np
 from tensorflow_distributed_tpu.observe.registry import emit_event
 
 KINDS = ("nan_grad", "ckpt_io_fail", "data_stall", "sigterm", "sigkill",
-         "decode_stall", "slot_nan", "reload")
+         "device_loss", "decode_stall", "slot_nan", "reload")
 # Phase validity (config.validate rejects cross-phase plans at startup
 # so a train-only fault never sits silently unfired in a serve run):
 # signals fire in both phases, keyed on the phase's own step clock.
 TRAIN_KINDS = ("nan_grad", "ckpt_io_fail", "data_stall", "sigterm",
-               "sigkill")
+               "sigkill", "device_loss")
 SERVE_KINDS = ("decode_stall", "slot_nan", "reload", "sigterm",
                "sigkill")
+
+# Where a device_loss drill records the masked-chip count for the
+# supervisor's next leg (under the run's checkpoint dir — the one
+# path both processes share; TFD_DEVICE_MASK_FILE overrides for
+# tests/drills without a checkpoint dir).
+DEVICE_MASK_FILENAME = "DEVICE_MASK"
+
+
+def device_mask_path(ckpt_dir: str) -> str:
+    return os.environ.get("TFD_DEVICE_MASK_FILE") or os.path.join(
+        ckpt_dir, DEVICE_MASK_FILENAME)
 
 _EVENT_RE = re.compile(
     r"^(?P<kind>[a-z_]+)@(?P<step>\d+)(?::(?P<arg>[0-9.]+s?))?$")
@@ -78,7 +100,8 @@ class FaultEvent:
     kind: str
     step: int
     arg: Optional[float] = None  # seconds for data_stall, count for
-    #                              ckpt_io_fail; None elsewhere
+    #                              ckpt_io_fail/device_loss, slot for
+    #                              slot_nan; None elsewhere
 
 
 def parse_fault_plan(spec: str) -> "FaultPlan":
@@ -113,11 +136,11 @@ def parse_fault_plan(spec: str) -> "FaultPlan":
                     raise ValueError(
                         f"slot_nan slot must be a non-negative int "
                         f"in {token!r}")
-            elif kind == "ckpt_io_fail":
+            elif kind in ("ckpt_io_fail", "device_loss"):
                 arg = float(arg_s)
                 if arg != int(arg) or arg < 1:
                     raise ValueError(
-                        f"ckpt_io_fail count must be a positive int "
+                        f"{kind} count must be a positive int "
                         f"in {token!r}")
             else:
                 raise ValueError(
@@ -241,6 +264,29 @@ class FaultPlan:
                 emit_event("recovery", kind="fault_injected",
                            fault=kind, step=step)
                 os.kill(os.getpid(), signum)
+
+    def maybe_device_loss(self, step: int, ckpt_dir: str) -> None:
+        """The chip-preemption drill at dispatch of ``step``: write the
+        lost-device count to the mask file (flushed durable — the next
+        line is a SIGKILL) and die without notice. First leg only,
+        like the signals: the restarted-and-resized leg is the
+        recovery under test."""
+        if self._start_step > 0:
+            return
+        ev = self._take("device_loss", step)
+        if ev is None:
+            return
+        lost = int(ev.arg) if ev.arg is not None else 1
+        path = device_mask_path(ckpt_dir)
+        emit_event("recovery", kind="fault_injected",
+                   fault="device_loss", step=step, lost=lost,
+                   mask_file=path)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            json.dump({"lost": lost, "step": step}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.kill(os.getpid(), signal.SIGKILL)
 
     # -- serve-phase injection points (step = the engine's decode step;
     #    serve/scheduler.py consults these between steps, the engine

@@ -48,17 +48,45 @@ to the child's ``--observe.metrics-jsonl`` file (when one is
 configured), so the run's metrics artifact records its own restart
 history — the next leg appends to that same file because its
 ``--resume`` restore makes observe.hub open the sink in append mode.
+
+**Elastic restarts** (``--elastic``): instead of relaunching the
+identical command, each leg first PROBES the live device count (a
+subprocess ``jax.device_count()``, minus any chips the device-mask
+file under the child's checkpoint dir declares lost — the
+``device_loss`` drill writes it; a real preemption needs no mask, the
+chips are simply gone) and picks the best mesh that fits: non-data
+axes (model/seq/pipe/expert — semantic parallelism choices) are
+preserved, and the data axis absorbs the resize — the largest width
+whose product fits the surviving devices and divides the global batch,
+so per-device batch re-derives from the SAME global batch and the loss
+trajectory stays comparable. The relaunch args are rewritten to that
+mesh, a ``kind="mesh_change"`` recovery event records old→new, and the
+child's ``--resume`` restore goes through the checkpoint layer's
+resharded path (train/checkpoint.py::restore_resharded) — so a
+``device_loss`` fault degrades to a smaller mesh and CONTINUES instead
+of crash-looping, and a capacity comeback (mask file removed, chips
+back) grows the mesh again on the next restart. Without ``--elastic``
+nothing changes: the identical-command relaunch stays as it was.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import signal
 import subprocess
 import sys
 import time
-from typing import List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# faults is import-light (stdlib + numpy + observe.registry — no jax,
+# no backend init): sharing device_mask_path keeps the mask-file
+# contract single-sourced between the drill that writes it and the
+# supervisor that reads it.
+from tensorflow_distributed_tpu.resilience.faults import device_mask_path
+
+_MESH_AXES = ("data", "model", "seq", "pipe", "expert")
 
 
 def _child_flag_value(args: Sequence[str], flag: str) -> Optional[str]:
@@ -68,6 +96,135 @@ def _child_flag_value(args: Sequence[str], flag: str) -> Optional[str]:
         if a.startswith(flag + "="):
             return a.split("=", 1)[1]
     return None
+
+
+def parse_mesh_args(args: Sequence[str]) -> Dict[str, int]:
+    """The child's configured mesh axes (config.MeshConfig defaults
+    where unset; ``data == -1`` = fill remaining devices). Pure —
+    jax-free, unit-testable."""
+    out = {a: (-1 if a == "data" else 1) for a in _MESH_AXES}
+    for name in out:
+        v = _child_flag_value(args, f"--mesh.{name}")
+        if v is not None:
+            out[name] = int(v)
+    return out
+
+
+def pick_elastic_mesh(axes: Dict[str, int], alive: int,
+                      batch: Optional[int] = None
+                      ) -> Optional[Dict[str, int]]:
+    """The best mesh for ``alive`` devices: the configured non-data
+    axes preserved exactly (tensor/seq/pipe/expert degrees are
+    semantic choices the checkpoint's layouts assume), the data axis
+    re-sized to the largest width whose product fits ``alive`` and
+    divides the global ``batch`` (per-device batch stays an integer
+    share of the SAME global batch — the loss trajectory's
+    comparability condition). None when even data=1 doesn't fit
+    (fewer devices than the non-data product): there is no compatible
+    mesh to degrade onto and the supervisor must stop rather than
+    crash-loop. Pure — jax-free, unit-testable."""
+    denom = 1
+    for name in ("model", "seq", "pipe", "expert"):
+        denom *= max(1, int(axes.get(name, 1)))
+    if denom > alive or alive < 1:
+        return None
+    data = next((d for d in range(alive // denom, 0, -1)
+                 if batch is None or batch % d == 0), None)
+    if data is None:
+        return None
+    out = {a: max(1, int(axes.get(a, 1))) for a in _MESH_AXES}
+    out["data"] = data
+    return out
+
+
+def rewrite_mesh_args(args: Sequence[str], mesh: Dict[str, int]
+                      ) -> List[str]:
+    """Child argv with every ``--mesh.*`` flag pinned to ``mesh``
+    (both ``--mesh.data N`` and ``--mesh.data=N`` spellings replaced
+    in place; ``--mesh.data`` appended when absent so a default-``-1``
+    child gets the EXPLICIT width the supervisor chose). Pure."""
+    out = list(args)
+    for name in _MESH_AXES:
+        flag = f"--mesh.{name}"
+        sval = str(int(mesh[name]))
+        replaced = False
+        i = 0
+        while i < len(out):
+            if out[i] == flag and i + 1 < len(out):
+                out[i + 1] = sval
+                replaced = True
+                i += 2
+                continue
+            if out[i].startswith(flag + "="):
+                out[i] = f"{flag}={sval}"
+                replaced = True
+            i += 1
+        if not replaced and (name == "data" or int(mesh[name]) != 1):
+            out += [flag, sval]
+    return out
+
+
+def plan_elastic(child_args: Sequence[str], total: int, masked: int
+                 ) -> Optional[Tuple[Dict[str, int], int]]:
+    """(mesh, child_mask) for a leg: the mesh to relaunch onto, and
+    how many trailing devices the child must hide via
+    ``TFD_DEVICE_MASK`` so its visible device set exactly equals the
+    mesh product — the masked "dead" chips plus any remainder the
+    mesh shape can't use. None = no compatible mesh. A child argv
+    with no ``--batch-size`` flag runs with config.TrainConfig's
+    default, so the divisibility constraint is held against THAT
+    value — never dropped (a data width that doesn't divide the
+    child's real global batch fails its startup validation and turns
+    every leg into the crash loop --elastic exists to prevent)."""
+    alive = total - masked
+    batch = _child_flag_value(child_args, "--batch-size")
+    mesh = pick_elastic_mesh(
+        parse_mesh_args(child_args), alive,
+        int(batch) if batch is not None else _default_batch_size())
+    if mesh is None:
+        return None
+    used = mesh["data"]
+    for name in ("model", "seq", "pipe", "expert"):
+        used *= mesh[name]
+    return mesh, total - used
+
+
+def _default_batch_size() -> int:
+    """config.TrainConfig's default global batch size — what a child
+    argv with no ``--batch-size`` flag will actually run with. Lazy
+    import so the pure helpers above stay unit-testable with zero
+    package machinery loaded."""
+    from tensorflow_distributed_tpu.config import TrainConfig
+    return int(TrainConfig().batch_size)
+
+
+def _read_mask(path: Optional[str]) -> int:
+    """Lost-device count from the mask file (resilience/faults.py
+    ``device_loss`` writes it; an operator deletes it when capacity
+    comes back). 0 when absent/unreadable — absence means nothing is
+    lost, never an error."""
+    if not path:
+        return 0
+    try:
+        with open(path) as f:
+            return max(0, int(json.load(f).get("lost", 0)))
+    except (OSError, ValueError, AttributeError, TypeError):
+        return 0
+
+
+def _probe_devices() -> Optional[int]:
+    """Live device count, probed in a SUBPROCESS (the supervisor never
+    imports jax — a wedged runtime must not wedge the supervisor, and
+    each leg must see the CURRENT count, not a stale cached backend).
+    None on probe failure."""
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.device_count())"],
+            capture_output=True, text=True, timeout=120)
+        return int(out.stdout.strip()) if out.returncode == 0 else None
+    except (subprocess.SubprocessError, ValueError, OSError):
+        return None
 
 
 def build_leg_args(child_args: Sequence[str], restarts: int
@@ -119,6 +276,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     # the same step, so restarting just burns the budget. Off by
     # default; crashes and stalls (any other nonzero rc) do restart.
     parser.add_argument("--restart-on-diverge", action="store_true")
+    # Elastic restarts: probe the live device count each leg and
+    # rewrite the child's mesh args to the best compatible shape
+    # (see the module docstring). Off by default — the identical-
+    # command relaunch is unchanged without it.
+    parser.add_argument("--elastic", action="store_true")
     opts = parser.parse_args(argv[:split])
     child_args = argv[split + 1:]
 
@@ -135,15 +297,66 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
               " — restarts will repeat from step 0 (the reference "
               "Supervisor's lose-everything behavior)", flush=True)
 
+    # One path contract with the writer: resilience/faults.py's
+    # device_loss drill writes where device_mask_path says.
+    mask_file = (device_mask_path(ckpt_dir) if ckpt_dir
+                 else os.environ.get("TFD_DEVICE_MASK_FILE"))
+    prev_mesh: Optional[Dict[str, int]] = None
+
     restarts = 0
     rc = 1
     while True:
         args = build_leg_args(child_args, restarts)
+        env = None
+        if opts.elastic:
+            total = _probe_devices()
+            if total is None:
+                print("[supervisor] WARNING: device probe failed — "
+                      "launching this leg with the unchanged mesh",
+                      flush=True)
+            else:
+                masked = _read_mask(mask_file)
+                planned = plan_elastic(args, total, masked)
+                if planned is None:
+                    print(f"[supervisor] no compatible mesh for "
+                          f"{total - masked} alive device(s) (of "
+                          f"{total}; non-data axes "
+                          f"{parse_mesh_args(args)}) — stopping",
+                          flush=True)
+                    _append_event(jsonl, {
+                        "event": "recovery", "kind": "mesh_exhausted",
+                        "leg": restarts, "alive": total - masked})
+                    # Same signal normalization as budget exhaustion:
+                    # the dead leg's rc is -signum after a SIGKILL and
+                    # a raw negative return would alias to an
+                    # unrelated 8-bit exit status.
+                    return (128 - rc if rc < 0 else rc) \
+                        if restarts else 1
+                mesh, child_mask = planned
+                args = rewrite_mesh_args(args, mesh)
+                if child_mask:
+                    env = dict(os.environ)
+                    env["TFD_DEVICE_MASK"] = str(child_mask)
+                # "from" is the previous leg's mesh, or the configured
+                # one when a pre-existing mask resizes the FIRST leg.
+                configured = parse_mesh_args(child_args)
+                from_mesh = prev_mesh or (
+                    configured if configured["data"] != -1 else None)
+                if from_mesh is not None and mesh != from_mesh:
+                    record = {"event": "recovery",
+                              "kind": "mesh_change", "leg": restarts,
+                              "from_mesh": from_mesh, "to_mesh": mesh,
+                              "alive": total - masked,
+                              "masked": masked}
+                    print(f"[supervisor] {json.dumps(record)}",
+                          flush=True)
+                    _append_event(jsonl, record)
+                prev_mesh = mesh
         cmd = [sys.executable, "-m", "tensorflow_distributed_tpu.cli",
                *args]
         print(f"[supervisor] leg {restarts}: {' '.join(cmd)}",
               flush=True)
-        proc = subprocess.Popen(cmd)
+        proc = subprocess.Popen(cmd, env=env)
 
         def forward(signum, frame, _p=proc):
             try:

@@ -48,10 +48,12 @@ from flax import serialization
 
 from tensorflow_distributed_tpu.observe import goodput as _goodput
 from tensorflow_distributed_tpu.observe.registry import emit_event
-from tensorflow_distributed_tpu.parallel.mesh import is_chief
+from tensorflow_distributed_tpu.parallel.mesh import (
+    is_chief, mesh_shape_dict)
 
 _STEP_PREFIX = "step_"
 _QUARANTINE_PREFIX = "quarantined_"
+_MESH_MANIFEST = "mesh.json"
 
 
 class CheckpointCorruptError(RuntimeError):
@@ -60,6 +62,96 @@ class CheckpointCorruptError(RuntimeError):
     offender and falls back to the newest verifiable step; this only
     escapes when an EXPLICIT step was requested or no verifiable
     checkpoint remains."""
+
+
+class MeshMismatchError(RuntimeError):
+    """A restore failed because the checkpoint was written on a
+    different mesh than the template requests — surfaced with both
+    topologies named instead of the opaque orbax/XLA placement error
+    underneath. Cross-mesh restore is :func:`restore_resharded`'s job:
+    it re-lays the checkpoint out onto the target mesh and verifies
+    the resulting layout against the sharding contract."""
+
+
+def _format_mesh(shape: Optional[dict]) -> str:
+    """``data=4,model=2``-style rendering of a mesh-shape dict for
+    operator-facing messages (axes of size 1 elided)."""
+    if not shape:
+        return "unknown mesh"
+    parts = [f"{k}={v}" for k, v in shape.items() if int(v) != 1]
+    return ",".join(parts) if parts else "single-device"
+
+
+def _tree_mesh(tree: Any) -> Optional[dict]:
+    """The mesh shape a live pytree sits on (first sharded leaf's
+    mesh), or None for host trees."""
+    for leaf in jax.tree_util.tree_leaves(tree):
+        sharding = getattr(leaf, "sharding", None)
+        mesh = getattr(sharding, "mesh", None)
+        if mesh is not None and getattr(mesh, "shape", None) is not None:
+            return mesh_shape_dict(mesh)
+    return None
+
+
+def _mesh_manifest(state: Any) -> Optional[dict]:
+    """The mesh/sharding manifest written beside the sha256 manifest:
+    mesh axis sizes, process count, device count, and the per-leaf
+    PartitionSpecs — everything :func:`restore_resharded` (and an
+    operator wondering which steps fit the current topology) needs to
+    know about the layout a checkpoint was WRITTEN with. None for a
+    state with no sharded leaves (host-only tests)."""
+    tree = serialization.to_state_dict(state)
+    shape = _tree_mesh(tree)
+    if shape is None:
+        return None
+    from tensorflow_distributed_tpu.analysis.runtime import (
+        sharding_spec_strings)
+    return {
+        "mesh": shape,
+        "process_count": int(jax.process_count()),
+        "devices": int(np.prod(list(shape.values()))),
+        "specs": sharding_spec_strings(tree),
+    }
+
+
+def read_mesh_manifest(ckpt_dir: str, step: int) -> Optional[dict]:
+    """The mesh manifest a step was written with, or None (pre-elastic
+    checkpoints, unreadable file — absence degrades to 'unknown', it
+    never blocks a restore)."""
+    path = os.path.join(_step_dir(ckpt_dir, step), _MESH_MANIFEST)
+    try:
+        with open(path) as f:
+            out = json.load(f)
+        return out if isinstance(out, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def steps_with_mesh(ckpt_dir: str) -> List[tuple]:
+    """``[(step, written-mesh dict or None), ...]`` for every complete
+    checkpoint — the operator view of which steps are restorable onto
+    which topology (``available_steps`` keeps its plain-int contract
+    for the callers that schedule around it)."""
+    return [(s, (read_mesh_manifest(ckpt_dir, s) or {}).get("mesh"))
+            for s in available_steps(ckpt_dir)]
+
+
+def _describe_available(ckpt_dir: str, steps: List[int]) -> str:
+    """Error-message rendering of the available steps WITH the
+    topology each was written on, so the operator can see which are
+    restorable onto the current mesh: ``[12, 16] (written on mesh
+    data=4)`` when uniform, per-step annotations when mixed."""
+    if not steps:
+        return "none"
+    meta = steps_with_mesh(ckpt_dir)
+    meshes = {_format_mesh(m) for _, m in meta if m}
+    if not meshes:
+        return str(steps)  # pre-elastic checkpoints: no manifests
+    if len(meshes) == 1:
+        return f"{steps} (written on mesh {meshes.pop()})"
+    return "[" + ", ".join(
+        f"{s} (mesh {_format_mesh(m)})" if m else str(s)
+        for s, m in meta) + "]"
 
 
 # --- save-I/O retry policy (capped exponential backoff) -----------------
@@ -244,6 +336,10 @@ def _orbax_save(ckpt_dir: str, step: int, state: Any, keep: int,
     deferred to the marker phase) can never delete the last good one.
     restore() auto-detects the layout, so --resume works regardless of
     which backend wrote the checkpoint."""
+    # Capture the live state's mesh manifest BEFORE the async write:
+    # it publishes with the commit marker in orbax_wait, where the
+    # state itself is long gone.
+    mesh_manifest = _mesh_manifest(state)
     final = _step_dir(ckpt_dir, step)
     os.makedirs(ckpt_dir, exist_ok=True)
     if background and _orbax_pending:
@@ -255,7 +351,7 @@ def _orbax_save(ckpt_dir: str, step: int, state: Any, keep: int,
         orbax_wait()
     tree = serialization.to_state_dict(state)
     _orbax().save(os.path.join(final, _ORBAX_DIRNAME), tree, force=True)
-    _orbax_pending.append((ckpt_dir, step, keep))
+    _orbax_pending.append((ckpt_dir, step, keep, mesh_manifest))
     if not background:
         orbax_wait()
         _save_barrier(step)
@@ -278,8 +374,16 @@ def orbax_wait() -> None:
         _orbax_ckptr.wait_until_finished()
     if not is_chief():
         return
-    for ckpt_dir, step, keep in pend:
-        marker = os.path.join(_step_dir(ckpt_dir, step), _ORBAX_MARKER)
+    for ckpt_dir, step, keep, mesh_manifest in pend:
+        step_path = _step_dir(ckpt_dir, step)
+        if mesh_manifest is not None:
+            # The mesh manifest lands WITH the commit marker (both
+            # chief-written, post-confirmation), so an unmarked crashed
+            # save never carries a manifest either.
+            with open(os.path.join(step_path, _MESH_MANIFEST),
+                      "w") as f:
+                json.dump(mesh_manifest, f)
+        marker = os.path.join(step_path, _ORBAX_MARKER)
         with open(marker, "w"):
             pass
         for old in available_steps(ckpt_dir)[:-keep]:
@@ -341,7 +445,8 @@ _writer: Optional[concurrent.futures.ThreadPoolExecutor] = None
 _pending: List[concurrent.futures.Future] = []
 
 
-def _write(ckpt_dir: str, step: int, host_state: Any, keep: int) -> str:
+def _write(ckpt_dir: str, step: int, host_state: Any, keep: int,
+           mesh_manifest: Optional[dict] = None) -> str:
     """Serialize + atomically publish one checkpoint (chief only).
 
     The state blob's sha256 is recorded in the manifest next to the
@@ -377,6 +482,13 @@ def _write(ckpt_dir: str, step: int, host_state: Any, keep: int) -> str:
             f.write(blob)
         with open(os.path.join(tmp, "manifest.json"), "w") as f:
             json.dump(manifest, f)
+        if mesh_manifest is not None:
+            # Mesh/sharding manifest beside the sha256 manifest: the
+            # topology and per-leaf layout the state was WRITTEN with,
+            # so restore_resharded (and the operator) can reason about
+            # mesh compatibility without decoding the blob.
+            with open(os.path.join(tmp, _MESH_MANIFEST), "w") as f:
+                json.dump(mesh_manifest, f)
         if os.path.exists(final):
             shutil.rmtree(final)
         os.rename(tmp, final)
@@ -419,6 +531,9 @@ def save(ckpt_dir: str, state: Any, keep: int = 3,
     if backend != "native":
         raise ValueError(f"unknown checkpoint backend {backend!r}")
     final = _step_dir(ckpt_dir, step)
+    # Mesh manifest from the LIVE state (host copies carry no
+    # shardings); chief-only like every other native write.
+    mesh_manifest = _mesh_manifest(state) if is_chief() else None
     # Collective fetch BEFORE the chief gate: cross-process-partitioned
     # leaves need every process in the allgather. Non-chief processes
     # run the collectives only; the chief also copies values to host.
@@ -450,9 +565,10 @@ def save(ckpt_dir: str, state: Any, keep: int = 3,
             _pending[:] = [f for f in _pending
                            if not f.done() or f.exception() is not None]
             _pending.append(
-                _writer.submit(_write, ckpt_dir, step, host_state, keep))
+                _writer.submit(_write, ckpt_dir, step, host_state, keep,
+                               mesh_manifest))
         return final
-    _write(ckpt_dir, step, host_state, keep)
+    _write(ckpt_dir, step, host_state, keep, mesh_manifest)
     _save_barrier(step)
     return final
 
@@ -520,7 +636,7 @@ def restore_averaged(ckpt_dir: str, state: Any,
         if step not in steps:
             raise FileNotFoundError(
                 f"no checkpoint for step {step} under {ckpt_dir}; "
-                f"available steps: {steps if steps else 'none'}")
+                f"available steps: {_describe_available(ckpt_dir, steps)}")
         path, raw = read_raw(step)
     else:
         if not steps:
@@ -629,7 +745,7 @@ def restore_params(ckpt_dir: str, params: Any,
     if step is not None and step not in steps:
         raise FileNotFoundError(
             f"no checkpoint for step {step} under {ckpt_dir}; "
-            f"available steps: {steps if steps else 'none'}")
+            f"available steps: {_describe_available(ckpt_dir, steps)}")
     if not steps:
         raise FileNotFoundError(
             f"no checkpoints under {ckpt_dir} — live weight swap needs "
@@ -695,7 +811,25 @@ def restore_params(ckpt_dir: str, params: Any,
                 arr.shape, tmpl.sharding, lambda idx: arr[idx])
         return jax.device_put(val, getattr(tmpl, "sharding", None))
 
-    return jax.tree_util.tree_map(place, params, host), s
+    try:
+        placed = jax.tree_util.tree_map(place, params, host)
+    except ValueError:
+        raise  # our own clear shape/architecture messages
+    except Exception as e:
+        # Same diagnosis as _load_step_checked: a placement failure
+        # across a mesh change names both topologies instead of
+        # surfacing the runtime's opaque error.
+        written = read_mesh_manifest(ckpt_dir, s) or {}
+        want = _tree_mesh(params)
+        if written.get("mesh") and want and written["mesh"] != want:
+            raise MeshMismatchError(
+                f"live weight swap from step {s} failed: checkpoint "
+                f"written on mesh {_format_mesh(written['mesh'])}, "
+                f"live params on mesh {_format_mesh(want)} "
+                f"[{type(e).__name__}: {e}]. restore_resharded() "
+                f"handles cross-mesh restores for full states.") from e
+        raise
+    return placed, s
 
 
 def _plus_zero(tree: Any) -> Any:
@@ -759,6 +893,11 @@ def _quarantine(ckpt_dir: str, step: int, reason: str) -> str:
     agrees)."""
     name = f"{_STEP_PREFIX}{step:08d}"
     dst = os.path.join(ckpt_dir, _QUARANTINE_PREFIX + name)
+    # Written-mesh metadata rides the event (read BEFORE the rename):
+    # the operator triaging a quarantine sees which topology the bytes
+    # belong to, i.e. whether the surviving steps still fit the
+    # current mesh.
+    written = (read_mesh_manifest(ckpt_dir, step) or {}).get("mesh")
     if is_chief():
         if os.path.exists(dst):
             shutil.rmtree(dst, ignore_errors=True)
@@ -766,7 +905,8 @@ def _quarantine(ckpt_dir: str, step: int, reason: str) -> str:
             os.rename(os.path.join(ckpt_dir, name), dst)
         except OSError:
             pass  # already moved/removed — the skip is what matters
-    emit_event("recovery", kind="quarantine", step=step, reason=reason)
+    emit_event("recovery", kind="quarantine", step=step, reason=reason,
+               mesh=_format_mesh(written) if written else None)
     _goodput.incr("quarantine")
     return dst
 
@@ -850,6 +990,38 @@ def _load_step(ckpt_dir: str, step: int, state: Any) -> Any:
     return _restore_from_raw(_load_native_raw(step_path), state)
 
 
+def _load_step_checked(ckpt_dir: str, step: int, state: Any) -> Any:
+    """_load_step with mesh-mismatch diagnosis: a cross-mesh restore
+    that dies inside orbax/XLA placement used to surface as that
+    library's opaque error — when the written mesh (from the mesh
+    manifest) differs from the template's, re-raise as
+    :class:`MeshMismatchError` naming both topologies and pointing at
+    :func:`restore_resharded`. Errors this layer already makes clear
+    (corruption, missing files, shape/param-sync ValueErrors) pass
+    through untouched; same-mesh failures are not mesh problems and
+    propagate as themselves."""
+    try:
+        return _load_step(ckpt_dir, step, state)
+    except (CheckpointCorruptError, FileNotFoundError, ValueError,
+            MeshMismatchError):
+        raise
+    except Exception as e:
+        written = read_mesh_manifest(ckpt_dir, step) or {}
+        want = _tree_mesh(state)
+        if written.get("mesh") and want \
+                and written["mesh"] != want:
+            raise MeshMismatchError(
+                f"restore of step {step} under {ckpt_dir} failed: the "
+                f"checkpoint was written on mesh "
+                f"{_format_mesh(written['mesh'])} "
+                f"({written.get('process_count', '?')} process(es)) "
+                f"but the template requests mesh {_format_mesh(want)} "
+                f"[{type(e).__name__}: {e}]. Use restore_resharded() "
+                f"to re-lay a checkpoint out onto a different mesh "
+                f"with the sharding contract verified.") from e
+        raise
+
+
 @_goodput.accounted("restore")
 def restore(ckpt_dir: str, state: Any, step: Optional[int] = None) -> Any:
     """Restore into the structure/shardings of ``state`` (a freshly
@@ -871,8 +1043,8 @@ def restore(ckpt_dir: str, state: Any, step: Optional[int] = None) -> Any:
         if step not in steps:
             raise FileNotFoundError(
                 f"no checkpoint for step {step} under {ckpt_dir}; "
-                f"available steps: {steps if steps else 'none'}")
-        return _load_step(ckpt_dir, step, state)
+                f"available steps: {_describe_available(ckpt_dir, steps)}")
+        return _load_step_checked(ckpt_dir, step, state)
     if not steps:
         raise FileNotFoundError(
             f"no checkpoints under {ckpt_dir} — is this a --resume "
@@ -881,13 +1053,66 @@ def restore(ckpt_dir: str, state: Any, step: Optional[int] = None) -> Any:
     last_err: Optional[CheckpointCorruptError] = None
     for s in reversed(steps):
         try:
-            return _load_step(ckpt_dir, s, state)
+            return _load_step_checked(ckpt_dir, s, state)
         except CheckpointCorruptError as e:
             _quarantine(ckpt_dir, s, str(e))
             last_err = e
     raise CheckpointCorruptError(
         f"every checkpoint under {ckpt_dir} failed verification "
         f"(all quarantined); last error: {last_err}")
+
+
+@_goodput.accounted("reshard")
+def restore_resharded(ckpt_dir: str, state: Any,
+                      step: Optional[int] = None,
+                      verify: bool = True):
+    """Restore a checkpoint written on mesh A into a template laid out
+    on mesh B — the elastic-restart path. Returns ``(state, info)``.
+
+    The values are the written ones bit-for-bit (the host round trip
+    is layout-free; resharding only changes which device holds which
+    slice), re-placed leaf by leaf onto the template's shardings: any
+    combination of data/fsdp/tensor axis sizes whose product matches
+    the template mesh's devices works, including growing onto MORE
+    devices than wrote the checkpoint. ``verify=True`` (default)
+    asserts the restored params/EMA against the template's declared
+    layout via the sharding-contract checker (analysis/runtime.py) —
+    the same contract ``--check`` holds the train step to — so a
+    resharded resume starts from a PROVEN layout, not an assumed one.
+
+    ``info`` carries ``step``, ``from_mesh`` (the written manifest's
+    topology, None for pre-elastic checkpoints), ``to_mesh``,
+    ``resharded`` (False when the topologies match) and ``seconds``
+    (the resize window — the wall the goodput ledger charges to the
+    "reshard" category). An actual mesh change emits one
+    ``kind="reshard_restore"`` recovery event.
+
+    Integrity contract is :func:`restore`'s: ``step=None`` walks back
+    from the newest verifiable step; an explicit step is exact."""
+    t0 = time.perf_counter()
+    restored = restore(ckpt_dir, state, step=step)
+    got_step = host_step(restored)
+    written = read_mesh_manifest(ckpt_dir, got_step) or {}
+    to_mesh = _tree_mesh(state)
+    from_mesh = written.get("mesh")
+    resharded = bool(from_mesh and to_mesh and from_mesh != to_mesh)
+    if verify:
+        from tensorflow_distributed_tpu.analysis import (
+            runtime as graftcheck)
+        graftcheck.assert_sharding_contract(
+            restored.params, graftcheck.sharding_tree(state.params),
+            what="resharded params")
+        if getattr(state, "ema", None) is not None:
+            graftcheck.assert_sharding_contract(
+                restored.ema, graftcheck.sharding_tree(state.ema),
+                what="resharded ema")
+    info = {"step": got_step, "from_mesh": from_mesh,
+            "to_mesh": to_mesh, "resharded": resharded,
+            "seconds": round(time.perf_counter() - t0, 4)}
+    if resharded:
+        emit_event("recovery", kind="reshard_restore", **info)
+        _goodput.incr("reshard_restore")
+    return restored, info
 
 
 def _align_masked_opt(skel: Any, raw: Any) -> Any:

@@ -24,7 +24,8 @@ from tensorflow_distributed_tpu.observe import Observatory
 from tensorflow_distributed_tpu.observe import health as health_mod
 from tensorflow_distributed_tpu.observe.registry import host_tags
 from tensorflow_distributed_tpu.parallel import make_mesh
-from tensorflow_distributed_tpu.parallel.mesh import bootstrap, is_chief
+from tensorflow_distributed_tpu.parallel.mesh import (
+    bootstrap, is_chief, mesh_shape_dict)
 from tensorflow_distributed_tpu.parallel.sharding import (
     process_slice, shard_batch)
 from tensorflow_distributed_tpu.resilience.faults import (
@@ -378,15 +379,45 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
 
         start_step = 0
         if cfg.resume and ckpt.latest_step(cfg.checkpoint_dir) is not None:
-            with obs.phase("restore"):
-                state = ckpt.restore(cfg.checkpoint_dir, state)
-                # The restored buffers feed a DONATING step; see
-                # checkpoint.launder_buffers for the container bug
-                # this sidesteps.
-                state = ckpt.launder_buffers(state)
+            latest = ckpt.latest_step(cfg.checkpoint_dir)
+            written = (ckpt.read_mesh_manifest(cfg.checkpoint_dir,
+                                               latest)
+                       or {}).get("mesh")
+            current = mesh_shape_dict(mesh)
+            resumed_extra = {}
+            if written and written != current:
+                # Elastic resume: the checkpoint was written on a
+                # DIFFERENT mesh (a supervisor --elastic restart after
+                # device loss, or an operator growing the run onto
+                # returned capacity). Restore through the resharded
+                # path — layout re-derived onto this mesh and verified
+                # against the sharding contract — and charge the
+                # resize window to its own goodput category. The
+                # global batch is unchanged; the data layer re-derives
+                # the per-device share from the new data-axis width,
+                # so the loss trajectory stays comparable across the
+                # resize.
+                with obs.phase("reshard"):
+                    state, rinfo = ckpt.restore_resharded(
+                        cfg.checkpoint_dir, state)
+                    state = ckpt.launder_buffers(state)
+                resumed_extra = {
+                    "from_mesh": rinfo["from_mesh"],
+                    "to_mesh": rinfo["to_mesh"],
+                    "reshard_seconds": rinfo["seconds"],
+                    "per_device_batch":
+                        cfg.batch_size // current["data"]}
+            else:
+                with obs.phase("restore"):
+                    state = ckpt.restore(cfg.checkpoint_dir, state)
+                    # The restored buffers feed a DONATING step; see
+                    # checkpoint.launder_buffers for the container bug
+                    # this sidesteps.
+                    state = ckpt.launder_buffers(state)
             start_step = ckpt.host_step(state)
-            logger.log_json({"event": "resumed", "step": start_step})
-            obs.emit("resumed", step=start_step)
+            logger.log_json({"event": "resumed", "step": start_step,
+                             **resumed_extra})
+            obs.emit("resumed", step=start_step, **resumed_extra)
 
         # Resilience wiring (all off by default — see config.
         # ResilienceConfig and the resilience/ package): fault plan,
@@ -723,6 +754,8 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
                 # here like any other step's would (the guard isn't
                 # armed yet, so a sigterm@first-step drill is a hard
                 # first-leg crash — which is what it models).
+                plan.maybe_device_loss(start_step + 1,
+                                       cfg.checkpoint_dir)
                 plan.maybe_signal(start_step + 1)
                 with obs.phase("compile"):
                     # The first fetch is the one most likely to wedge
@@ -795,6 +828,8 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
                             obs.instant("preempted", step=i)
                             obs.emit("preempted", step=i)
                             break
+                        plan.maybe_device_loss(i + 1,
+                                               cfg.checkpoint_dir)
                         plan.maybe_signal(i + 1)
                         profiler.observe(i + 1, pending=metrics)
                         with obs.data():
