@@ -113,6 +113,36 @@ if [ "$elastic_rc" -ne 0 ]; then
        "see $ELASTICLOG" >&2
 fi
 
+# Planbench smoke (auto-layout planner pick quality: enumerate ->
+# AOT-score -> actually execute the tiny-gpt sweep and require the
+# planner's top pick within 15% of the best measured candidate, with
+# the predicted peak-HBM ordering matching the executed compiles —
+# benchmarks/planbench.py): tiny config, gpt only, CPU. The committed
+# PLANBENCH.json run carries the full gpt+moe sweep. Same abort-guard
+# shape as the smokes above: a run that dies to the known container
+# XLA:CPU abort prints no plan_checks line and is retried once; a
+# genuine gate failure prints one and is NOT retried.
+PLANLOG="${PLANLOG:-/tmp/_t1_plan.log}"
+run_planbench() {
+  rm -f "$PLANLOG"
+  timeout -k 10 300 env JAX_PLATFORMS=cpu python -m \
+    tensorflow_distributed_tpu.benchmarks.planbench \
+    --families gpt --steps 6 --batch 32 --out "" 2>&1 | tee "$PLANLOG"
+  return "${PIPESTATUS[0]}"
+}
+run_planbench
+plan_rc=$?
+if ! grep -qa '"metric": "plan_checks"' "$PLANLOG"; then
+  echo "[t1] no plan_checks line in $PLANLOG (known container" \
+       "XLA:CPU abort) — rerunning planbench once" >&2
+  run_planbench
+  plan_rc=$?
+fi
+if [ "$plan_rc" -ne 0 ]; then
+  echo "[t1] planbench smoke FAILED (plan_rc=$plan_rc) — see" \
+       "$PLANLOG" >&2
+fi
+
 if [ "$rc" -eq 0 ] && [ "$lint_rc" -ne 0 ]; then
   echo "[t1] suite green but graftcheck red (lint_rc=$lint_rc) — see" \
        "scripts/lint.sh output above" >&2
@@ -123,5 +153,8 @@ if [ "$rc" -eq 0 ] && [ "$fire_rc" -ne 0 ]; then
 fi
 if [ "$rc" -eq 0 ] && [ "$elastic_rc" -ne 0 ]; then
   exit "$elastic_rc"
+fi
+if [ "$rc" -eq 0 ] && [ "$plan_rc" -ne 0 ]; then
+  exit "$plan_rc"
 fi
 exit "$rc"

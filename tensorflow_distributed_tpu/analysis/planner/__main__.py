@@ -1,0 +1,8 @@
+"""``python -m tensorflow_distributed_tpu.analysis.planner`` entry."""
+
+import sys
+
+from tensorflow_distributed_tpu.analysis.planner.plan import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -51,6 +51,16 @@ Examples:
     python -m tensorflow_distributed_tpu.cli --model gpt_lm \
         --model-size tiny --observe.metrics-jsonl /tmp/m.jsonl \
         --observe.health true --observe.health-taps true
+
+    # auto-layout planner (analysis/planner; README "Auto-layout
+    # planner"): rank every valid mesh x strategy by AOT cost model,
+    # or let the train CLI launch with the winner (--plan auto emits
+    # an auditable "plan" record through observe)
+    python -m tensorflow_distributed_tpu.analysis.planner \
+        --family gpt --devices 8 --batch-size 128
+    python -m tensorflow_distributed_tpu.cli --model gpt_lm \
+        --model-size tiny --plan auto \
+        --observe.metrics-jsonl /tmp/m.jsonl
 """
 
 from __future__ import annotations

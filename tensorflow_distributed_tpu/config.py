@@ -522,6 +522,19 @@ class TrainConfig:
     moe_dispatch: str = "dense"
 
     # --- mesh / parallelism ---------------------------------------------
+    # "auto": run the cost-model auto-layout planner (analysis/planner)
+    # before the mesh is built — every valid mesh factorization x
+    # parallelism strategy for this model/device-count/batch is scored
+    # by AOT-compiling the REAL train step (no execution), and the
+    # winner's --mesh.* axes + --param-partition (+ pipelined
+    # microbatches) replace the defaults. The choice is emitted as a
+    # "plan" JSONL record through observe so it is auditable. "" =
+    # the explicit mesh below (the default).
+    plan: str = ""  # "" | auto
+    # Per-device HBM budget (GB) the planner marks candidates
+    # infeasible against. 0 = the device's own memory_stats limit
+    # when it reports one (TPUs do; CPU hosts don't -> no budget).
+    plan_hbm_budget_gb: float = 0.0
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     # "fsdp": ZeRO-3-style sharding of params + optimizer slots over
     # the data axis (parallel.sharding.param_sharding) — memory per
@@ -1074,6 +1087,54 @@ class TrainConfig:
                 "flag or use a dense family")
         if self.norm not in ("layernorm", "rmsnorm"):
             raise ValueError(f"unknown norm {self.norm!r}")
+        if self.plan not in ("", "auto"):
+            raise ValueError(
+                f"unknown plan {self.plan!r}; have ('', 'auto')")
+        if self.plan_hbm_budget_gb < 0:
+            raise ValueError(
+                f"plan_hbm_budget_gb must be >= 0, "
+                f"got {self.plan_hbm_budget_gb}")
+        if self.plan_hbm_budget_gb and self.plan != "auto":
+            raise ValueError(
+                "plan_hbm_budget_gb has no effect without --plan auto; "
+                "drop the flag")
+        if self.plan == "auto":
+            if self.mode != "train":
+                raise ValueError(
+                    f"--plan auto chooses a TRAINING layout; it has "
+                    f"no effect under mode={self.mode!r} — drop the "
+                    f"flag")
+            if self.model not in ("gpt_lm", "moe_lm", "pipelined_lm"):
+                raise ValueError(
+                    f"--plan auto plans the LM training families "
+                    f"(gpt_lm | moe_lm | pipelined_lm), got "
+                    f"model={self.model!r}")
+            if self.mesh != MeshConfig():
+                raise ValueError(
+                    "--plan auto owns the mesh shape; drop the "
+                    "explicit --mesh.* flags (or drop --plan auto and "
+                    "keep them)")
+            if self.param_partition != "replicated":
+                raise ValueError(
+                    "--plan auto owns the partition choice "
+                    "(replicated/fsdp/zero1 is part of the strategy "
+                    "it ranks); drop --param-partition")
+            if self.param_sync_every > 1:
+                raise ValueError(
+                    "--plan auto does not compose with "
+                    "param_sync_every > 1 (local SGD is not a "
+                    "planner strategy)")
+            if self.moe_experts > 0 and self.model != "moe_lm":
+                # The planner scores the FAMILY's own program; a
+                # dense family turned MoE via --moe-experts would be
+                # scored as dense (wrong flops, wrong HBM, no expert
+                # axis enumerated) — reject rather than emit a plan
+                # that misdescribes the run.
+                raise ValueError(
+                    "--plan auto with --moe-experts needs "
+                    "model=moe_lm (the planner scores the family's "
+                    "own expert layout; a dense family with experts "
+                    "bolted on would be scored as dense)")
         if self.mode == "eval" and not self.checkpoint_dir:
             raise ValueError("mode=eval requires checkpoint_dir")
         if self.resilience.nonfinite == "rewind" and not self.checkpoint_dir:

@@ -374,6 +374,14 @@ class PipelinedLM:
         return out(p["shell"], x)
 
 
+# The layer count pipelined_lm bumps tiny_config's n_layers=2 up to,
+# so common stage counts (2, 4) divide it. Named so the auto-layout
+# planner's model facts (analysis/planner/candidates.model_facts)
+# prune pipe-axis shapes against the SAME number the scorer's real
+# build slices into stages.
+PIPELINED_TINY_LAYERS = 4
+
+
 def pipelined_lm(mesh: Mesh, size: str = "tiny", causal: bool = True,
                  num_microbatches: int = 4, virtual_stages: int = 1,
                  **overrides) -> PipelinedLM:
@@ -389,7 +397,8 @@ def pipelined_lm(mesh: Mesh, size: str = "tiny", causal: bool = True,
     # of the GPT family, opt out with use_flash=False.
     overrides.setdefault("use_flash", True)
     if size == "tiny":
-        overrides.setdefault("n_layers", 4)  # tiny default (2) < common S
+        # tiny default (2) < common stage counts
+        overrides.setdefault("n_layers", PIPELINED_TINY_LAYERS)
         cfg = tiny_config(**overrides)
     else:
         from tensorflow_distributed_tpu.models.transformer import (

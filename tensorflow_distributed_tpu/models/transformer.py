@@ -719,12 +719,19 @@ def gpt_lm(mesh: Optional[Mesh] = None, size: str = "small",
     return CausalLM(cfg, mesh)
 
 
+# The factory-default expert count moe_lm applies when none is given.
+# Named so the auto-layout planner's model facts (analysis/planner/
+# candidates.model_facts) prune expert-axis shapes against the SAME
+# number the scorer's real build uses.
+MOE_DEFAULT_EXPERTS = 4
+
+
 def moe_lm(mesh: Optional[Mesh] = None, size: str = "tiny",
            **overrides) -> CausalLM:
     """Expert-parallel causal LM ("moe_lm" registry entry): the GPT
     family with every MLP a top-2 MoE (models/moe.py). No reference
     counterpart (SURVEY.md §2b "Expert parallel: NO")."""
-    overrides.setdefault("moe_experts", 4)
+    overrides.setdefault("moe_experts", MOE_DEFAULT_EXPERTS)
     if overrides["moe_experts"] <= 0:
         raise ValueError("moe_lm needs moe_experts > 0")
     return gpt_lm(mesh=mesh, size=size, **overrides)  # auto expert axis

@@ -101,6 +101,84 @@ def _round(v: Optional[float]) -> Optional[float]:
     return None if v is None else round(float(v), 6)
 
 
+# Every analysis field a compile record (and a planner score) carries,
+# in record order. extract_costs fills them all — explicitly None when
+# the backend exposes nothing — so record SHAPE is stable everywhere.
+COST_FIELDS = ("flops", "bytes_accessed", "argument_bytes",
+               "output_bytes", "temp_bytes", "generated_code_bytes",
+               "donated_bytes", "peak_hbm_bytes")
+
+
+def extract_costs(compiled: Any) -> Dict[str, Any]:
+    """``cost_analysis``/``memory_analysis`` of one AOT-compiled
+    program, normalized to the :data:`COST_FIELDS` dict.
+
+    THE one place the cross-jax-version key handling lives (dict vs
+    per-device list-of-dicts cost_analysis, space-separated cost keys,
+    memory_analysis attribute names) with the explicit-null
+    degradation contract: a backend exposing no analysis yields a
+    dict of ``None`` fields, never a missing key and never a raise.
+    Shared by :func:`register_compiled` (the program registry) and
+    the auto-layout planner's candidate scoring
+    (analysis/planner/score.py)."""
+    rec: Dict[str, Any] = {k: None for k in COST_FIELDS}
+    if compiled is None:
+        return rec
+    try:
+        cost = _first_mapping(compiled.cost_analysis())
+    except Exception:
+        cost = None
+    if cost:
+        if isinstance(cost.get("flops"), (int, float)):
+            rec["flops"] = float(cost["flops"])
+        if isinstance(cost.get("bytes accessed"), (int, float)):
+            rec["bytes_accessed"] = float(cost["bytes accessed"])
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:
+        mem = None
+    if mem is not None:
+        fields = {
+            "argument_bytes": "argument_size_in_bytes",
+            "output_bytes": "output_size_in_bytes",
+            "temp_bytes": "temp_size_in_bytes",
+            "generated_code_bytes": "generated_code_size_in_bytes",
+            "donated_bytes": "alias_size_in_bytes",
+        }
+        for key, attr in fields.items():
+            v = getattr(mem, attr, None)
+            if isinstance(v, (int, float)):
+                rec[key] = int(v)
+        parts = (rec["argument_bytes"], rec["output_bytes"],
+                 rec["temp_bytes"], rec["generated_code_bytes"])
+        if all(p is not None for p in parts):
+            # What XLA plans to hold resident while the program
+            # runs; donated inputs alias their outputs, so they
+            # are counted once, not twice.
+            rec["peak_hbm_bytes"] = (
+                sum(parts) - (rec["donated_bytes"] or 0))
+    return rec
+
+
+def aot_lower_compile(jitted: Callable, args: tuple = (),
+                      kwargs: Optional[Dict[str, Any]] = None):
+    """``jitted.lower(*args, **kwargs).compile()`` with wall clocks:
+    returns ``(lowered, compiled, lower_s, compile_s)``. The ONE AOT
+    capture path, shared by :func:`instrument`'s registration pass and
+    the planner's candidate scoring — exceptions propagate; callers
+    own their degradation policy (the registry degrades to a null
+    record, the planner marks the candidate unscoreable)."""
+    lower = getattr(jitted, "lower", None)
+    if lower is None:
+        raise TypeError("no .lower (not a jit callable)")
+    t0 = time.perf_counter()
+    lowered = lower(*args, **(kwargs or {}))
+    t1 = time.perf_counter()
+    compiled = lowered.compile()
+    t2 = time.perf_counter()
+    return lowered, compiled, t1 - t0, t2 - t1
+
+
 def register_compiled(name: str, lowered: Any = None,
                       compiled: Any = None, *,
                       lower_s: Optional[float] = None,
@@ -116,53 +194,12 @@ def register_compiled(name: str, lowered: Any = None,
     """
     rec: Dict[str, Any] = {
         "program": name,
-        "flops": None,
-        "bytes_accessed": None,
-        "argument_bytes": None,
-        "output_bytes": None,
-        "temp_bytes": None,
-        "generated_code_bytes": None,
-        "donated_bytes": None,
-        "peak_hbm_bytes": None,
+        **extract_costs(compiled),
         "lower_s": _round(lower_s),
         "compile_s": _round(compile_s),
     }
     if error:
         rec["error"] = error[:300]
-    if compiled is not None:
-        try:
-            cost = _first_mapping(compiled.cost_analysis())
-        except Exception:
-            cost = None
-        if cost:
-            if isinstance(cost.get("flops"), (int, float)):
-                rec["flops"] = float(cost["flops"])
-            if isinstance(cost.get("bytes accessed"), (int, float)):
-                rec["bytes_accessed"] = float(cost["bytes accessed"])
-        try:
-            mem = compiled.memory_analysis()
-        except Exception:
-            mem = None
-        if mem is not None:
-            fields = {
-                "argument_bytes": "argument_size_in_bytes",
-                "output_bytes": "output_size_in_bytes",
-                "temp_bytes": "temp_size_in_bytes",
-                "generated_code_bytes": "generated_code_size_in_bytes",
-                "donated_bytes": "alias_size_in_bytes",
-            }
-            for key, attr in fields.items():
-                v = getattr(mem, attr, None)
-                if isinstance(v, (int, float)):
-                    rec[key] = int(v)
-            parts = (rec["argument_bytes"], rec["output_bytes"],
-                     rec["temp_bytes"], rec["generated_code_bytes"])
-            if all(p is not None for p in parts):
-                # What XLA plans to hold resident while the program
-                # runs; donated inputs alias their outputs, so they
-                # are counted once, not twice.
-                rec["peak_hbm_bytes"] = (
-                    sum(parts) - (rec["donated_bytes"] or 0))
     with _lock:
         _programs.append(rec)
     emit_event("compile", **rec)
@@ -207,21 +244,14 @@ def _register_from(name: str, jitted: Callable, args, kwargs) -> None:
     """AOT lower+compile for the record; exceptions degrade to a
     null-field record (e.g. a non-jit callable, or an argument set the
     AOT path rejects) instead of propagating into the step."""
-    lower = getattr(jitted, "lower", None)
-    if lower is None:
-        register_compiled(name, error="no .lower (not a jit callable)")
-        return
     try:
-        t0 = time.perf_counter()
-        lowered = lower(*args, **kwargs)
-        t1 = time.perf_counter()
-        compiled = lowered.compile()
-        t2 = time.perf_counter()
+        lowered, compiled, lower_s, compile_s = aot_lower_compile(
+            jitted, args, kwargs)
     except Exception as e:  # never take the run down for telemetry
         register_compiled(name, error=f"{type(e).__name__}: {e}")
         return
-    register_compiled(name, lowered, compiled, lower_s=t1 - t0,
-                      compile_s=t2 - t1)
+    register_compiled(name, lowered, compiled, lower_s=lower_s,
+                      compile_s=compile_s)
 
 
 def _latest_by_name() -> Dict[str, Dict[str, Any]]:

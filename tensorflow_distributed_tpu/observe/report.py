@@ -169,6 +169,28 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                 and isinstance(r.get("seconds"), (int, float)))
             if reshard_s:
                 out["reshard_seconds_total"] = round(reshard_s, 4)
+    # Auto-layout planner (--plan auto, analysis/planner): the chosen
+    # mesh/strategy and its predicted step time, reported beside the
+    # MEASURED step time when the run got far enough to have one —
+    # the audit trail for "why is this run on this mesh".
+    plans = [r for r in records if r.get("event") == "plan"]
+    if plans:
+        p = plans[-1]
+        entry: Dict[str, Any] = {
+            "family": p.get("family"),
+            "mesh": p.get("mesh"),
+            "strategy": p.get("strategy"),
+            "partition": p.get("partition"),
+            "predicted_step_ms": p.get("predicted_step_ms"),
+            "predicted_peak_hbm_bytes": p.get(
+                "predicted_peak_hbm_bytes"),
+            "candidates": p.get("candidates"),
+            "feasible": p.get("feasible"),
+            "infeasible": p.get("infeasible"),
+        }
+        if "step_ms_p50" in out:
+            entry["measured_step_ms_p50"] = out["step_ms_p50"]
+        out["plan"] = entry
     # Compiled-program registry (observe/device.py "compile" records):
     # latest record per program — name, flops, peak-HBM estimate,
     # compile seconds — the device-side cost/memory inventory.
@@ -238,9 +260,9 @@ def render(summary: Dict[str, Any]) -> str:
              "serve_mean_slot_occupancy", "serve_total_new_tokens",
              "serve_prefill_compiles", "serve_retries", "serve_swaps",
              "serve_swap_seconds", "serve_seed", "serve_trace")
-    # programs/health/recovery render as their own sections below;
-    # peak_hbm_bytes_sum renders as the Programs TOTAL row.
-    sections = ("programs", "health", "peak_hbm_bytes_sum",
+    # plan/programs/health/recovery render as their own sections
+    # below; peak_hbm_bytes_sum renders as the Programs TOTAL row.
+    sections = ("plan", "programs", "health", "peak_hbm_bytes_sum",
                 "recovery_counts", "swap_seconds_total",
                 "mesh_changes", "mesh_change_path",
                 "reshard_seconds_total")
@@ -251,6 +273,30 @@ def render(summary: Dict[str, Any]) -> str:
               if k not in order and k not in sections]
     for key in extras:
         lines.append(f"  {key:<22} {summary[key]}")
+    if "plan" in summary:
+        # Lazy, stdlib-only import: THE planner mesh formatter.
+        from tensorflow_distributed_tpu.analysis.planner.candidates \
+            import format_mesh
+        p = summary["plan"]
+        mesh = p.get("mesh") or {}
+        mesh_s = format_mesh(mesh) if isinstance(mesh, dict) else "?"
+        lines.append("Plan")
+        lines.append(f"  {'chosen':<28} {mesh_s} "
+                     f"[{p.get('strategy')}] "
+                     f"partition={p.get('partition')}")
+        pred = p.get("predicted_step_ms")
+        meas = p.get("measured_step_ms_p50")
+        step_line = (f"predicted={pred} ms"
+                     if pred is not None else "predicted=-")
+        if meas is not None:
+            step_line += f" measured_p50={meas} ms"
+        lines.append(f"  {'step_time':<28} {step_line}")
+        lines.append(
+            f"  {'peak_hbm':<28} "
+            f"{_device.human_bytes(p.get('predicted_peak_hbm_bytes'))}")
+        lines.append(f"  {'candidates':<28} {p.get('candidates')} "
+                     f"({p.get('feasible')} feasible, "
+                     f"{p.get('infeasible')} infeasible)")
     if "programs" in summary:
         lines.append("Programs")
         for p in summary["programs"]:

@@ -40,6 +40,66 @@ MESH_AXES = (AXIS_DATA, AXIS_PIPE, AXIS_SEQ, AXIS_MODEL, AXIS_EXPERT)
 _bootstrapped = False
 
 
+# --- mesh-feasibility rules (pure helpers; no jax, no backend) ---------
+#
+# The ONE home for the constraints every mesh-shape chooser applies:
+# the elastic supervisor (resilience/supervisor.pick_elastic_mesh)
+# re-sizing the data axis onto surviving devices, and the auto-layout
+# planner (analysis/planner/candidates.py) enumerating factorizations.
+# Both used to re-derive the same two rules; a third copy was the line
+# this factoring exists to prevent.
+
+
+def nondata_product(axes) -> int:
+    """Product of the non-data axis sizes in ``axes`` (a {name: size}
+    mapping; missing axes count 1) — the devices one data coordinate
+    consumes. Non-data axes are SEMANTIC parallelism choices (tensor/
+    seq/pipe/expert degrees the params' layouts assume), which is why
+    resizes preserve them exactly and only "data" absorbs change."""
+    denom = 1
+    for name in (AXIS_MODEL, AXIS_SEQ, AXIS_PIPE, AXIS_EXPERT):
+        denom *= max(1, int(axes.get(name, 1)))
+    return denom
+
+
+def pick_data_width(axes, alive: int, batch: Optional[int] = None
+                    ) -> Optional[int]:
+    """The largest data-axis width for ``alive`` devices: non-data
+    axes of ``axes`` preserved, data = the biggest d whose product
+    fits ``alive`` AND divides the global ``batch`` (per-device batch
+    stays an integer share of the SAME global batch — the loss
+    trajectory's comparability condition). None when even data=1
+    doesn't fit — there is no compatible shape. Pure and jax-free."""
+    denom = nondata_product(axes)
+    if denom > alive or alive < 1:
+        return None
+    return next((d for d in range(alive // denom, 0, -1)
+                 if batch is None or batch % d == 0), None)
+
+
+def mesh_infeasible(axes, devices: int,
+                    batch: Optional[int] = None) -> Optional[str]:
+    """Why an EXPLICIT factorization can't run on ``devices`` with
+    global ``batch`` — None when it can. The hard constraints shared
+    by every chooser: every axis >= 1, the axis product must equal
+    the device count, and the data width must divide the batch.
+    Family-level divisibility (heads over "model", layers over
+    "pipe", experts over "expert") lives with the model facts in
+    analysis/planner/candidates.py — this module doesn't know models.
+    Pure and jax-free."""
+    sizes = {a: int(axes.get(a, 1)) for a in MESH_AXES}
+    bad = [f"{a}={v}" for a, v in sizes.items() if v < 1]
+    if bad:
+        return f"axis sizes must be >= 1 ({', '.join(bad)})"
+    product = sizes[AXIS_DATA] * nondata_product(sizes)
+    if product != devices:
+        return (f"mesh product {product} != {devices} device(s)")
+    if batch is not None and batch % sizes[AXIS_DATA]:
+        return (f"global batch {batch} not divisible by data width "
+                f"{sizes[AXIS_DATA]}")
+    return None
+
+
 def bootstrap(coordinator: Optional[str] = None,
               num_processes: Optional[int] = None,
               process_id: Optional[int] = None) -> None:

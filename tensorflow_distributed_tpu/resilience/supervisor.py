@@ -122,14 +122,13 @@ def pick_elastic_mesh(axes: Dict[str, int], alive: int,
     comparability condition). None when even data=1 doesn't fit
     (fewer devices than the non-data product): there is no compatible
     mesh to degrade onto and the supervisor must stop rather than
-    crash-loop. Pure — jax-free, unit-testable."""
-    denom = 1
-    for name in ("model", "seq", "pipe", "expert"):
-        denom *= max(1, int(axes.get(name, 1)))
-    if denom > alive or alive < 1:
-        return None
-    data = next((d for d in range(alive // denom, 0, -1)
-                 if batch is None or batch % d == 0), None)
+    crash-loop. The width rule itself is parallel.mesh.pick_data_width
+    — the ONE copy, shared with the auto-layout planner's candidate
+    enumeration — imported lazily so this module stays importable (and
+    its helpers unit-testable) with zero heavyweight machinery loaded;
+    the import touches no jax backend."""
+    from tensorflow_distributed_tpu.parallel.mesh import pick_data_width
+    data = pick_data_width(axes, alive, batch)
     if data is None:
         return None
     out = {a: max(1, int(axes.get(a, 1))) for a in _MESH_AXES}
@@ -214,9 +213,11 @@ def _read_mask(path: Optional[str]) -> int:
 
 def _probe_devices() -> Optional[int]:
     """Live device count, probed in a SUBPROCESS (the supervisor never
-    imports jax — a wedged runtime must not wedge the supervisor, and
-    each leg must see the CURRENT count, not a stale cached backend).
-    None on probe failure."""
+    INITIALIZES a jax backend in-process — a wedged runtime must not
+    wedge the supervisor, and each leg must see the CURRENT count, not
+    a stale cached backend; pick_elastic_mesh's lazy parallel.mesh
+    import is module-load only and touches no backend). None on probe
+    failure."""
     try:
         out = subprocess.run(
             [sys.executable, "-c",
@@ -283,6 +284,22 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument("--elastic", action="store_true")
     opts = parser.parse_args(argv[:split])
     child_args = argv[split + 1:]
+
+    if (opts.elastic
+            and _child_flag_value(child_args, "--plan") == "auto"):
+        # Two mesh owners: --elastic pins --mesh.* to the surviving
+        # devices on EVERY leg, which the child's "--plan auto owns
+        # the mesh" config guard rejects — the child would die at
+        # validate on leg 0 and every restart after it, the exact
+        # crash loop --elastic exists to prevent. Refuse up front;
+        # --plan auto under the PLAIN supervisor is fine (each leg
+        # re-plans on the same devices).
+        print("[supervisor] --elastic does not compose with a child "
+              "--plan auto (the elastic supervisor and the planner "
+              "both own the mesh). Drop one: keep --elastic with an "
+              "explicit --mesh.*, or keep --plan auto without "
+              "--elastic.", file=sys.stderr)
+        return 2
 
     ckpt_dir = _child_flag_value(child_args, "--checkpoint-dir")
     jsonl = _child_flag_value(child_args, "--observe.metrics-jsonl")

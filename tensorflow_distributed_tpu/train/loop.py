@@ -343,6 +343,26 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
           ) -> TrainResult:
     cfg.validate()
     bootstrap()
+    plan_rec = None
+    if cfg.plan == "auto":
+        # Cost-model auto-layout (analysis/planner): score every valid
+        # mesh x strategy by AOT-compiling the real step, then rewrite
+        # cfg's mesh/partition to the winner BEFORE the mesh is built.
+        # Re-validate after the rewrite so the chosen combination
+        # passes the same rules an explicit one would; the record is
+        # emitted once the Observatory exists below.
+        from tensorflow_distributed_tpu.analysis.planner.plan import (
+            apply_auto)
+        plan_rec = apply_auto(cfg)
+        # The plan is applied: cfg now IS an explicit config (the
+        # "plan" record below keeps the audit trail), so clear the
+        # planner flags before re-validating — the parse-time
+        # "planner owns the mesh" guard must not reject its own
+        # choice, and the budget knob is validated against plan=auto
+        # (it already did its job inside apply_auto).
+        cfg.plan = ""
+        cfg.plan_hbm_budget_gb = 0.0
+        cfg.validate()
     logger = logger or MetricLogger(enabled=is_chief(),
                                 max_records=cfg.observe.max_records)
     mesh = make_mesh(cfg.mesh)
@@ -365,6 +385,12 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
     # file handles drop, and the process-global goodput counter is
     # uninstalled rather than left charging a dead run.
     try:
+        if plan_rec is not None:
+            # The auto-layout choice, durable next to the run's own
+            # records: what was chosen, what it predicted, how many
+            # candidates competed (observe.report's "Plan" section).
+            logger.log_json({"event": "plan", **plan_rec})
+            obs.emit("plan", **plan_rec)
         local_sgd = cfg.param_sync_every > 1
         if local_sgd:
             from tensorflow_distributed_tpu.train.local_sgd import (

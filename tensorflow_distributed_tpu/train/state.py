@@ -86,6 +86,53 @@ def create_train_state(model: nn.Module, tx: optax.GradientTransformation,
     params. Memory: optimizer state drops ~1/data, the usual best
     deal when params fit but Adam doubles don't.
     """
+    (abstract, var_shardings, shardings, abstract_opt,
+     opt_shardings) = derive_state_shardings(
+        model, tx, sample_input, mesh, fsdp=fsdp,
+        fsdp_min_size=fsdp_min_size, opt_fsdp=opt_fsdp)
+
+    def init_vars(key):
+        return nn.meta.unbox(model.init(key, sample_input, train=False))
+
+    with mesh:
+        variables = jax.jit(init_vars, out_shardings=var_shardings)(
+            prng.init_key(seed))
+        params = variables["params"]
+        extra = {k: v for k, v in variables.items()
+                 if k != "params" and k not in TRANSIENT_COLLECTIONS}
+        opt_state = jax.jit(tx.init, out_shardings=opt_shardings)(params)
+        step = jax.device_put(jax.numpy.zeros((), jax.numpy.int32),
+                              replicated(mesh))
+    ema_params = None
+    if ema:
+        with mesh:
+            # Start at the init params, placed identically (sharded
+            # leaves stay sharded — EMA costs 1/data per device under
+            # FSDP like the params themselves).
+            ema_params = jax.jit(
+                lambda p: jax.tree_util.tree_map(jax.numpy.array, p),
+                out_shardings=shardings)(params)
+    return TrainState(step=step, params=params, opt_state=opt_state,
+                      apply_fn=model.apply, tx=tx, extra=extra,
+                      ema=ema_params)
+
+
+def derive_state_shardings(model: nn.Module,
+                           tx: optax.GradientTransformation,
+                           sample_input: jax.Array, mesh: Mesh,
+                           fsdp: bool = False,
+                           fsdp_min_size: int = FSDP_MIN_SIZE,
+                           opt_fsdp: bool = False):
+    """The state-layout derivation, WITHOUT allocating anything.
+
+    Returns ``(abstract_variables, var_shardings, param_shardings,
+    abstract_opt_state, opt_shardings)`` — the abstract (eval_shape)
+    variable/optimizer trees plus the NamedShardings
+    :func:`create_train_state` places them with. Factored out so the
+    auto-layout planner (:func:`abstract_train_state`) can score THE
+    layout a run would actually get — FSDP/ZeRO-1 slot-matching rules
+    included — from exactly one implementation.
+    """
     # Abstract init to read partition metadata without allocating.
     abstract = jax.eval_shape(
         lambda k: model.init(k, sample_input, train=False),
@@ -104,9 +151,6 @@ def create_train_state(model: nn.Module, tx: optax.GradientTransformation,
             "params": param_sharding(mesh, abstract["params"], fsdp=True,
                                      fsdp_min_size=fsdp_min_size)}
     shardings = var_shardings["params"]
-
-    def init_vars(key):
-        return nn.meta.unbox(model.init(key, sample_input, train=False))
 
     # Optimizer-state shardings: slots that mirror a param tensor (Adam
     # m/v, momentum) get that param's sharding; scalars (step counts)
@@ -152,25 +196,48 @@ def create_train_state(model: nn.Module, tx: optax.GradientTransformation,
     abstract_opt = jax.eval_shape(tx.init, abstract_params)
     opt_shardings = jax.tree_util.tree_map_with_path(
         opt_leaf_sharding, abstract_opt)
+    return abstract, var_shardings, shardings, abstract_opt, opt_shardings
 
-    with mesh:
-        variables = jax.jit(init_vars, out_shardings=var_shardings)(
-            prng.init_key(seed))
-        params = variables["params"]
-        extra = {k: v for k, v in variables.items()
-                 if k != "params" and k not in TRANSIENT_COLLECTIONS}
-        opt_state = jax.jit(tx.init, out_shardings=opt_shardings)(params)
-        step = jax.device_put(jax.numpy.zeros((), jax.numpy.int32),
-                              replicated(mesh))
-    ema_params = None
-    if ema:
-        with mesh:
-            # Start at the init params, placed identically (sharded
-            # leaves stay sharded — EMA costs 1/data per device under
-            # FSDP like the params themselves).
-            ema_params = jax.jit(
-                lambda p: jax.tree_util.tree_map(jax.numpy.array, p),
-                out_shardings=shardings)(params)
+
+def abstract_train_state(model: nn.Module,
+                         tx: optax.GradientTransformation,
+                         sample_input: jax.Array, mesh: Mesh,
+                         fsdp: bool = False,
+                         fsdp_min_size: int = FSDP_MIN_SIZE,
+                         opt_fsdp: bool = False,
+                         ema: bool = False) -> TrainState:
+    """A :class:`TrainState` of sharding-annotated ShapeDtypeStructs —
+    the EXACT layout :func:`create_train_state` would place (same
+    derivation, :func:`derive_state_shardings`) without allocating a
+    byte on any device.
+
+    Enough to drive the AOT API: ``make_train_step(...).lower(state,
+    batch).compile()`` accepts this state and yields the real
+    program's ``cost_analysis``/``memory_analysis`` — what the
+    auto-layout planner scores candidates with, including mesh shapes
+    too big (or, on a skewed container, too broken) to ever
+    materialize here.
+    """
+    (abstract, _, shardings, abstract_opt,
+     opt_shardings) = derive_state_shardings(
+        model, tx, sample_input, mesh, fsdp=fsdp,
+        fsdp_min_size=fsdp_min_size, opt_fsdp=opt_fsdp)
+
+    def _sds(leaf, sharding):
+        return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
+                                    sharding=sharding)
+
+    abstract_params = nn.meta.unbox(abstract["params"])
+    params = jax.tree_util.tree_map(_sds, abstract_params, shardings)
+    opt_state = jax.tree_util.tree_map(_sds, abstract_opt, opt_shardings)
+    rep = replicated(mesh)
+    extra = {
+        k: jax.tree_util.tree_map(lambda a: _sds(a, rep), v)
+        for k, v in nn.meta.unbox(abstract).items()
+        if k != "params" and k not in TRANSIENT_COLLECTIONS}
+    step = jax.ShapeDtypeStruct((), jax.numpy.int32, sharding=rep)
+    ema_params = (jax.tree_util.tree_map(_sds, abstract_params,
+                                         shardings) if ema else None)
     return TrainState(step=step, params=params, opt_state=opt_state,
                       apply_fn=model.apply, tx=tx, extra=extra,
                       ema=ema_params)
