@@ -143,6 +143,37 @@ if [ "$plan_rc" -ne 0 ]; then
        "$PLANLOG" >&2
 fi
 
+# Gradsync smoke (overlap-aware grad sync: serial psum vs bucketed
+# reduce-scatter/all-gather on the real tiny-gpt step, mesh 2 —
+# benchmarks/gradsync.py --family gpt): identity-gated (serial and
+# overlap training bit-equal incl. a skipped NaN step) plus the
+# step-time gate at the CPU tolerance; the committed GRADSYNC.json
+# run carries the mesh-4 A/B. Same abort-guard shape as the smokes
+# above: a run that dies to the known container XLA:CPU abort prints
+# no gradsync_checks line and is retried once; a genuine gate failure
+# prints one and is NOT retried.
+GRADSYNCLOG="${GRADSYNCLOG:-/tmp/_t1_gradsync.log}"
+run_gradsync() {
+  rm -f "$GRADSYNCLOG"
+  timeout -k 10 300 env JAX_PLATFORMS=cpu python -m \
+    tensorflow_distributed_tpu.benchmarks.gradsync \
+    --family gpt --devices 2 --steps 6 --batch 16 --seq-len 32 \
+    --out "" 2>&1 | tee "$GRADSYNCLOG"
+  return "${PIPESTATUS[0]}"
+}
+run_gradsync
+gradsync_rc=$?
+if ! grep -qa '"metric": "gradsync_checks"' "$GRADSYNCLOG"; then
+  echo "[t1] no gradsync_checks line in $GRADSYNCLOG (known container" \
+       "XLA:CPU abort) — rerunning gradsync once" >&2
+  run_gradsync
+  gradsync_rc=$?
+fi
+if [ "$gradsync_rc" -ne 0 ]; then
+  echo "[t1] gradsync smoke FAILED (gradsync_rc=$gradsync_rc) — see" \
+       "$GRADSYNCLOG" >&2
+fi
+
 if [ "$rc" -eq 0 ] && [ "$lint_rc" -ne 0 ]; then
   echo "[t1] suite green but graftcheck red (lint_rc=$lint_rc) — see" \
        "scripts/lint.sh output above" >&2
@@ -156,5 +187,8 @@ if [ "$rc" -eq 0 ] && [ "$elastic_rc" -ne 0 ]; then
 fi
 if [ "$rc" -eq 0 ] && [ "$plan_rc" -ne 0 ]; then
   exit "$plan_rc"
+fi
+if [ "$rc" -eq 0 ] && [ "$gradsync_rc" -ne 0 ]; then
+  exit "$gradsync_rc"
 fi
 exit "$rc"

@@ -197,6 +197,52 @@ def _pipelined_jaxpr(health_every: int = 0):
     return jax.make_jaxpr(step)(state, _clm_batch())
 
 
+#: The overlap census build: data=2 mesh, tiny model, a bucket bound
+#: and scatter threshold small enough that the tiny tree splits into
+#: SEVERAL scatter buckets — the golden pins one psum_scatter + one
+#: all_gather PER BUCKET (plus the replicated-leaf psum and the metric
+#: pmeans), so a refactor that fuses, drops, or doubles a bucket's
+#: collectives fails here by count.
+_OVERLAP_DATA = 2
+_OVERLAP_BUCKET_BYTES = 8192
+_OVERLAP_MIN_SIZE = 256
+
+
+def _overlap_jaxpr(model_name: str):
+    """The explicit overlap train step (parallel/overlap.py) on a
+    data=2 mesh: bucketed psum_scatter -> ZeRO-1 sharded update ->
+    bucketed all_gather, traced via the REAL builder. Model built
+    mesh-less (the forward runs inside the step's shard_map; see the
+    builder's docstring), state built with zero1 slots at the same
+    scatter threshold the step plans with."""
+    import optax
+
+    from tensorflow_distributed_tpu.models import transformer
+    from tensorflow_distributed_tpu.parallel.overlap import (
+        make_explicit_train_step)
+    from tensorflow_distributed_tpu.train.state import create_train_state
+    from tensorflow_distributed_tpu.train.tasks import (
+        make_mlm_loss, make_moe_loss, mlm_batch_shardings)
+
+    mesh = _mesh(data=_OVERLAP_DATA)
+    factory = (transformer.moe_lm if model_name == "moe_lm"
+               else transformer.gpt_lm)
+    model = factory(mesh=None, size="tiny", dropout_rate=0.0,
+                    compute_dtype=jnp.bfloat16, tp_partitioning=False)
+    state = create_train_state(model, optax.adam(1e-3),
+                               np.zeros((2, _L), np.int32), mesh, seed=0,
+                               opt_fsdp=True,
+                               fsdp_min_size=_OVERLAP_MIN_SIZE)
+    loss = (make_moe_loss() if model_name == "moe_lm"
+            else make_mlm_loss())
+    step = make_explicit_train_step(
+        mesh, state, loss=loss,
+        batch_shardings=mlm_batch_shardings(mesh), grad_sync="overlap",
+        bucket_bytes=_OVERLAP_BUCKET_BYTES,
+        fsdp_min_size=_OVERLAP_MIN_SIZE, jit=False)
+    return jax.make_jaxpr(step)(state, _clm_batch())
+
+
 def _serve_decode_jaxpr():
     """THE decode program serve/engine.py dispatches every step: one
     greedy token for every slot at its own depth."""
@@ -246,6 +292,11 @@ PROGRAMS = {
     "moe_train_health": lambda: _train_jaxpr(
         "moe_lm", health_every=10),
     "pipelined_train_health": lambda: _pipelined_jaxpr(health_every=10),
+    # Explicit overlap grad-sync (parallel/overlap.py): the budgets
+    # pin the bucketed reduce-scatter/all-gather schedule per bucket
+    # count (see _overlap_jaxpr's constants).
+    "gpt_train_overlap": lambda: _overlap_jaxpr("gpt_lm"),
+    "moe_train_overlap": lambda: _overlap_jaxpr("moe_lm"),
 }
 
 

@@ -30,7 +30,13 @@ MODEL_FAMILIES = {v: k for k, v in FAMILY_MODELS.items()}
 #: gpt_lm(size="small"), moe_lm(size="tiny"), pipelined_lm("tiny")).
 DEFAULT_SIZES = {"gpt": "small", "moe": "tiny", "pipelined": "tiny"}
 
-PARTITIONS = ("replicated", "fsdp", "zero1")
+#: Partition-like strategy choices. "overlap" = zero1 slot sharding +
+#: the explicit bucketed reduce-scatter/all-gather grad sync
+#: (parallel/overlap.py; launches as --param-partition zero1
+#: --grad-sync overlap). Pure-data meshes only — the explicit
+#: shard_map formulation doesn't reproduce tensor/expert/pipe
+#: schedules.
+PARTITIONS = ("replicated", "fsdp", "zero1", "overlap")
 
 
 def format_mesh(mesh: Dict[str, int]) -> str:
@@ -139,7 +145,12 @@ class Candidate:
         out: List[str] = []
         for axis, size in self.axes:
             out += [f"--mesh.{axis}", str(size)]
-        if self.partition != "replicated":
+        if self.partition == "overlap":
+            # The overlap strategy IS zero1 slot sharding plus the
+            # explicit grad-sync flag.
+            out += ["--param-partition", "zero1",
+                    "--grad-sync", "overlap"]
+        elif self.partition != "replicated":
             out += ["--param-partition", self.partition]
         if self.microbatches:
             out += ["--pipeline-microbatches", str(self.microbatches)]
@@ -207,6 +218,7 @@ def enumerate_candidates(
         strategies: Optional[Sequence[str]] = None,
         microbatches: int = 4,
         infeasible: Optional[Callable[..., Optional[str]]] = None,
+        overlap_conflict: Optional[str] = None,
 ) -> Tuple[List[Candidate], List[Pruned]]:
     """All (mesh factorization x partition) candidates for a family.
 
@@ -217,6 +229,11 @@ def enumerate_candidates(
     survives only when every part of its strategy name is allowed.
     ``infeasible`` is the shared mesh rule
     (parallel.mesh.mesh_infeasible), injectable for jax-free tests.
+    ``overlap_conflict`` (a reason string, or None) prunes every
+    "overlap" candidate — --plan auto passes the run's
+    config.overlap_grad_sync_conflict() so the plan never picks a
+    layout whose launch the config would then reject (the standalone
+    planner CLI plans layouts, not runs, and passes nothing).
     """
     facts.validate()
     if devices < 1:
@@ -248,6 +265,23 @@ def enumerate_candidates(
                         "(stage params are shard_map-managed; "
                         "config.validate rejects it)")))
                     continue
+                if partition == "overlap":
+                    if facts.family == "pipelined":
+                        pruned.append(Pruned(cand, (
+                            "overlap grad-sync applies to the "
+                            "standard jitted step; the hand-scheduled "
+                            "pipeline owns its own collective "
+                            "schedule")))
+                        continue
+                    if k > 1:
+                        pruned.append(Pruned(cand, (
+                            f"overlap grad-sync needs a pure data "
+                            f"mesh; {second}={k} > 1")))
+                        continue
+                    if overlap_conflict:
+                        pruned.append(Pruned(cand, (
+                            f"overlap grad-sync: {overlap_conflict}")))
+                        continue
                 if partition != "replicated" and data == 1:
                     pruned.append(Pruned(cand, (
                         f"{partition} shards over the data axis; "

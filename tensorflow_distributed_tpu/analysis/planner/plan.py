@@ -41,15 +41,19 @@ def make_plan(family: str, devices: int, batch_size: int,
               dropout_rate: float = 0.0,
               compute_dtype: str = "bfloat16",
               hw: Optional[score_lib.Hardware] = None,
-              hbm_budget: Optional[float] = None) -> Dict[str, Any]:
+              hbm_budget: Optional[float] = None,
+              overlap_conflict: Optional[str] = None) -> Dict[str, Any]:
     """Enumerate + score + rank: the whole planning pass, as a dict
     (the ``plan.json`` schema). ``chosen`` is the best feasible scored
-    candidate, or None when nothing is feasible."""
+    candidate, or None when nothing is feasible. ``overlap_conflict``
+    prunes the overlap strategy with that reason (see
+    enumerate_candidates — apply_auto threads the run's knob
+    conflicts)."""
     facts = cand_lib.model_facts(family, size, moe_experts=moe_experts)
     seq_len = seq_len or 128
     feasible, pruned = cand_lib.enumerate_candidates(
         facts, devices, batch_size, strategies=strategies,
-        microbatches=microbatches)
+        microbatches=microbatches, overlap_conflict=overlap_conflict)
     hw = hw or score_lib.detect_hardware()
     rows = score_lib.score_candidates(
         feasible, facts, batch_size, hw, seq_len=seq_len, size=size,
@@ -173,7 +177,11 @@ def apply_auto(cfg) -> Dict[str, Any]:
         moe_experts=cfg.moe_experts, dropout_rate=cfg.dropout_rate,
         compute_dtype=cfg.compute_dtype,
         hbm_budget=(cfg.plan_hbm_budget_gb * 1e9
-                    if cfg.plan_hbm_budget_gb else None))
+                    if cfg.plan_hbm_budget_gb else None),
+        # Knobs the overlap launch would reject (non-elementwise
+        # optimizer, grad clip, ce_chunk, ...) prune the strategy here
+        # — picking it would just crash the re-validate after the plan.
+        overlap_conflict=cfg.overlap_grad_sync_conflict())
     if is_chief():
         print(render_table(plan), flush=True)
     chosen = plan["chosen"]
@@ -183,7 +191,13 @@ def apply_auto(cfg) -> Dict[str, Any]:
             f"{devices} device(s) with batch {cfg.batch_size} — see "
             f"the table above for per-candidate reasons")
     cfg.mesh = MeshConfig(**chosen["mesh"])
-    cfg.param_partition = chosen["partition"]
+    if chosen["partition"] == "overlap":
+        # The overlap strategy launches as zero1 slots + the explicit
+        # bucketed grad sync (Candidate.cli_args says the same).
+        cfg.param_partition = "zero1"
+        cfg.grad_sync = "overlap"
+    else:
+        cfg.param_partition = chosen["partition"]
     if family == "pipelined" and chosen.get("microbatches"):
         cfg.pipeline_microbatches = chosen["microbatches"]
     return plan_record(plan)

@@ -111,11 +111,21 @@ def detect_hardware(peak_tflops: float = 0.0, hbm_gbps: float = 0.0,
 # --- the scoring math (pure; unit-tested on canned dicts) --------------
 
 def roofline_ms(costs: Dict[str, Any], collective_bytes: float,
-                hw: Hardware) -> Dict[str, Optional[float]]:
+                hw: Hardware, overlap: bool = False
+                ) -> Dict[str, Optional[float]]:
     """Predicted per-step milliseconds from one program's cost dict:
     ``max(compute, memory) + collectives``. Null costs (a backend
     exposing no analysis) yield explicitly-null predictions — the
-    candidate stays in the table, unranked, never invents a number."""
+    candidate stays in the table, unranked, never invents a number.
+
+    ``overlap=True`` (the explicit bucketed grad-sync strategy,
+    parallel/overlap.py) applies the overlap discount: the bucketed
+    reduce-scatter/all-gather schedule hides under backward compute,
+    so the collective term stops being additive —
+    ``max(compute, memory, collectives)`` instead of
+    ``max(compute, memory) + collectives``. That is exactly the edge
+    the planner needs to rank overlap against plain data/zero1, whose
+    GSPMD-implicit allreduce rides the bytes term serially."""
     flops, moved = costs.get("flops"), costs.get("bytes_accessed")
     if not isinstance(flops, (int, float)) or not isinstance(
             moved, (int, float)):
@@ -124,10 +134,12 @@ def roofline_ms(costs: Dict[str, Any], collective_bytes: float,
     compute = 1e3 * float(flops) / hw.peak_flops
     memory = 1e3 * float(moved) / hw.hbm_bw
     collective = 1e3 * float(collective_bytes or 0.0) / hw.ici_bw
+    step = (max(compute, memory, collective) if overlap
+            else max(compute, memory) + collective)
     return {"compute_ms": round(compute, 6),
             "memory_ms": round(memory, 6),
             "collective_ms": round(collective, 6),
-            "step_ms": round(max(compute, memory) + collective, 6)}
+            "step_ms": round(step, 6)}
 
 
 def mark_feasibility(rows: List[Dict[str, Any]],
@@ -265,17 +277,34 @@ def build_candidate_step(cand: Candidate, facts: ModelFacts,
             kw["moe_experts"] = moe_experts
         factory = (transformer.moe_lm if facts.family == "moe"
                    else transformer.gpt_lm)
-        model = factory(mesh=mesh, size=size, **kw)
+        overlap = cand.partition == "overlap"
+        if overlap:
+            # The explicit step's forward runs inside its shard_map —
+            # mesh-less model, no activation pins (the builder's
+            # docstring; same construction train.loop uses for
+            # --grad-sync).
+            kw["tp_partitioning"] = False
+        model = factory(mesh=None if overlap else mesh, size=size,
+                        **kw)
         state = make_state(model, tx, sample, mesh,
                            fsdp=cand.partition == "fsdp",
-                           opt_fsdp=cand.partition == "zero1")
+                           opt_fsdp=cand.partition in ("zero1",
+                                                       "overlap"))
         params_out = (jax.tree_util.tree_map(lambda s: s.sharding,
                                              state.params)
-                      if cand.partition == "zero1" else None)
+                      if cand.partition in ("zero1", "overlap")
+                      else None)
         loss = (make_moe_loss() if facts.family == "moe"
                 else make_mlm_loss())
-        step = make_train_step(mesh, loss=loss, batch_shardings=sh,
-                               params_out_shardings=params_out)
+        if overlap:
+            from tensorflow_distributed_tpu.parallel.overlap import (
+                make_explicit_train_step)
+            step = make_explicit_train_step(
+                mesh, state, loss=loss, batch_shardings=sh,
+                grad_sync="overlap", params_out_shardings=params_out)
+        else:
+            step = make_train_step(mesh, loss=loss, batch_shardings=sh,
+                                   params_out_shardings=params_out)
     abatch = {
         k: jax.ShapeDtypeStruct(
             (batch, seq_len),
@@ -323,7 +352,8 @@ def score_candidate(cand: Candidate, facts: ModelFacts, batch: int,
         row["compile_s"] = round(compile_s, 4)
     except Exception as e:  # degrade, never die: explicit-null row
         row["error"] = f"{type(e).__name__}: {e}"[:300]
-    row.update(roofline_ms(row, row["collective_bytes"], hw))
+    row.update(roofline_ms(row, row["collective_bytes"], hw,
+                           overlap=cand.partition == "overlap"))
     return row
 
 

@@ -1,27 +1,48 @@
-"""Gradient-sync latency A/B: ICI allreduce vs parameter-server emulation.
+"""Gradient-sync benchmarks: the ps-era latency A/B and the overlap gate.
 
-This is the BASELINE.json metric "allreduce vs ps grad-sync latency",
-measured rather than assumed. The reference synchronized gradients by
-routing every worker's full gradient tensor through one parameter-server
-process over gRPC/TCP and pulling the updated weights back — 2x full
-push + 2x full pull per step through a single host NIC
-(mnist_python_m.py:216-233; SURVEY.md §5 "communication backend"). The
-TPU-native replacement is one XLA psum over ICI: gradients never leave
-the chips.
+Two modes, one CLI:
 
-Both sides of the A/B time ONLY the sync protocol on identically-shaped
-gradient pytrees (the MNIST CNN's ~3.2M params by default); gradient
-computation is excluded from both timed spans:
+**Legacy ps A/B** (no ``--family``; the BASELINE.json metric
+"allreduce vs ps grad-sync latency"): the reference routed every
+worker's full gradient through one parameter-server process over
+gRPC/TCP (mnist_python_m.py:216-233; SURVEY.md §5); the TPU-native
+replacement is one XLA psum over ICI. Both sides time ONLY the sync
+protocol on identically-shaped gradient pytrees.
 
-- ``allreduce``: jitted ``lax.pmean`` over the mesh "data" axis
-  (parallel.collectives.allreduce_latency_probe).
-- ``ps``: per-shard grads pulled to host numpy, averaged there,
-  re-broadcast with device_put (parallel.collectives.ps_style_sync_probe)
-  — an honest local-host stand-in for the reference's ps (it still pays
-  device<->host transit + host aggregation, but NOT TCP, so the measured
-  gap is a *lower bound* on the real one).
+**Overlap A/B gate** (``--family gpt``): serial psum tail vs the
+bucketed overlap path (parallel/overlap.py) on the REAL LM train step
+at mesh >= 2 — the ROADMAP item 2 acceptance artifact:
 
-Prints one JSON line per metric plus a summary speedup line.
+- **identity**: serial and overlap training are BIT-identical over
+  several steps (params, Adam slots, EMA — a NaN-poisoned step
+  exercises the ``skip_nonfinite`` discard on both sides). The two
+  formulations compute the same per-element sums by construction
+  (psum_scatter/all_gather vs pmean; blocking-invariant elementwise
+  optimizer math); what the gate additionally pins is that XLA:CPU
+  COMPILES them to the same roundings — elementwise FMA contraction
+  can differ between differently-fused programs (observed at
+  --bucket-kb 64 with the skip-norm consumers in the graph), so the
+  committed artifact runs the default config where the compiled
+  programs agree bit-for-bit;
+- **step time**: min-of-interleaved-steps (the planbench discipline —
+  all candidates resident, measured round-robin, so host scheduling
+  noise degrades every side equally) must satisfy
+  ``overlap <= serial * (1 + tol)``; tol defaults to 10% on CPU hosts
+  (virtual-device collectives are memcpys — the overlap win there is
+  the 1/N sharded update, not hidden comm) and 0 on TPU, where a
+  measurable win is required;
+- **exposed communication**: an "unsynced" third program (same
+  compute, collectives deleted — WRONG math, bench-only) gives the
+  compute floor; ``exposed(side) = step_min(side) - unsynced_min``
+  estimates each side's serial communication tail. On TPU the gate
+  additionally requires the overlap side's exposure to SHRINK.
+- the ``allreduce_latency_probe`` comm floor (min-of-N; the probe is
+  warm since this PR — its first sample used to carry compile wall)
+  is reported beside the exposure estimates for cross-checking.
+
+Prints one JSON line per metric plus a ``gradsync_checks`` line;
+``--out`` writes the full artifact (committed as GRADSYNC.json);
+exit 1 on a failed gate (``--no-check`` to report without gating).
 """
 
 from __future__ import annotations
@@ -29,8 +50,11 @@ from __future__ import annotations
 import argparse
 import json
 import statistics
+import sys
 import time
-from typing import Callable, List
+from typing import Any, Callable, Dict, List
+
+from tensorflow_distributed_tpu.analysis.planner.plan import init_backend
 
 
 def _time_probe(probe: Callable[[], float], iters: int, warmup: int = 3
@@ -40,13 +64,7 @@ def _time_probe(probe: Callable[[], float], iters: int, warmup: int = 3
     return [probe() for _ in range(iters)]
 
 
-def main(argv=None) -> None:
-    parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument("--iters", type=int, default=30)
-    parser.add_argument("--model", default="mnist_cnn",
-                        choices=["mnist_cnn", "resnet20"])
-    args = parser.parse_args(argv)
-
+def _legacy_ps_ab(args) -> int:
     import jax
     import numpy as np
     import optax
@@ -98,7 +116,261 @@ def main(argv=None) -> None:
         "metric": "allreduce_vs_ps_speedup",
         "value": round(ps_ms / ar_ms, 2) if ar_ms > 0 else float("inf"),
         "unit": "x", **meta}))
+    return 0
+
+
+# --- the overlap A/B gate ----------------------------------------------
+
+SIDES = ("serial", "overlap", "unsynced")
+
+
+def _build_side(sync: str, mesh, model, loss, sh, args, donate: bool,
+                skip_nonfinite: bool = False):
+    """State + explicit step for one A/B side. Serial/unsynced run
+    replicated slots (the serial tail's real layout); overlap runs
+    zero1 slots at the same scatter threshold it buckets with."""
+    import jax
+    import numpy as np
+    import optax
+
+    from tensorflow_distributed_tpu.parallel.overlap import (
+        make_explicit_train_step)
+    from tensorflow_distributed_tpu.train.state import create_train_state
+
+    overlap = sync == "overlap"
+    state = create_train_state(
+        model, optax.adam(1e-3), np.zeros((2, args.seq_len), np.int32),
+        mesh, seed=0, opt_fsdp=overlap, fsdp_min_size=args.min_scatter,
+        ema=True)
+    params_out = (jax.tree_util.tree_map(lambda a: a.sharding,
+                                         state.params)
+                  if overlap else None)
+    step = make_explicit_train_step(
+        mesh, state, loss=loss, batch_shardings=sh, grad_sync=sync,
+        bucket_bytes=args.bucket_kb * 1024,
+        fsdp_min_size=args.min_scatter, donate=donate, ema_decay=0.999,
+        params_out_shardings=params_out, skip_nonfinite=skip_nonfinite)
+    return state, step
+
+
+def _bit_equal(a, b) -> bool:
+    import jax
+    import numpy as np
+
+    fa, fb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    return len(fa) == len(fb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(fa, fb))
+
+
+def _overlap_ab(args) -> int:
+    platform = init_backend(args.devices, tag="gradsync")
+    import jax
+    import numpy as np
+
+    from tensorflow_distributed_tpu.config import MeshConfig
+    from tensorflow_distributed_tpu.data.lm import synthetic_clm
+    from tensorflow_distributed_tpu.models import transformer
+    from tensorflow_distributed_tpu.parallel.collectives import (
+        allreduce_latency_probe, min_latency)
+    from tensorflow_distributed_tpu.parallel.mesh import make_mesh
+    from tensorflow_distributed_tpu.parallel.overlap import (
+        comm_bytes_per_step, plan_buckets)
+    from tensorflow_distributed_tpu.train.tasks import (
+        make_mlm_loss, mlm_batch_shardings)
+
+    devices = args.devices or len(jax.devices())
+    if devices < 2:
+        print("gradsync: the overlap A/B needs >= 2 devices",
+              file=sys.stderr)
+        return 2
+    if len(jax.devices()) < devices:
+        print(f"gradsync: asked for {devices} devices but only "
+              f"{len(jax.devices())} are visible", file=sys.stderr)
+        return 2
+    mesh = make_mesh(MeshConfig(data=devices), jax.devices()[:devices])
+    # Mesh-less model: the explicit step's forward runs inside its
+    # shard_map (parallel/overlap.py docstring).
+    model = transformer.gpt_lm(
+        mesh=None, size=args.size, tp_partitioning=False,
+        dropout_rate=0.0, compute_dtype=jax.numpy.bfloat16,
+        max_len=args.seq_len)
+    loss = make_mlm_loss()
+    sh = mlm_batch_shardings(mesh)
+    ds = synthetic_clm(n=max(8 * args.batch, 128), seq_len=args.seq_len,
+                       vocab_size=64)
+
+    def put(i: int, poison: bool = False):
+        b = ds.batch((np.arange(args.batch) + i * args.batch)
+                     % ds.tokens.shape[0])
+        if poison:
+            b = dict(b)
+            b["mask"] = np.asarray(b["mask"]) * np.nan
+        return {k: jax.device_put(np.asarray(v), sh[k])
+                for k, v in b.items()}
+
+    meta: Dict[str, Any] = {
+        "platform": platform, "devices": devices, "family": args.family,
+        "size": args.size, "batch": args.batch, "seq_len": args.seq_len,
+        "bucket_kb": args.bucket_kb, "min_scatter": args.min_scatter,
+    }
+    template, _ = _build_side("serial", mesh, model, loss, sh, args,
+                              donate=False)
+    plan = plan_buckets(template.params, devices,
+                        bucket_bytes=args.bucket_kb * 1024,
+                        fsdp_min_size=args.min_scatter)
+    meta["plan"] = plan.describe()
+    meta["comm_bytes_per_step"] = comm_bytes_per_step(plan)
+    if not plan.scatter:
+        print("gradsync: WARNING no scatterable leaves at "
+              f"--min-scatter {args.min_scatter} — overlap degenerates "
+              f"to fused psums", file=sys.stderr)
+
+    # --- identity: serial vs overlap bit-equal, skip step included ---
+    id_states = {}
+    for sync in ("serial", "overlap"):
+        st, step = _build_side(sync, mesh, model, loss, sh, args,
+                               donate=False, skip_nonfinite=True)
+        for i in range(args.identity_steps):
+            st, m = step(st, put(i, poison=(i == 1)))
+        jax.block_until_ready(m)
+        id_states[sync] = st
+    identity = {
+        "params": _bit_equal(id_states["serial"].params,
+                             id_states["overlap"].params),
+        "opt_state": _bit_equal(id_states["serial"].opt_state,
+                                id_states["overlap"].opt_state),
+        "ema": _bit_equal(id_states["serial"].ema,
+                          id_states["overlap"].ema),
+    }
+    print(json.dumps({"metric": "gradsync_identity", **identity,
+                      "steps": args.identity_steps, **{
+                          k: meta[k] for k in ("platform", "devices")}}))
+
+    # --- step-time A/B: warm, then min-of-interleaved-steps ----------
+    ctxs = {}
+    for sync in SIDES:
+        st, step = _build_side(sync, mesh, model, loss, sh, args,
+                               donate=True)
+        m = None
+        for i in range(args.warmup):
+            st, m = step(st, put(i))
+        if m is not None:
+            jax.block_until_ready(m)
+        ctxs[sync] = {"state": st, "step": step, "i": args.warmup,
+                      "walls": []}
+    for _ in range(args.steps):
+        for sync in SIDES:
+            ctx = ctxs[sync]
+            b = put(ctx["i"])
+            ctx["i"] += 1
+            t0 = time.perf_counter()
+            ctx["state"], m = ctx["step"](ctx["state"], b)
+            jax.block_until_ready(m)
+            ctx["walls"].append(time.perf_counter() - t0)
+
+    stats: Dict[str, Dict[str, float]] = {}
+    for sync in SIDES:
+        walls = sorted(ctxs[sync]["walls"])
+        stats[sync] = {
+            "min_ms": round(1e3 * walls[0], 4),
+            "median_ms": round(1e3 * walls[len(walls) // 2], 4)}
+        print(json.dumps({"metric": f"gradsync_step_{sync}",
+                          **stats[sync], "steps": args.steps,
+                          **{k: meta[k] for k in ("platform",
+                                                  "devices")}}))
+
+    # Comm floor: one warm mean-allreduce of the full param tree,
+    # min-of-N (the satellite-fixed probe).
+    floor_s = min_latency(
+        allreduce_latency_probe(mesh, template.params), iters=10)
+    exposed = {
+        sync: round(stats[sync]["min_ms"] - stats["unsynced"]["min_ms"],
+                    4)
+        for sync in ("serial", "overlap")}
+    print(json.dumps({"metric": "gradsync_exposed_comm_ms",
+                      **exposed,
+                      "allreduce_floor_ms": round(1e3 * floor_s, 4),
+                      **{k: meta[k] for k in ("platform", "devices")}}))
+
+    tol = args.tol if args.tol >= 0 else (0.0 if platform == "tpu"
+                                          else 0.10)
+    checks = {
+        "identity": all(identity.values()),
+        "overlap_not_slower": (
+            stats["overlap"]["min_ms"]
+            <= stats["serial"]["min_ms"] * (1.0 + tol)),
+    }
+    if platform == "tpu":
+        # On real ICI the whole point is hiding the tail: require the
+        # exposure estimate to shrink, not just the total.
+        checks["exposed_shrinks"] = (exposed["overlap"]
+                                     < exposed["serial"])
+    ok = all(checks.values())
+    line = {"metric": "gradsync_checks", "value": ok, **checks,
+            "tol": tol, **{k: meta[k] for k in ("platform", "devices")}}
+    print(json.dumps(line))
+
+    if args.out:
+        artifact = {"meta": meta, "identity": identity, "steps": stats,
+                    "exposed_comm_ms": exposed,
+                    "allreduce_floor_ms": round(1e3 * floor_s, 4),
+                    "checks": checks, "tol": tol, "ok": ok}
+        with open(args.out, "w") as f:
+            json.dump(artifact, f, indent=2)
+            f.write("\n")
+        print(f"gradsync: wrote {args.out}")
+    if args.no_check:
+        return 0
+    return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    # Legacy ps A/B knobs:
+    parser.add_argument("--iters", type=int, default=30)
+    parser.add_argument("--model", default="mnist_cnn",
+                        choices=["mnist_cnn", "resnet20"])
+    # Overlap A/B gate knobs:
+    parser.add_argument("--family", default="", choices=["", "gpt"],
+                        help="LM family for the overlap A/B gate; "
+                        "empty = the legacy ps-vs-allreduce A/B")
+    parser.add_argument("--size", default="tiny")
+    parser.add_argument("--devices", type=int, default=0,
+                        help="data-axis width (default: all visible; "
+                        "on CPU forces that many virtual devices)")
+    parser.add_argument("--batch", type=int, default=16)
+    parser.add_argument("--seq-len", type=int, default=64)
+    parser.add_argument("--steps", type=int, default=20,
+                        help="interleaved timed visits per side")
+    parser.add_argument("--warmup", type=int, default=3)
+    parser.add_argument("--identity-steps", type=int, default=4)
+    parser.add_argument("--bucket-kb", type=int, default=8,
+                        help="overlap bucket bound (KiB; tiny trees "
+                        "want small buckets so several exist — "
+                        "production runs use --grad-sync-bucket-mb)")
+    parser.add_argument("--min-scatter", type=int, default=256,
+                        help="scatterable-leaf threshold (elements); "
+                        "the tiny preset's leaves sit under the "
+                        "production FSDP_MIN_SIZE")
+    parser.add_argument("--tol", type=float, default=-1.0,
+                        help="overlap-vs-serial step-time tolerance "
+                        "(-1 = auto: 0.10 on CPU, 0 on TPU)")
+    parser.add_argument("--out", default="",
+                        help="artifact JSON path ('' = don't write)")
+    parser.add_argument("--no-check", action="store_true",
+                        help="report without gating")
+    args = parser.parse_args(argv)
+    for flag in ("iters", "steps", "identity_steps"):
+        if getattr(args, flag) < 1:
+            parser.error(f"--{flag.replace('_', '-')} must be >= 1, "
+                         f"got {getattr(args, flag)}")
+    if args.warmup < 0:
+        parser.error(f"--warmup must be >= 0, got {args.warmup}")
+    if args.family:
+        return _overlap_ab(args)
+    return _legacy_ps_ab(args)
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

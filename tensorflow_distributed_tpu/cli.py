@@ -61,6 +61,15 @@ Examples:
     python -m tensorflow_distributed_tpu.cli --model gpt_lm \
         --model-size tiny --plan auto \
         --observe.metrics-jsonl /tmp/m.jsonl
+
+    # overlap-aware gradient sync (parallel/overlap.py; README
+    # "Gradient-sync overlap"): bucketed reduce-scatter + ZeRO-1
+    # sharded update + bucketed all-gather, hidden under backward
+    # compute; step records carry the exposed-vs-hidden comm estimate
+    python -m tensorflow_distributed_tpu.cli --model gpt_lm \
+        --mesh.data 8 --param-partition zero1 --grad-sync overlap \
+        --grad-sync-bucket-mb 4 \
+        --observe.metrics-jsonl /tmp/m.jsonl
 """
 
 from __future__ import annotations

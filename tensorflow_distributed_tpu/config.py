@@ -547,6 +547,28 @@ class TrainConfig:
     # every-worker-has-all-weights layout, minus its per-step ps
     # pull/push.
     param_partition: str = "replicated"  # replicated | zero1 | fsdp
+    # Gradient-sync formulation (parallel/overlap.py; README
+    # "Gradient-sync overlap"). "implicit" (default): GSPMD inserts
+    # the allreduce — the serial psum tail. "overlap": the grad tree
+    # is bucketed, each bucket reduce-scattered over the data axis as
+    # its backward contribution completes, the ZeRO-1 sharded
+    # optimizer update runs per bucket on each device's shard, and
+    # updated params are all-gathered bucketed — XLA's latency-hiding
+    # scheduler interleaves the explicit collectives with remaining
+    # compute instead of paying them serially. Requires
+    # param_partition=zero1 (the sharded update runs against zero1's
+    # slot layout), a pure-data mesh with data > 1, an elementwise
+    # optimizer (adam/sgd), and a non-pipelined family. "serial" is
+    # the explicit monolithic-psum baseline the GRADSYNC A/B measures
+    # overlap against (requires param_partition=replicated).
+    grad_sync: str = "implicit"  # implicit | serial | overlap
+    # Bucket bound (MiB) for grad_sync=overlap: leaves pack into
+    # dtype-keyed buckets of at most this size, one fused
+    # reduce-scatter + one fused all-gather per bucket. None = the
+    # path's default (parallel.overlap.DEFAULT_BUCKET_BYTES, 4 MiB);
+    # a sentinel rather than the literal so ANY explicit value without
+    # --grad-sync overlap is rejected, not just non-default ones.
+    grad_sync_bucket_mb: Optional[float] = None
     # Remat (jax.checkpoint) policy for big models: none | full | dots
     remat: str = "none"
     # Pipeline schedule for model=pipelined_lm: "1f1b" (default —
@@ -689,6 +711,48 @@ class TrainConfig:
     # > 1: beam search (deterministic; excludes gen_temperature > 0).
     num_beams: int = 1
 
+    def _explicit_sync_knob_conflict(self) -> Optional[str]:
+        """First training knob the explicit grad-sync step (serial or
+        overlap; parallel/overlap.py) cannot compose with, as the
+        message validate raises — None when compatible."""
+        if self.grad_accum_steps > 1:
+            return ("grad_sync != implicit has no microbatch scan; "
+                    "drop grad_accum_steps or use the implicit step")
+        if self.param_sync_every > 1:
+            return ("grad_sync != implicit does not compose with "
+                    "param_sync_every > 1 (local SGD has its own sync "
+                    "protocol)")
+        if self.grad_clip_norm:
+            return ("grad_clip_norm is not yet composed with the "
+                    "explicit grad-sync step (clip-by-global-norm "
+                    "inside the sharded update needs its own psum'd "
+                    "norm); drop one of the flags")
+        if self.ce_chunk:
+            return ("ce_chunk's fused loss applies its own sharding "
+                    "constraints, which cannot run inside the explicit "
+                    "step's shard_map; drop one of the flags")
+        if self.shard_vocab:
+            return ("shard_vocab annotates params over the model axis; "
+                    "the explicit grad-sync step needs plain pure-data "
+                    "params — drop one of the flags")
+        return None
+
+    def overlap_grad_sync_conflict(self) -> Optional[str]:
+        """Why grad_sync=overlap cannot run with this config's TRAINING
+        knobs (mesh shape / partition / family aside) — None when
+        compatible. The SAME checks validate raises for an explicit
+        --grad-sync overlap; --plan auto consults this so the planner
+        never picks an overlap layout the launch would then reject
+        (analysis/planner/plan.apply_auto)."""
+        if self.optimizer not in ("adam", "sgd"):
+            return (f"grad_sync=overlap needs an ELEMENTWISE "
+                    f"optimizer (adam/sgd; adamw via "
+                    f"weight_decay): a device's block must compute "
+                    f"exactly the full update's slice, which "
+                    f"{self.optimizer!r}'s factored statistics "
+                    f"break")
+        return self._explicit_sync_knob_conflict()
+
     def validate(self) -> None:
         if self.batch_size < 1:
             raise ValueError(f"batch_size must be >= 1, got {self.batch_size}")
@@ -758,6 +822,64 @@ class TrainConfig:
                 "model=pipelined_lm (stage params are shard_map-"
                 "managed); use param_partition=zero1 for optimizer-"
                 "slot memory, mesh.pipe/mesh.model for param memory")
+        if self.grad_sync not in ("implicit", "serial", "overlap"):
+            raise ValueError(
+                f"unknown grad_sync {self.grad_sync!r}; have "
+                f"('implicit', 'serial', 'overlap')")
+        if self.grad_sync_bucket_mb is not None:
+            if self.grad_sync_bucket_mb <= 0:
+                raise ValueError(
+                    f"grad_sync_bucket_mb must be > 0, "
+                    f"got {self.grad_sync_bucket_mb}")
+            if self.grad_sync != "overlap":
+                raise ValueError(
+                    "grad_sync_bucket_mb sizes the overlap path's "
+                    "collective buckets; it has no effect without "
+                    "--grad-sync overlap — drop the flag")
+        if self.grad_sync != "implicit":
+            # The explicit-collective step (parallel/overlap.py) is a
+            # shard_map over a pure data mesh; every exclusion below is
+            # a knob the explicit formulation would silently ignore or
+            # silently get wrong — rejected loudly, repo policy.
+            if self.mode != "train":
+                raise ValueError(
+                    f"grad_sync={self.grad_sync!r} shapes the TRAIN "
+                    f"step's gradient sync; it has no effect under "
+                    f"mode={self.mode!r} — drop the flag")
+            if self.model == "pipelined_lm":
+                raise ValueError(
+                    "grad_sync applies to the standard jitted step; "
+                    "the hand-scheduled pipeline step owns its own "
+                    "collective schedule (use mesh.pipe for that "
+                    "family)")
+            bad = [a for a in ("model", "seq", "pipe", "expert")
+                   if getattr(self.mesh, a) > 1]
+            if bad:
+                raise ValueError(
+                    f"grad_sync={self.grad_sync!r} needs a pure "
+                    f"data-parallel mesh; axes {bad} > 1")
+            if self.mesh.data == 1:
+                raise ValueError(
+                    "grad_sync with mesh.data=1 has nothing to "
+                    "synchronize; use the implicit step")
+            if self.grad_sync == "overlap":
+                if self.param_partition != "zero1":
+                    raise ValueError(
+                        "grad_sync=overlap IS weight-update sharding: "
+                        "the per-bucket update runs against zero1's "
+                        "sharded optimizer slots — add "
+                        "--param-partition zero1")
+            elif self.param_partition != "replicated":
+                raise ValueError(
+                    "grad_sync=serial replicates the full-tree update "
+                    "on every device; it requires "
+                    "param_partition=replicated (overlap is the mode "
+                    "that composes with zero1)")
+            conflict = (self.overlap_grad_sync_conflict()
+                        if self.grad_sync == "overlap"
+                        else self._explicit_sync_knob_conflict())
+            if conflict:
+                raise ValueError(conflict)
         if self.pipeline_microbatches < 1:
             raise ValueError(
                 f"pipeline_microbatches must be >= 1, "
@@ -1119,6 +1241,11 @@ class TrainConfig:
                     "--plan auto owns the partition choice "
                     "(replicated/fsdp/zero1 is part of the strategy "
                     "it ranks); drop --param-partition")
+            if self.grad_sync != "implicit":
+                raise ValueError(
+                    "--plan auto owns the grad-sync choice (the "
+                    "overlap strategy is one of the candidates it "
+                    "ranks); drop --grad-sync")
             if self.param_sync_every > 1:
                 raise ValueError(
                     "--plan auto does not compose with "

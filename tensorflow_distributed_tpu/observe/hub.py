@@ -120,6 +120,52 @@ class Observatory:
         obs.seq_len = seq
         return obs
 
+    def note_grad_sync(self, comm_bytes_per_step: float,
+                       plan: Optional[Dict[str, Any]] = None) -> None:
+        """Arm the per-step collective-exposed-vs-hidden estimate
+        (grad_sync=overlap): ``comm_bytes_per_step`` is the overlap
+        plan's per-device traffic (parallel.overlap.comm_bytes_per_
+        step). Step records then carry ``comm_ms_est`` (traffic over
+        the device kind's ICI bandwidth — the planner's TPU_HW table,
+        generic ratios on unknown kinds) and, when the accountant
+        knows the model FLOPs AND the chip peak, ``comm_exposed_ms_
+        est``/``comm_hidden_ms_est``: the slice of the comm estimate
+        NOT covered by the measured p50 step time's compute headroom.
+        An estimate by construction — the A/B truth lives in
+        benchmarks/gradsync.py."""
+        if not self.active:
+            return
+        self._comm_bytes = float(comm_bytes_per_step)
+        # Lazy: analysis.planner.score is import-light, but hub must
+        # not pull it (or jax device queries) for runs that never arm
+        # this.
+        import jax
+
+        from tensorflow_distributed_tpu.analysis.planner.score import (
+            GENERIC_HW, TPU_HW)
+        kind = getattr(jax.devices()[0], "device_kind", "unknown")
+        self._ici_bw = TPU_HW.get(kind, GENERIC_HW)[1]
+        if plan:
+            self.emit("grad_sync", comm_bytes_per_step=self._comm_bytes,
+                      ici_bw=self._ici_bw, **plan)
+
+    def _comm_fields(self, step_ms: Optional[float]) -> Dict[str, Any]:
+        """The exposed-vs-hidden split for one step-time sample."""
+        comm_bytes = getattr(self, "_comm_bytes", 0.0)
+        if not comm_bytes:
+            return {}
+        comm_ms = 1e3 * comm_bytes / self._ici_bw
+        out = {"comm_ms_est": round(comm_ms, 4)}
+        acc = self.accountant
+        if (acc.flops_per_item and acc.peak_flops_total
+                and self.items_per_step and step_ms is not None):
+            compute_ms = (1e3 * acc.flops_per_item * self.items_per_step
+                          / acc.peak_flops_total)
+            exposed = min(comm_ms, max(0.0, step_ms - compute_ms))
+            out["comm_exposed_ms_est"] = round(exposed, 4)
+            out["comm_hidden_ms_est"] = round(comm_ms - exposed, 4)
+        return out
+
     def note_step_fn(self, step_fn, params=None, model_cfg=None) -> None:
         """Inspect the built step function for observability metadata:
         a 1F1B step whose ``observe_hw_recompute`` attribute is set
@@ -197,6 +243,7 @@ class Observatory:
         fields: Dict[str, Any] = {"step": step}
         fields.update({k: float(v) for k, v in metrics.items()})
         fields.update(self.steptime.summary())
+        fields.update(self._comm_fields(fields.get("step_ms_p50")))
         if self._last_log is not None:
             last_step, last_t = self._last_log
             rates = self.accountant.rates(
@@ -226,7 +273,8 @@ class Observatory:
         # Plain dict merge (caller fields win): the goodput ledger may
         # carry categories whose "<cat>_seconds" keys the caller also
         # reports (e.g. compile_seconds from the loop's Timer).
-        rec = {**self.steptime.summary(),
+        steps = self.steptime.summary()
+        rec = {**steps, **self._comm_fields(steps.get("step_ms_p50")),
                **self.goodput.summary(total_seconds), **fields}
         self.registry.emit("summary", **rec)
 

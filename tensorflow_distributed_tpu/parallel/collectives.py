@@ -190,7 +190,16 @@ def ps_style_sync_probe(mesh: Mesh, stacked_grads: Any) -> Callable[[], float]:
 
 
 def allreduce_latency_probe(mesh: Mesh, grads_like: Any) -> Callable[[], float]:
-    """Time one psum-mean over the data axis for grad-shaped buffers."""
+    """Time one psum-mean over the data axis for grad-shaped buffers.
+
+    The returned probe is WARM: one untimed dispatch (with the same
+    dependent-scalar readback the timed path uses) runs here, so the
+    first timed call measures the collective, not trace+compile wall.
+    For a usable communication floor (the overlap A/B's baseline,
+    benchmarks/gradsync.py) take :func:`min_latency` over several
+    calls — the minimum is the schedulable cost; the median carries
+    host scheduling noise.
+    """
     psum = jax.jit(
         jax.shard_map(
             lambda t: jax.lax.pmean(t, AXIS_DATA), mesh=mesh,
@@ -207,4 +216,19 @@ def allreduce_latency_probe(mesh: Mesh, grads_like: Any) -> Callable[[], float]:
         float(jax.device_get(jax.numpy.ravel(leaf)[0]))
         return time.perf_counter() - t0
 
+    # Warm-up dispatch: psum compile wall must never leak into the
+    # first timed sample (it used to — the probe was unusable as a
+    # comm floor until its caller happened to add its own warmup).
+    warm = psum(grads_like)
+    leaf = jax.tree_util.tree_leaves(warm)[0]
+    float(jax.device_get(jax.numpy.ravel(leaf)[0]))
     return probe
+
+
+def min_latency(probe: Callable[[], float], iters: int = 10) -> float:
+    """Min-of-N of a latency probe, in seconds: the schedulable cost
+    of the operation, robust to host scheduling noise — what the
+    gradsync A/B reports as the communication floor."""
+    if iters < 1:
+        raise ValueError(f"iters must be >= 1, got {iters}")
+    return min(probe() for _ in range(iters))

@@ -69,6 +69,22 @@ def batch_sharding(mesh: Mesh, ndim: int = 1,
 FSDP_MIN_SIZE = 2 ** 14
 
 
+def fsdp_scatter_dim(shape: tuple, axis_size: int,
+                     taken: tuple = ()) -> int:
+    """The dim a leaf shards over "data" under the FSDP/ZeRO rule: the
+    LARGEST still-unsharded dim divisible by the axis size (ties keep
+    the earliest). -1 when no dim qualifies. THE one copy of the
+    dim-choice rule — ``param_sharding`` places slots with it and the
+    overlap grad-sync (parallel.overlap) reduce-scatters along it, so
+    the two can never disagree about where a shard lives."""
+    best = -1
+    for d, n in enumerate(shape):
+        if d not in taken and n % axis_size == 0:
+            if best < 0 or n > shape[best]:
+                best = d
+    return best
+
+
 def _fsdp_axis_choice(spec: list, shape: tuple, axis_size: int) -> list:
     """Add the data axis to the largest still-unsharded, divisible dim.
 
@@ -85,11 +101,9 @@ def _fsdp_axis_choice(spec: list, shape: tuple, axis_size: int) -> list:
     if any(AXIS_DATA in (e if isinstance(e, tuple) else (e,))
            for e in spec):  # already data-annotated: nothing to add
         return spec
-    best = -1
-    for d, n in enumerate(shape):
-        if spec[d] is None and n % axis_size == 0:
-            if best < 0 or n > shape[best]:
-                best = d
+    best = fsdp_scatter_dim(
+        tuple(shape), axis_size,
+        taken=tuple(d for d, e in enumerate(spec) if e is not None))
     if best >= 0:
         spec = list(spec)
         spec[best] = AXIS_DATA

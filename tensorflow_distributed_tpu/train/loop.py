@@ -161,8 +161,19 @@ def _build_model_and_state(cfg: TrainConfig, mesh, task):
         size_kw["num_microbatches"] = cfg.pipeline_microbatches
         if cfg.pipeline_virtual_stages > 1:
             size_kw["virtual_stages"] = cfg.pipeline_virtual_stages
+    model_mesh = mesh
+    if cfg.grad_sync != "implicit":
+        # The explicit grad-sync step (parallel/overlap.py) runs the
+        # forward INSIDE a shard_map over the whole mesh, where a
+        # with_sharding_constraint on already-manual axes is an error:
+        # build the model mesh-less (no activation pins, no TP
+        # metadata — config.validate has already pinned the mesh to
+        # pure-data, so both were no-ops anyway).
+        model_mesh = None
+        if cfg.model in ("bert_mlm", "gpt_lm", "moe_lm"):
+            size_kw["tp_partitioning"] = False
     model = build_model(
-        cfg.model, mesh=mesh, dropout_rate=cfg.dropout_rate,
+        cfg.model, mesh=model_mesh, dropout_rate=cfg.dropout_rate,
         init_scheme=cfg.init_scheme,
         compute_dtype=jax.numpy.bfloat16
         if cfg.compute_dtype == "bfloat16" else jax.numpy.float32,
@@ -508,7 +519,22 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
                 params_out_shardings=params_out,
                 skip_nonfinite=(policy is not None
                                 and policy.mode == "skip_batch"),
-                health_every=health_every)
+                health_every=health_every,
+                grad_sync=cfg.grad_sync,
+                state_template=(state if cfg.grad_sync != "implicit"
+                                else None),
+                grad_sync_bucket_bytes=(
+                    int(cfg.grad_sync_bucket_mb * 2 ** 20)
+                    if cfg.grad_sync_bucket_mb else 0))
+            if cfg.grad_sync == "overlap":
+                # Surface the per-step collective-traffic estimate so
+                # the step records can split comm into exposed vs
+                # hidden (observe/hub.py). The step carries the exact
+                # plan its compiled program executes.
+                from tensorflow_distributed_tpu.parallel import overlap
+                plan_b = step_fn.bucket_plan
+                obs.note_grad_sync(overlap.comm_bytes_per_step(plan_b),
+                                   plan_b.describe())
         eval_fn = make_eval_step(mesh, loss=task.eval_loss or task.loss,
                                  batch_shardings=task.batch_shardings)
         # 1F1B-recompute steps advertise their extra executed FLOPs

@@ -39,7 +39,11 @@ def make_multi_step(mesh: Mesh, seed: int = 0, loss: LossFn = loss_fn,
                     batch_shardings: Any = None,
                     preprocess: Optional[Callable[[Any], Any]] = None,
                     accum_steps: int = 1,
-                    health_every: int = 0
+                    health_every: int = 0,
+                    grad_sync: str = "implicit",
+                    state_template: Any = None,
+                    grad_sync_bucket_bytes: int = 0,
+                    grad_sync_min_size: int = 0
                     ) -> Callable[[TrainState, Any],
                                   Tuple[TrainState, Metrics]]:
     """Build ``fn(state, stacked_batches) -> (state, metrics_of_last)``.
@@ -50,11 +54,20 @@ def make_multi_step(mesh: Mesh, seed: int = 0, loss: LossFn = loss_fn,
     per-module health cadence into the inner step (train.step); the
     returned metrics being the LAST scanned step's, a cadence that
     divides K reports the vitals of that dispatch's final step.
+    ``grad_sync`` != "implicit" scans the EXPLICIT collective step
+    (parallel.overlap; needs ``state_template`` like train.step's
+    dispatch) — the bucketed reduce-scatter/all-gather schedule runs
+    inside every scan iteration, so K on-device steps keep the same
+    overlap window a dispatched-per-step loop gets.
     """
     base = make_train_step(mesh, seed=seed, loss=loss,
                            batch_shardings=batch_shardings,
                            accum_steps=accum_steps, jit=False,
-                           health_every=health_every)
+                           health_every=health_every,
+                           grad_sync=grad_sync,
+                           state_template=state_template,
+                           grad_sync_bucket_bytes=grad_sync_bucket_bytes,
+                           grad_sync_min_size=grad_sync_min_size)
 
     def run(state: TrainState, batches: Any) -> Tuple[TrainState, Metrics]:
         def body(s, b):

@@ -36,7 +36,8 @@ from jax.sharding import Mesh
 from tensorflow_distributed_tpu.observe import device as observe_device
 from tensorflow_distributed_tpu.observe import health as observe_health
 from tensorflow_distributed_tpu.ops.losses import accuracy, softmax_cross_entropy
-from tensorflow_distributed_tpu.parallel.sharding import batch_sharding, replicated
+from tensorflow_distributed_tpu.parallel.sharding import (
+    FSDP_MIN_SIZE, batch_sharding, replicated)
 from tensorflow_distributed_tpu.train.state import TrainState, ema_update
 from tensorflow_distributed_tpu.utils import prng
 
@@ -116,16 +117,34 @@ def make_train_step(mesh: Mesh, seed: int = 0, donate: bool = True,
                     ema_decay: float = 0.0,
                     params_out_shardings: Any = None,
                     skip_nonfinite: bool = False,
-                    health_every: int = 0
+                    health_every: int = 0,
+                    grad_sync: str = "implicit",
+                    state_template: Any = None,
+                    grad_sync_bucket_bytes: int = 0,
+                    grad_sync_min_size: int = 0
                     ) -> Callable[[TrainState, Batch],
                                   Tuple[TrainState, Metrics]]:
     """Build the jitted train step for a mesh.
 
-    Gradient synchronization is implicit: params are replicated (or
-    partition-annotated) and the batch is sharded over the data axis, so
-    XLA's SPMD partitioner inserts the psum allreduce in the backward
-    pass — the explicit, inspectable shard_map/psum formulation lives in
-    ``parallel.collectives`` and is proven equivalent in tests.
+    Gradient synchronization is implicit by default: params are
+    replicated (or partition-annotated) and the batch is sharded over
+    the data axis, so XLA's SPMD partitioner inserts the psum allreduce
+    in the backward pass — the explicit, inspectable shard_map/psum
+    formulation lives in ``parallel.collectives`` and is proven
+    equivalent in tests.
+
+    ``grad_sync`` != "implicit" dispatches to the EXPLICIT collective
+    step (parallel.overlap): "overlap" buckets the grad tree,
+    reduce-scatters each bucket over the data axis, applies the ZeRO-1
+    sharded optimizer update per bucket, and all-gathers updated params
+    bucketed so XLA can hide the collectives under backward compute;
+    "serial" is the same skeleton with one monolithic pmean (the A/B
+    baseline). Requires ``state_template`` (the state the loop threads
+    — it pins the slot shardings the sharded update runs against) and
+    a pure-data mesh; ``grad_sync_bucket_bytes``/``grad_sync_min_size``
+    forward the bucket bound and the scatterable-leaf threshold (0 =
+    the overlap module's defaults). ``accum_steps`` must stay 1 — the
+    explicit path has no microbatch scan.
 
     ``accum_steps > 1`` splits the global batch into that many
     microbatches and accumulates their mean gradient in a ``lax.scan``
@@ -165,6 +184,29 @@ def make_train_step(mesh: Mesh, seed: int = 0, donate: bool = True,
     scalars ride the existing metrics pytree; ``health_emit`` flags
     the real fetches). 0 = off (metric dict unchanged).
     """
+
+    if grad_sync != "implicit":
+        if accum_steps != 1:
+            raise ValueError(
+                f"grad_sync={grad_sync!r} has no microbatch scan; "
+                f"accum_steps must be 1, got {accum_steps}")
+        if state_template is None:
+            raise ValueError(
+                f"grad_sync={grad_sync!r} needs state_template (the "
+                f"state the loop threads — it pins the opt-slot "
+                f"shardings the sharded update runs against)")
+        from tensorflow_distributed_tpu.parallel import overlap
+        return overlap.make_explicit_train_step(
+            mesh, state_template, seed=seed, loss=loss,
+            batch_shardings=batch_shardings, grad_sync=grad_sync,
+            bucket_bytes=(grad_sync_bucket_bytes
+                          or overlap.DEFAULT_BUCKET_BYTES),
+            fsdp_min_size=grad_sync_min_size or FSDP_MIN_SIZE,
+            donate=donate, grad_norm_metric=grad_norm_metric,
+            ema_decay=ema_decay,
+            params_out_shardings=params_out_shardings,
+            skip_nonfinite=skip_nonfinite, health_every=health_every,
+            jit=jit)
 
     if batch_shardings is None:
         batch_shardings = default_batch_shardings(mesh)

@@ -601,10 +601,21 @@ def test_census_structure_sane():
     assert set(golden) == {"gpt_train", "moe_train", "pipelined_train",
                            "serve_decode", "gpt_train_health",
                            "moe_train_health",
-                           "pipelined_train_health"}
+                           "pipelined_train_health",
+                           "gpt_train_overlap", "moe_train_overlap"}
     assert golden["pipelined_train"]["collectives"].get("ppermute", 0) > 0
     assert golden["gpt_train"]["collectives"] == {}
     assert golden["serve_decode"]["collectives"] == {}
+    # The overlap grad-sync invariant: an explicit reduce-scatter AND
+    # an explicit all-gather per scatter bucket (counts equal — a
+    # bucket that scatters but never gathers back would train on
+    # stale params), plus >= 1 psum (replicated small leaves + the
+    # metric pmean).
+    for name in ("gpt_train_overlap", "moe_train_overlap"):
+        c = golden[name]["collectives"]
+        assert c.get("reduce_scatter", 0) > 1, name
+        assert c["reduce_scatter"] == c["all_gather"], name
+        assert c.get("psum", 0) >= 1, name
     for prog in golden.values():
         assert prog["upcasts"].get("bfloat16->float32", 0) > 0
     # The device-telemetry invariant the health entries exist to pin:

@@ -43,8 +43,13 @@ def test_enumeration_completeness_stubbed():
     reasons = {p.reason for p in pruned}
     assert any("n_heads" in r for r in reasons)
     # 3 factorizations x 3 partitions = 9 (model=8 pruned, and its
-    # fsdp/zero1 variants pruned as degenerate-at-data-1).
-    assert len(feasible) == 9
+    # fsdp/zero1 variants pruned as degenerate-at-data-1), plus the
+    # overlap strategy on the ONE pure-data shape (tensor-carrying
+    # shapes prune it — the explicit grad-sync needs a pure data
+    # mesh).
+    assert len(feasible) == 10
+    overlaps = [c for c in feasible if c.partition == "overlap"]
+    assert len(overlaps) == 1 and overlaps[0].mesh["data"] == 8
 
 
 def test_enumeration_prunes_all_on_stubbed_constraint():
@@ -54,7 +59,8 @@ def test_enumeration_prunes_all_on_stubbed_constraint():
     assert feasible == []
     assert pruned and all(
         p.reason == "stubbed: no" or "identical to the plain" in p.reason
-        or "n_heads" in p.reason for p in pruned)
+        or "n_heads" in p.reason or "pure data" in p.reason
+        for p in pruned)
 
 
 def test_enumeration_batch_divisibility_via_shared_rule():
