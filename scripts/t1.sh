@@ -174,6 +174,49 @@ if [ "$gradsync_rc" -ne 0 ]; then
        "$GRADSYNCLOG" >&2
 fi
 
+# Servebench smoke (fast-path serving: speculative decoding on the
+# memorized bigram-cycle model, int8 KV slots-at-budget + divergence,
+# SLO-vs-FIFO p95 TTFT under a burst — benchmarks/servebench.py).
+# Skips the base continuous-vs-sequential phase (pinned in
+# tests/test_serve.py and the committed SERVEBENCH.json); gates one
+# SPECULATIVE and one INT8 config token-identity + thresholds, and
+# asserts the artifact's new p95_ttft_under_load / accept_rate fields
+# exist. Same abort-guard shape as the smokes above: a run that dies
+# to the known container XLA:CPU abort prints no serve_checks line
+# and is retried once; a genuine gate failure prints one and is NOT
+# retried.
+SERVELOG="${SERVELOG:-/tmp/_t1_serve.log}"
+run_servebench() {
+  rm -f "$SERVELOG"
+  timeout -k 10 300 env JAX_PLATFORMS=cpu python -m \
+    tensorflow_distributed_tpu.benchmarks.servebench \
+    --phases spec,int8,slo --requests 8 --slo-requests 16 \
+    --spec-new-tokens 48 --out "" 2>&1 | tee "$SERVELOG"
+  return "${PIPESTATUS[0]}"
+}
+run_servebench
+serve_rc=$?
+if ! grep -qa '"metric": "serve_checks"' "$SERVELOG"; then
+  echo "[t1] no serve_checks line in $SERVELOG (known container" \
+       "XLA:CPU abort) — rerunning servebench once" >&2
+  run_servebench
+  serve_rc=$?
+fi
+if [ "$serve_rc" -eq 0 ]; then
+  # The fields the SLO/spec artifact is consumed by (README, observe
+  # report): their absence is a regression even when gates pass.
+  if ! grep -qa '"p95_ttft_under_load"' "$SERVELOG" \
+      || ! grep -qa '"accept_rate"' "$SERVELOG"; then
+    echo "[t1] servebench output is missing p95_ttft_under_load /" \
+         "accept_rate fields" >&2
+    serve_rc=1
+  fi
+fi
+if [ "$serve_rc" -ne 0 ]; then
+  echo "[t1] servebench smoke FAILED (serve_rc=$serve_rc) — see" \
+       "$SERVELOG" >&2
+fi
+
 if [ "$rc" -eq 0 ] && [ "$lint_rc" -ne 0 ]; then
   echo "[t1] suite green but graftcheck red (lint_rc=$lint_rc) — see" \
        "scripts/lint.sh output above" >&2
@@ -190,5 +233,8 @@ if [ "$rc" -eq 0 ] && [ "$plan_rc" -ne 0 ]; then
 fi
 if [ "$rc" -eq 0 ] && [ "$gradsync_rc" -ne 0 ]; then
   exit "$gradsync_rc"
+fi
+if [ "$rc" -eq 0 ] && [ "$serve_rc" -ne 0 ]; then
+  exit "$serve_rc"
 fi
 exit "$rc"

@@ -243,16 +243,17 @@ def _overlap_jaxpr(model_name: str):
     return jax.make_jaxpr(step)(state, _clm_batch())
 
 
-def _serve_decode_jaxpr():
-    """THE decode program serve/engine.py dispatches every step: one
-    greedy token for every slot at its own depth."""
-    from tensorflow_distributed_tpu.models.generate import decode_token
+def _serve_model(kv_cache_quant: str = "none"):
+    """The tiny bf16 causal LM + zeroed slot cache the serve censuses
+    trace against (kv_cache_quant="int8" produces the quantized cache
+    layout — int8 K/V leaves with f32 scale leaves beside them)."""
     from tensorflow_distributed_tpu.models.transformer import (
         CausalLM, tiny_config)
 
     num_slots = 4
     model = CausalLM(tiny_config(causal=True,
-                                 compute_dtype=jnp.bfloat16))
+                                 compute_dtype=jnp.bfloat16,
+                                 kv_cache_quant=kv_cache_quant))
     params = model.init(jax.random.key(0),
                         jnp.zeros((1, 8), jnp.int32))["params"]
     tok = jnp.zeros((num_slots, 1), jnp.int32)
@@ -264,6 +265,18 @@ def _serve_decode_jaxpr():
         params, tok, pos)
     cache = jax.tree_util.tree_map(
         lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+    return model, params, cache, num_slots
+
+
+def _serve_decode_jaxpr(kv_cache_quant: str = "none"):
+    """THE decode program serve/engine.py dispatches every step: one
+    greedy token for every slot at its own depth. The int8 variant
+    (``serve_decode_int8``) pins that KV-cache quantization adds NO
+    collectives and only a bounded number of dtype converts — the
+    quantize-on-write/scale-adjusted-attend math is entirely local."""
+    from tensorflow_distributed_tpu.models.generate import decode_token
+
+    model, params, cache, num_slots = _serve_model(kv_cache_quant)
 
     def run(params, cache, tok, pos):
         # Mirrors serve/engine.py::_compiled_step: greedy token + the
@@ -277,6 +290,35 @@ def _serve_decode_jaxpr():
     return jax.make_jaxpr(run)(params, cache,
                                jnp.zeros((num_slots,), jnp.int32),
                                jnp.zeros((num_slots,), jnp.int32))
+
+
+#: The verify census build: k proposals per slot, matching
+#: serve/engine.py::_compiled_verify's shape discipline (toks
+#: [S, k+1] = pending + proposals; one forward, argmax chain + ok).
+_VERIFY_K = 4
+
+
+def _serve_verify_jaxpr():
+    """THE speculative verify program (serve/engine.py::
+    _compiled_verify): all k proposals scored in one forward over the
+    slot cache. The golden pins that speculation's verify adds ZERO
+    collectives next to serve_decode — it is the same local attend
+    over k + 1 positions."""
+    model, params, cache, num_slots = _serve_model()
+    k = _VERIFY_K
+
+    def run(params, cache, toks, pos):
+        positions = pos[:, None] + jnp.arange(k + 1)[None, :]
+        logits, state = model.apply(
+            {"params": params, "cache": cache}, toks, decode=True,
+            positions=positions, mutable=["cache"])
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        ok = jnp.isfinite(logits).all(axis=(-1, -2))
+        return state["cache"], nxt, ok
+
+    return jax.make_jaxpr(run)(
+        params, cache, jnp.zeros((num_slots, k + 1), jnp.int32),
+        jnp.zeros((num_slots,), jnp.int32))
 
 
 PROGRAMS = {
@@ -297,6 +339,11 @@ PROGRAMS = {
     # count (see _overlap_jaxpr's constants).
     "gpt_train_overlap": lambda: _overlap_jaxpr("gpt_lm"),
     "moe_train_overlap": lambda: _overlap_jaxpr("moe_lm"),
+    # Fast-path serving (speculative verify + int8 KV cache): both pin
+    # ZERO collectives — per-token cost work must stay local — and the
+    # int8 entry bounds the quantize/dequantize convert count.
+    "serve_verify": _serve_verify_jaxpr,
+    "serve_decode_int8": lambda: _serve_decode_jaxpr("int8"),
 }
 
 

@@ -21,6 +21,16 @@ Examples:
     python -m tensorflow_distributed_tpu.cli --mode serve \
         --model gpt_lm --serve.num-slots 8 --serve.num-requests 32
 
+    # fast-path serving (README "Fast-path serving"): speculative
+    # decoding (k-gram self-draft; token-identical by construction),
+    # int8 KV cache (~2x slots per HBM at head dim 64), SLO classes
+    # with per-tenant quotas + preempt-and-requeue
+    python -m tensorflow_distributed_tpu.cli --mode serve \
+        --model gpt_lm --serve.num-slots 4 --serve.num-requests 32 \
+        --serve.spec-tokens 4 --serve.kv-dtype int8 \
+        --serve.policy slo --serve.slo-mix "high:0.25,batch:0.25" \
+        --serve.tenants 4 --serve.tenant-quota 512
+
     # serve under fire (README "Serving under faults"): bursty
     # arrivals, slot-NaN containment + live weight swap drills, a
     # crash-durable request journal, decode watchdog; run under

@@ -202,6 +202,57 @@ class ServeConfig:
     slot_retries: int = 2
     # Print each streamed token as it retires (chief only).
     stream: bool = False
+    # --- speculative decoding (serve/speculate.py) -----------------
+    # Tokens PROPOSED per decode step (0 = off). With speculation on,
+    # each step runs ONE jitted verify program that scores all
+    # spec_tokens proposals against the target model in a single
+    # forward over the slot's KV cache and accepts the longest
+    # greedy-consistent prefix — output stays token-identical to
+    # non-speculative greedy decode; the win is (accepted + 1) tokens
+    # per program dispatch instead of 1.
+    spec_tokens: int = 0
+    # Draft model spec, e.g. "tiny" or "size=tiny,n_layers=1" — a
+    # smaller model of the same transformer family proposing the
+    # spec_tokens. "" = k-gram SELF-draft: proposals come from the
+    # request's own token history (prompt-lookup; no second model, no
+    # extra device work), which is what repetitive greedy tails make
+    # cheap to predict.
+    draft_config: str = ""
+    # Suffix length the k-gram self-draft matches on (history lookups
+    # key on the last this-many tokens).
+    spec_kgram: int = 3
+    # --- KV-cache storage ------------------------------------------
+    # "bf16": cache rows stored in the model's compute dtype (the
+    # default). "int8": per-(token, head) absmax-quantized rows with
+    # f32 scales stored beside the cache (models/transformer.py's
+    # kv_cache_quant path) — roughly halves HBM per slot at real head
+    # dims, so num_slots can grow at a fixed budget; greedy output may
+    # diverge within the pinned servebench tolerance.
+    kv_dtype: str = "bf16"  # bf16 | int8
+    # --- SLO-aware scheduling --------------------------------------
+    # "fifo": arrival-order admission (the original policy). "slo":
+    # class-priority admission (high > standard > batch), per-tenant
+    # token quotas, and preempt-and-requeue of over-budget requests
+    # (the PR-6 continuation machinery: prompt + tokens-so-far
+    # re-admit, journal-compatible, token-identical by greedy
+    # determinism).
+    policy: str = "fifo"  # fifo | slo
+    # Per-tenant decoded-token quota for policy=slo (0 = off): a
+    # tenant at/over its quota is DEFERRED while an under-quota
+    # request waits — requeued behind, never dropped, and still
+    # served when nothing under-quota is waiting (work-conserving).
+    tenant_quota: int = 0
+    # Allow policy=slo to preempt a live lower-class (or over-quota)
+    # request when a higher-class one has waited out the
+    # decode-priority clock with no free slot.
+    preempt: bool = True
+    # Synthetic-workload SLO class mix, e.g. "high:0.25,batch:0.25"
+    # (remainder "standard"); "" = all standard. Request files carry
+    # their own per-request "slo" field instead.
+    slo_mix: str = ""
+    # Synthetic-workload tenant count (requests assigned round-robin);
+    # request files carry their own "tenant" field.
+    tenants: int = 1
 
     def validate(self) -> None:
         if self.num_slots < 1:
@@ -253,6 +304,58 @@ class ServeConfig:
                 "serve.trace shapes the SYNTHETIC workload's "
                 "arrivals; a request file carries its own arrival_s "
                 "— drop one of the flags")
+        if self.spec_tokens < 0:
+            raise ValueError(
+                f"serve.spec_tokens must be >= 0, "
+                f"got {self.spec_tokens}")
+        if self.draft_config and not self.spec_tokens:
+            raise ValueError(
+                "serve.draft_config proposes serve.spec_tokens tokens "
+                "per step; add --serve.spec-tokens > 0")
+        if self.spec_kgram < 1:
+            raise ValueError(
+                f"serve.spec_kgram must be >= 1, "
+                f"got {self.spec_kgram}")
+        if self.kv_dtype not in ("bf16", "int8"):
+            raise ValueError(
+                f"unknown serve.kv_dtype {self.kv_dtype!r}; have "
+                f"('bf16', 'int8')")
+        if self.policy not in ("fifo", "slo"):
+            raise ValueError(
+                f"unknown serve.policy {self.policy!r}; have "
+                f"('fifo', 'slo')")
+        if self.tenant_quota < 0:
+            raise ValueError(
+                f"serve.tenant_quota must be >= 0, "
+                f"got {self.tenant_quota}")
+        if self.tenant_quota and self.policy != "slo":
+            raise ValueError(
+                "serve.tenant_quota is enforced by the SLO scheduler; "
+                "add --serve.policy slo")
+        if (self.tenant_quota and not self.requests
+                and self.tenants <= 1):
+            raise ValueError(
+                "serve.tenant_quota needs tenants to meter: the "
+                "synthetic workload assigns tenants only when "
+                "--serve.tenants > 1 (request files carry their own "
+                "per-request tenant fields) — without them the quota "
+                "silently never fires")
+        if self.slo_mix:
+            if self.policy != "slo":
+                raise ValueError(
+                    "serve.slo_mix assigns classes the SLO scheduler "
+                    "acts on; add --serve.policy slo")
+            if self.requests:
+                raise ValueError(
+                    "serve.slo_mix shapes the SYNTHETIC workload; a "
+                    "request file carries its own per-request slo "
+                    "field — drop one of the flags")
+            from tensorflow_distributed_tpu.serve.scheduler import (
+                parse_slo_mix)
+            parse_slo_mix(self.slo_mix)  # syntax at config time
+        if self.tenants < 1:
+            raise ValueError(
+                f"serve.tenants must be >= 1, got {self.tenants}")
 
 
 @dataclasses.dataclass
@@ -722,11 +825,13 @@ class TrainConfig:
             return ("grad_sync != implicit does not compose with "
                     "param_sync_every > 1 (local SGD has its own sync "
                     "protocol)")
-        if self.grad_clip_norm:
-            return ("grad_clip_norm is not yet composed with the "
-                    "explicit grad-sync step (clip-by-global-norm "
-                    "inside the sharded update needs its own psum'd "
-                    "norm); drop one of the flags")
+        # grad_clip_norm COMPOSES: both explicit modes clip by the
+        # SAME psum-reconstructed global norm (block sums-of-squares,
+        # one scalar psum) before the elementwise update — the optax
+        # chain clip is omitted for explicit runs (train/optim.py),
+        # since inside the shard_map tx sees grad BLOCKS and a chain
+        # clip would use each device's local norm. Serial+clip vs
+        # overlap+clip bit-identity is pinned in tests/test_overlap.py.
         if self.ce_chunk:
             return ("ce_chunk's fused loss applies its own sharding "
                     "constraints, which cannot run inside the explicit "

@@ -93,13 +93,33 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                 if isinstance(r.get("tok_ms"), (int, float))]
         if toks:
             out["serve_tok_ms_mean"] = round(_mean(toks), 4)
+        # Per-SLO-class TTFT p95 (serve/scheduler.py policy="slo"
+        # tags every serve_request with its class): the split the SLO
+        # scheduler exists to move — only emitted when a non-default
+        # class actually appears, so plain FIFO reports are unchanged.
+        by_class: Dict[str, List[float]] = {}
+        for r in serve_reqs:
+            if isinstance(r.get("ttft_ms"), (int, float)):
+                by_class.setdefault(str(r.get("slo", "standard")),
+                                    []).append(float(r["ttft_ms"]))
+        if len(by_class) > 1 or set(by_class) - {"standard"}:
+            for cls, vals in sorted(by_class.items()):
+                out[f"serve_ttft_ms_p95_{cls}"] = round(
+                    _percentile(sorted(vals), 95), 3)
     if serve_sums:
         final = serve_sums[-1]
         for key in ("tokens_per_sec", "mean_slot_occupancy",
                     "total_new_tokens", "prefill_compiles", "retries",
-                    "swaps", "swap_seconds", "seed", "trace"):
+                    "swaps", "swap_seconds", "seed", "trace",
+                    "policy", "preemptions", "spec_tokens",
+                    "verify_steps", "accept_rate"):
             if key in final:
                 out[f"serve_{key}"] = final[key]
+    # SLO preempt-and-requeue events (policy, not failure — reported
+    # apart from the Recovery section).
+    preempts = [r for r in records if r.get("event") == "preempt"]
+    if preempts:
+        out["serve_preempt_events"] = len(preempts)
     if steps:
         out["last_step"] = max(int(r.get("step", 0)) for r in steps)
         # The freshest rolling-window stats (each step record carries
@@ -259,7 +279,10 @@ def render(summary: Dict[str, Any]) -> str:
              "serve_tok_ms_mean", "serve_tokens_per_sec",
              "serve_mean_slot_occupancy", "serve_total_new_tokens",
              "serve_prefill_compiles", "serve_retries", "serve_swaps",
-             "serve_swap_seconds", "serve_seed", "serve_trace")
+             "serve_swap_seconds", "serve_policy", "serve_preemptions",
+             "serve_preempt_events", "serve_spec_tokens",
+             "serve_verify_steps", "serve_accept_rate", "serve_seed",
+             "serve_trace")
     # plan/programs/health/recovery render as their own sections
     # below; peak_hbm_bytes_sum renders as the Programs TOTAL row.
     sections = ("plan", "programs", "health", "peak_hbm_bytes_sum",

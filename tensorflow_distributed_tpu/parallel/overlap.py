@@ -359,6 +359,35 @@ def _global_grad_norm(shard_grads: Any, plan: BucketPlan) -> jax.Array:
     return jnp.sqrt(jax.lax.psum(sc, AXIS_DATA) + rep)
 
 
+def _clip_tree(tree: Any, g_norm: jax.Array, max_norm: float) -> Any:
+    """Clip-by-global-norm with a CALLER-supplied norm — optax's exact
+    elementwise semantics (`lax.select` on `g_norm < max_norm`, scale
+    by `max_norm / g_norm` otherwise), detached from optax's own
+    `global_norm` so both explicit grad-sync modes can feed the SAME
+    psum-reconstructed scalar:
+
+    - overlap: the norm comes from the scattered blocks
+      (:func:`_global_grad_norm` — psum of block sums-of-squares);
+    - serial: the norm comes from the SAME formulation applied to the
+      pmean'd full tree's local block slices (``_shard_params``), so
+      the scalar — and therefore the clipped update — is bit-identical
+      to overlap's, which is what lets the serial-vs-overlap identity
+      gate keep running under clip (tests/test_overlap.py).
+
+    The chain clip in train/optim.py is correspondingly OMITTED for
+    explicit grad-sync runs: inside the shard_map tx sees grad BLOCKS,
+    and a chain clip would use each device's local norm."""
+    trigger = g_norm < max_norm
+
+    def clip_leaf(t):
+        return jax.lax.select(
+            jnp.broadcast_to(trigger, t.shape), t,
+            (t / g_norm.astype(t.dtype)) * jnp.asarray(
+                max_norm, t.dtype))
+
+    return jax.tree_util.tree_map(clip_leaf, tree)
+
+
 def _sharded_health(params: Any, shard_grads: Any, shard_updates: Any,
                     plan: BucketPlan, step: jax.Array,
                     health_every: int) -> dict:
@@ -411,6 +440,7 @@ def make_explicit_train_step(mesh: Mesh, state_template: TrainState,
                              params_out_shardings: Any = None,
                              skip_nonfinite: bool = False,
                              health_every: int = 0,
+                             grad_clip_norm: float = 0.0,
                              jit: bool = True
                              ) -> Callable[[TrainState, Batch],
                                            Tuple[TrainState, Metrics]]:
@@ -442,6 +472,13 @@ def make_explicit_train_step(mesh: Mesh, state_template: TrainState,
     on the full param view and the slot blocks, EMA tracks the
     gathered params, health reads the sharded grads/updates through
     psum-reconstructed full-tree norms.
+
+    ``grad_clip_norm`` > 0 clips by the TRUE global norm before the
+    elementwise update, reconstructed from block sums-of-squares with
+    one scalar psum — the identical formulation in both modes, so
+    serial+clip and overlap+clip stay bit-equal (see
+    :func:`_clip_tree`; the optax chain clip is omitted for explicit
+    grad-sync runs by train/optim.py — pass the UNCLIPPED tx here).
     """
     if grad_sync not in GRAD_SYNC_MODES:
         raise ValueError(f"unknown grad_sync {grad_sync!r}; have "
@@ -488,7 +525,7 @@ def make_explicit_train_step(mesh: Mesh, state_template: TrainState,
             shard_grads = _sync_overlap(grads, plan)
             shard_params = _shard_params(state.params, plan)
             norm = None
-            if grad_norm_metric or skip_nonfinite:
+            if grad_clip_norm or grad_norm_metric or skip_nonfinite:
                 norm = _global_grad_norm(shard_grads, plan)
             if grad_norm_metric:
                 metrics = dict(metrics, grad_norm=norm)
@@ -497,6 +534,14 @@ def make_explicit_train_step(mesh: Mesh, state_template: TrainState,
                 ok = jnp.isfinite(metrics["loss"]) & jnp.isfinite(norm)
                 metrics = dict(metrics,
                                skipped_nonfinite=jnp.where(ok, 0.0, 1.0))
+            if grad_clip_norm:
+                # Clip by the psum-reconstructed TRUE global norm
+                # before the elementwise update (the chain clip is
+                # omitted for explicit grad-sync — train/optim.py).
+                # Pre-clip norm feeds the metric and the skip flag,
+                # matching the implicit step's semantics.
+                shard_grads = _clip_tree(shard_grads, norm,
+                                         grad_clip_norm)
             # The ZeRO-1 sharded update: slots arrive as blocks (their
             # persisted sharding IS the in_spec), params as local
             # slices, grads as scattered blocks. Elementwise optimizer
@@ -519,15 +564,31 @@ def make_explicit_train_step(mesh: Mesh, state_template: TrainState,
                 # mean-allreduce, then every device repeats the full
                 # update.
                 grads = jax.lax.pmean(grads, AXIS_DATA)
+            norm = None
+            if grad_clip_norm:
+                # The SAME block-partitioned reconstruction overlap
+                # uses (this device's local slices of the full tree →
+                # block sums-of-squares → one psum), NOT
+                # optax.global_norm: the scalar is bit-identical to
+                # the overlap path's, so clipped serial and clipped
+                # overlap stay bit-equal — the identity gate's
+                # requirement.
+                norm = _global_grad_norm(_shard_params(grads, plan),
+                                         plan)
             if grad_norm_metric:
                 metrics = dict(metrics,
-                               grad_norm=optax.global_norm(grads))
+                               grad_norm=(norm if norm is not None
+                                          else optax.global_norm(grads)))
             ok = None
             if skip_nonfinite:
+                skip_norm = (norm if norm is not None
+                             else optax.global_norm(grads))
                 ok = (jnp.isfinite(metrics["loss"])
-                      & jnp.isfinite(optax.global_norm(grads)))
+                      & jnp.isfinite(skip_norm))
                 metrics = dict(metrics,
                                skipped_nonfinite=jnp.where(ok, 0.0, 1.0))
+            if grad_clip_norm:
+                grads = _clip_tree(grads, norm, grad_clip_norm)
             updates, new_opt = state.tx.update(
                 grads, state.opt_state, state.params)
             if health_every:

@@ -73,6 +73,34 @@ def _compiled_prefill(model, bucket: int):
 
 
 @functools.lru_cache(maxsize=8)
+def _compiled_verify(model, k: int):
+    """THE speculative verify program: feed each slot's pending token
+    plus its ``k`` proposals in ONE forward at positions
+    ``pos .. pos + k`` (the decode cache path already writes per-row
+    contiguous spans), take the greedy argmax at every fed position,
+    and flag per-slot finiteness like the decode step. The host
+    compares proposals against the argmax chain (speculate.
+    accept_length) — everything emitted is the TARGET model's own
+    greedy token, so speculation cannot change output, only how many
+    tokens one dispatch yields. Fixed shapes per (model, k): one
+    executable for the engine's lifetime, censused as ``serve_verify``
+    in the jaxpr goldens."""
+
+    @jax.jit
+    def run(params, cache, toks, pos):
+        # toks [S, k+1] (pending token + proposals), pos [S].
+        positions = pos[:, None] + jnp.arange(k + 1)[None, :]
+        logits, state = model.apply(
+            {"params": params, "cache": cache}, toks, decode=True,
+            positions=positions, mutable=["cache"])
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S, k+1]
+        ok = jnp.isfinite(logits).all(axis=(-1, -2))
+        return state["cache"], nxt, ok
+
+    return observe_device.instrument(f"serve_verify_k{k}", run)
+
+
+@functools.lru_cache(maxsize=8)
 def _compiled_step(model):
     """THE decode program: one greedy token for every slot at its own
     depth, plus a per-slot ``ok`` flag — logits fully finite. The flag
@@ -132,21 +160,42 @@ def _poison_row_jit(cache, slot):
     return jax.tree_util.tree_map(bad, cache)
 
 
+def zero_cache(model, params, num_slots: int):
+    """A zeroed [num_slots, max_len, ...] decode-cache pytree for
+    ``model``, shaped via eval_shape (no device work, no params
+    flops). Shared by the engine and the draft speculator's mirrored
+    cache (serve/speculate.py); int8 quantized caches come back with
+    their scale leaves included."""
+    tok = jnp.zeros((num_slots, 1), jnp.int32)
+    pos = jnp.zeros((num_slots, 1), jnp.int32)
+    shapes = jax.eval_shape(
+        lambda p, t, q: model.apply(
+            {"params": p}, t, decode=True, positions=q,
+            mutable=["cache"])[1]["cache"],
+        params, tok, pos)
+    return jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+
 class SlotDecodeEngine:
-    """The slot cache + the three programs (prefill/insert/step),
-    with host-side slot bookkeeping. The scheduler (serve/scheduler.py)
-    decides WHEN to prefill vs decode; this class owns WHAT runs on
-    device."""
+    """The slot cache + the programs (prefill/insert/step, plus the
+    speculative verify when ``spec_tokens > 0``), with host-side slot
+    bookkeeping. The scheduler (serve/scheduler.py) decides WHEN to
+    prefill vs decode; this class owns WHAT runs on device."""
 
     def __init__(self, model, params, num_slots: int,
                  buckets: Optional[Sequence[int]] = None,
                  min_bucket: int = 16, check: bool = False,
-                 fault_plan=None, watchdog=None):
+                 fault_plan=None, watchdog=None, spec_tokens: int = 0):
         cfg = model.cfg
         if not cfg.causal:
             raise ValueError("SlotDecodeEngine needs a causal model")
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        if spec_tokens < 0:
+            raise ValueError(
+                f"spec_tokens must be >= 0, got {spec_tokens}")
+        self.spec_tokens = spec_tokens
         self.model = model
         self.params = params
         self.num_slots = num_slots
@@ -180,6 +229,10 @@ class SlotDecodeEngine:
         self._watchdog = watchdog
         self._last_ok: Optional[np.ndarray] = None
         self._step_fn = lookup_program(_compiled_step, self.model)
+        self._verify_fn = (lookup_program(_compiled_verify, self.model,
+                                          spec_tokens)
+                           if spec_tokens else None)
+        self.verify_steps = 0
         # --check (graftcheck's runtime layer): the decode step runs
         # under jax.transfer_guard("disallow"), and the cache layout
         # after the first step is asserted against the layout the
@@ -189,17 +242,19 @@ class SlotDecodeEngine:
                                 if check else None)
 
     def _zero_cache(self):
-        """A zeroed [num_slots, max_len, ...] cache pytree, shaped via
-        eval_shape (no device work, no params flops)."""
-        tok = jnp.zeros((self.num_slots, 1), jnp.int32)
-        pos = jnp.zeros((self.num_slots, 1), jnp.int32)
-        shapes = jax.eval_shape(
-            lambda p, t, q: self.model.apply(
-                {"params": p}, t, decode=True, positions=q,
-                mutable=["cache"])[1]["cache"],
-            self.params, tok, pos)
-        return jax.tree_util.tree_map(
-            lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+        return zero_cache(self.model, self.params, self.num_slots)
+
+    def cache_bytes_per_slot(self) -> int:
+        """HBM the decode cache spends per slot (scale leaves of an
+        int8 cache included) — the number the "choosing num_slots
+        under an HBM budget" math divides by (README "Serving";
+        servebench's int8 slots-at-budget gate)."""
+        total = sum(
+            int(np.prod(c.shape)) * c.dtype.itemsize
+            for c in jax.tree_util.tree_leaves(self.cache)
+            if getattr(c, "ndim", 0)
+            and c.shape[:1] == (self.num_slots,))
+        return total // self.num_slots
 
     @property
     def prefill_compiles(self) -> int:
@@ -226,6 +281,12 @@ class SlotDecodeEngine:
         out = self._step_fn(self.params, self.cache,
                             jnp.asarray(self.tok),
                             jnp.asarray(self.pos))
+        if self._verify_fn is not None:
+            out = self._verify_fn(
+                self.params, out[0],
+                jnp.zeros((self.num_slots, self.spec_tokens + 1),
+                          jnp.int32),
+                jnp.zeros((self.num_slots,), jnp.int32))
         # graftcheck: disable=host-sync-in-loop -- startup-only drain
         # of the warmup dispatches; runs once per process, never in
         # the decode loop
@@ -239,9 +300,90 @@ class SlotDecodeEngine:
         return float(self.active.sum()) / self.num_slots
 
     def fits(self, prompt_len: int, max_new_tokens: int) -> bool:
-        """Would this request's full trajectory fit the cache?"""
+        """Would this request's full trajectory fit the cache?
+        Deliberately WITHOUT speculative slack: a tightly-sized cache
+        still serves every request — ``can_verify()`` makes the
+        scheduler fall back to the plain decode step for the
+        iterations where a slot lacks verify write headroom
+        (serve/run.py sizes the default cache with ``spec_tokens`` of
+        slack so that fallback stays rare)."""
         return (prompt_len <= max(self.buckets)
                 and prompt_len + max_new_tokens <= self.max_len)
+
+    def can_verify(self) -> bool:
+        """Every active slot has verify write headroom (a continuation
+        resumed onto a tightly-sized cache may not — the scheduler
+        falls back to the plain decode step for those iterations)."""
+        if self._verify_fn is None:
+            return False
+        act = self.active
+        return bool((self.pos[act] + self.spec_tokens + 1
+                     <= self.max_len).all())
+
+    def verify_step(self, props: np.ndarray
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+        """One SPECULATIVE decode step: verify ``props``
+        [num_slots, spec_tokens] draft proposals for every slot in one
+        program dispatch. Returns ``(toks, acc)`` — ``toks``
+        [num_slots, spec_tokens + 1] is the target model's greedy
+        chain at each fed position and ``acc[s]`` how many of its
+        leading entries slot ``s`` emits this step (accepted proposals
+        + the bonus token); inactive rows are garbage the scheduler
+        never reads. Rollback-on-reject is pure position bookkeeping:
+        a rejected proposal's cache row sits PAST the slot's new
+        authoritative position, and the next verify (or insert) writes
+        over it before any attend can reach it — positions, not the
+        cache, are the source of truth on depth."""
+        from tensorflow_distributed_tpu.serve.speculate import (
+            accept_length)
+        if self._verify_fn is None:
+            raise RuntimeError(
+                "verify_step needs the engine built with "
+                "spec_tokens > 0")
+        k = self.spec_tokens
+        # graftcheck: disable=host-sync-in-loop -- normalizes the HOST
+        # proposal array the speculator handed in; no device value
+        props = np.asarray(props, np.int32).reshape(self.num_slots, k)
+        if (self.pos[self.active] + k + 1 > self.max_len).any():
+            raise RuntimeError(
+                "an active slot lacks verify headroom — can_verify() "
+                "is the guard (the scheduler falls back to step())")
+        toks_in = np.concatenate([self.tok[:, None], props], axis=1)
+        tok, pos = jnp.asarray(toks_in), jnp.asarray(self.pos)
+        with graftcheck.transfer_guard(self._check):
+            self.cache, nxt, ok = self._verify_fn(
+                self.params, self.cache, tok, pos)
+        step_no = self.decode_steps + 1
+
+        def fetch():
+            if self._plan:
+                self._plan.decode_stall_sleep(step_no)
+            # graftcheck: disable=host-sync-in-loop -- the engine's
+            # OUTPUT, same contract as step(): ONE fetch per dispatch
+            # (the [S, k+1] chain + per-slot ok flags) drives
+            # acceptance, streaming, and NaN containment
+            return jax.device_get((nxt, ok))
+
+        if (self._watchdog is not None
+                and self._watchdog.sync_timeout_s > 0):
+            nxt, ok = self._watchdog.decode(fetch, step_no)
+        else:
+            nxt, ok = fetch()
+        self._last_ok = ok
+        acc = np.zeros((self.num_slots,), np.int32)
+        for s in range(self.num_slots):
+            if not self.active[s]:
+                continue
+            a = accept_length(props[s], nxt[s])
+            acc[s] = a + 1                       # + the bonus token
+            self.tok[s] = nxt[s, a]
+            self.pos[s] += a + 1
+        self.decode_steps += 1
+        self.verify_steps += 1
+        # graftcheck: disable=host-sync-in-loop -- nxt is already the
+        # fetched HOST array (the one watched fetch above); this is a
+        # view, not a second sync
+        return np.asarray(nxt), acc
 
     def prefill(self, prompt: np.ndarray, slot: int) -> int:
         """Admit a request into ``slot``: bucketed prefill, row insert,

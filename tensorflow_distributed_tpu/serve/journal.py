@@ -46,11 +46,22 @@ class RequestJournal:
         self._f.write(json.dumps(rec) + "\n")
 
     def admit(self, rid: int, prompt, max_new_tokens: int,
-              eos_id: int) -> None:
-        self._line({"e": "admit", "rid": int(rid),
-                    "prompt": [int(t) for t in np.asarray(prompt)],
-                    "max_new": int(max_new_tokens),
-                    "eos": int(eos_id)})
+              eos_id: int, slo: str = "standard",
+              tenant: str = "") -> None:
+        """``slo``/``tenant`` make the journal self-describing for the
+        SLO scheduler (policy="slo"): replay re-derives requests from
+        the run seed, so they are informational for the resume path —
+        but a journal read standalone (firebench workload re-derivation,
+        debugging) keeps the class/tenant story."""
+        rec = {"e": "admit", "rid": int(rid),
+               "prompt": [int(t) for t in np.asarray(prompt)],
+               "max_new": int(max_new_tokens),
+               "eos": int(eos_id)}
+        if slo != "standard":
+            rec["slo"] = slo
+        if tenant:
+            rec["tenant"] = tenant
+        self._line(rec)
 
     def token(self, rid: int, tok: int, t_s: float) -> None:
         """One retired token (``t_s`` = run-relative seconds, so a

@@ -120,6 +120,13 @@ def _workload(cfg: TrainConfig, vocab_size: int,
                 # vocab in serve_run (like generate_only): with
                 # synthetic_vocab unset the family default (e.g.
                 # 50257 for gpt_lm small) is the real bound.
+                slo = str(obj.get("slo", "standard"))
+                from tensorflow_distributed_tpu.serve.scheduler import (
+                    SLO_CLASSES)
+                if slo not in SLO_CLASSES:
+                    raise ValueError(
+                        f"{serve.requests}:{i + 1}: unknown slo "
+                        f"{slo!r}; have {SLO_CLASSES}")
                 # graftcheck: disable=host-sync-in-loop -- request-file
                 # parsing runs once, before the engine exists; this
                 # materializes host JSON, not device buffers
@@ -128,14 +135,16 @@ def _workload(cfg: TrainConfig, vocab_size: int,
                     max_new_tokens=int(obj.get("max_new_tokens",
                                                serve.max_new_tokens)),
                     eos_id=int(obj.get("eos_id", serve.eos_id)),
-                    arrival_s=float(obj.get("arrival_s", 0.0))))
+                    arrival_s=float(obj.get("arrival_s", 0.0)),
+                    slo=slo, tenant=str(obj.get("tenant", ""))))
         if not reqs:
             raise ValueError(f"{serve.requests} names no requests")
         return reqs
     # Synthetic open-loop workload: mixed lengths, deterministic by
     # seed, arrivals shaped by the trace (prompt draws happen BEFORE
     # the arrival draws so the token content is identical across
-    # traces — a trace A/B compares arrival shape, nothing else).
+    # traces — a trace A/B compares arrival shape, nothing else; the
+    # class draws come after BOTH for the same reason).
     rng = np.random.default_rng(cfg.seed)
     prompts = []
     for _ in range(serve.num_requests):
@@ -144,9 +153,23 @@ def _workload(cfg: TrainConfig, vocab_size: int,
         prompts.append(
             rng.integers(0, vocab_size, size=plen).astype(np.int32))
     arrivals = _arrivals(serve, serve.num_requests, rng)
+    slos = ["standard"] * serve.num_requests
+    if serve.slo_mix:
+        from tensorflow_distributed_tpu.serve.scheduler import (
+            SLO_CLASSES, parse_slo_mix)
+        mix = parse_slo_mix(serve.slo_mix)
+        edges = np.cumsum([mix.get(c, 0.0) for c in SLO_CLASSES])
+        draws = rng.random(serve.num_requests)
+        slos = [SLO_CLASSES[int(np.searchsorted(edges, d,
+                                                side="right").clip(
+                                                    0, len(edges) - 1))]
+                for d in draws]
     return [Request(rid=i, prompt=p,
                     max_new_tokens=serve.max_new_tokens,
-                    eos_id=serve.eos_id, arrival_s=float(a))
+                    eos_id=serve.eos_id, arrival_s=float(a),
+                    slo=slos[i],
+                    tenant=(f"t{i % serve.tenants}"
+                            if serve.tenants > 1 else ""))
             for i, (p, a) in enumerate(zip(prompts, arrivals))]
 
 
@@ -212,6 +235,15 @@ def serve_run(cfg: TrainConfig) -> Dict:
         # bind(start_step) — a resumed leg must terminate).
         plan.bind(1 << 30)
 
+    # int8 KV-cache serving: --serve.kv-dtype is the serve-side
+    # spelling of the model-level kv_cache_quant knob (the decode
+    # cache quantizes on write, dequantizes inside attention via
+    # exact scale-adjusted dots — models/transformer.py). An explicit
+    # --kv-cache-quant int8 means the same thing and passes through.
+    if (cfg.serve.kv_dtype == "int8"
+            and cfg.kv_cache_quant == "none"):
+        cfg = dataclasses.replace(cfg, kv_cache_quant="int8")
+
     max_prompt = max(len(r.prompt) for r in requests)
     # Per-request trajectory bound (what actually has to fit the
     # cache); bucket padding is prefill-only slack and is clamped to
@@ -225,13 +257,19 @@ def serve_run(cfg: TrainConfig) -> Dict:
     if not cfg.seq_len:
         # Size the cache to the workload (fresh-init serving). A
         # checkpointed model's max_len is pinned by training — set
-        # --seq-len to the trained length explicitly.
-        cfg = dataclasses.replace(cfg, seq_len=max(need, 32))
-    # With a fault plan armed (or a resumed journal), slot-retry /
-    # replay continuations can carry prompts up to prompt+new-1
-    # tokens — size the default ladder to the full trajectory so a
-    # re-prefill never outgrows the largest bucket.
-    cover = need if (plan or resumed_journal) else max_prompt
+        # --seq-len to the trained length explicitly. Speculation gets
+        # spec_tokens of verify write headroom past the last useful
+        # position (a user-pinned tight seq_len instead falls back to
+        # plain decode near each request's end — engine.can_verify).
+        cfg = dataclasses.replace(
+            cfg, seq_len=max(need + cfg.serve.spec_tokens, 32))
+    # With a fault plan armed (or a resumed journal, or the SLO
+    # scheduler's preemption), slot-retry / replay / preemption
+    # continuations can carry prompts up to prompt+new-1 tokens —
+    # size the default ladder to the full trajectory so a re-prefill
+    # never outgrows the largest bucket.
+    cover = (need if (plan or resumed_journal
+                      or cfg.serve.policy == "slo") else max_prompt)
     buckets = (parse_buckets(cfg.serve.buckets) if cfg.serve.buckets
                else default_buckets(cover, cap=cfg.seq_len))
 
@@ -295,7 +333,14 @@ def serve_run(cfg: TrainConfig) -> Dict:
     engine = SlotDecodeEngine(model, params, cfg.serve.num_slots,
                               buckets=buckets, check=cfg.check,
                               fault_plan=plan if plan else None,
-                              watchdog=watchdog)
+                              watchdog=watchdog,
+                              spec_tokens=cfg.serve.spec_tokens)
+    # Speculative decoding: the proposer (k-gram self-draft, or a
+    # draft model mirroring the slot cache — serve/speculate.py).
+    from tensorflow_distributed_tpu.serve.speculate import (
+        build_speculator)
+    speculator = build_speculator(cfg, model, cfg.seed + 1,
+                                  cfg.serve.num_slots, buckets)
     # Every program dispatches once BEFORE the scheduler's clock
     # starts: first-request TTFT (and, on a supervised restart, the
     # recovery window) pays compute, not compile/cache-load.
@@ -318,6 +363,10 @@ def serve_run(cfg: TrainConfig) -> Dict:
                       fault_plan=plan if plan else None,
                       journal=journal, reload_fn=reload_fn,
                       slot_retries=cfg.serve.slot_retries,
+                      policy=cfg.serve.policy,
+                      tenant_quota=cfg.serve.tenant_quota,
+                      preempt=cfg.serve.preempt,
+                      speculator=speculator,
                       summary_extra={"seed": cfg.seed,
                                      "trace": trace_name,
                                      "resumed": resumed_journal})
@@ -344,6 +393,18 @@ def serve_run(cfg: TrainConfig) -> Dict:
     summary["ttft_ms_p99"] = round(1e3 * float(np.percentile(ttfts, 99)), 3)
     summary["tok_ms_mean"] = round(
         float(np.mean([c.tok_ms for c in done])), 4)
+    # Per-SLO-class TTFT p95: the number the SLO scheduler exists to
+    # move (servebench's p95_ttft_under_load gate reads the high
+    # class). Emitted per class actually present, FIFO runs included —
+    # a FIFO baseline with the same class mix is the A/B.
+    by_class: Dict[str, list] = {}
+    for c in done:
+        by_class.setdefault(c.slo, []).append(c.ttft_s)
+    for cls, vals in sorted(by_class.items()):
+        # graftcheck: disable=host-sync-in-loop -- post-run summary
+        # math over HOST completion floats; the engine is done
+        summary[f"ttft_ms_p95_{cls}"] = round(
+            1e3 * float(np.percentile(np.asarray(vals), 95)), 3)
     summary["params"] = "checkpoint" if restored else "fresh-init"
     if is_chief():
         print(f"[serve] {summary['requests']} requests, "
@@ -356,6 +417,18 @@ def serve_run(cfg: TrainConfig) -> Dict:
               f"{summary['prefill_compiles']} prefill programs "
               f"(buckets {summary['buckets']}), "
               f"{summary['params']} params", flush=True)
+        if cfg.serve.spec_tokens:
+            print(f"[serve] speculative: k={summary.get('spec_tokens')} "
+                  f"accept_rate={summary.get('accept_rate')} "
+                  f"verify_steps={summary.get('verify_steps')}",
+                  flush=True)
+        if cfg.serve.policy == "slo":
+            cls_bits = " ".join(
+                f"{k.rsplit('_', 1)[-1]}={summary[k]}ms"
+                for k in sorted(summary)
+                if k.startswith("ttft_ms_p95_"))
+            print(f"[serve] slo: preemptions={summary['preemptions']} "
+                  f"p95 ttft by class: {cls_bits}", flush=True)
         if plan or resumed_journal:
             print(f"[serve] fire: retries={summary['retries']} "
                   f"swaps={summary['swaps']} "

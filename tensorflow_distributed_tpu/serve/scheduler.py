@@ -1,4 +1,4 @@
-"""FIFO admission + prefill/decode interleaving for the slot engine.
+"""Admission + prefill/decode interleaving for the slot engine.
 
 Policy: **decode-priority with a starvation bound**. Decoding a full
 batch is the throughput-optimal steady state, so the scheduler keeps
@@ -8,12 +8,41 @@ starvation clock only ticks while BOTH hold: someone is waiting and a
 slot is free — capacity waits don't count against the policy). An
 idle engine admits immediately.
 
+Admission order is the **policy** knob:
+
+- ``fifo`` (default): arrival order, the original behavior.
+- ``slo``: SLO classes (``high`` > ``standard`` > ``batch``) pick the
+  admitted request — a high-class arrival never queues behind a
+  lower class while a slot frees (pinned in tests/test_serve_slo.py).
+  Two more levers ride the class order:
+
+  * **per-tenant token quotas** (``tenant_quota``): a tenant at/over
+    its decoded-token quota is DEFERRED while any under-quota request
+    waits — requeued behind, never dropped, and still served when
+    nothing under-quota is waiting (work-conserving, so exhaustion
+    cannot starve).
+  * **preempt-and-requeue** (``preempt``): when a higher-class
+    request has waited out the decode-priority clock with no free
+    slot, the worst live lower-class (or over-quota) request is
+    preempted — freed and re-queued as a CONTINUATION (prompt +
+    tokens-so-far, remaining budget; the PR-6 machinery, so it is
+    journal-compatible) — and greedy determinism makes its final
+    stream token-identical to the unpreempted run.
+
+**Speculative decoding** (``speculator`` + an engine built with
+``spec_tokens > 0``): each decode iteration proposes k tokens per
+slot (serve/speculate.py) and retires ``accepted + 1`` of them from
+ONE verify dispatch — token-identical to plain greedy, with
+accepted-length telemetry in the summary (``accept_rate``). Falls
+back to the plain step whenever a slot lacks verify headroom.
+
 Termination is per request (EOS or its max-token budget), tokens
 stream to the host as they retire (``on_token``), and every request's
 lifecycle lands in the observe registry: ``serve_request`` records
-(TTFT, per-token latency, queue steps) plus one final
-``serve_summary`` (aggregate tokens/s, mean slot occupancy) —
-summarized by ``observe.report`` next to the training numbers.
+(TTFT, per-token latency, queue steps, class/tenant) plus one final
+``serve_summary`` (aggregate tokens/s, mean slot occupancy, accept
+rate, preemptions) — summarized by ``observe.report`` next to the
+training numbers.
 
 Serve-under-fire (all optional; zero cost unconfigured):
 
@@ -44,11 +73,49 @@ from __future__ import annotations
 import collections
 import dataclasses
 import time
-from typing import Callable, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
 from tensorflow_distributed_tpu.serve.engine import SlotDecodeEngine
+
+#: SLO classes, best first — admission under policy="slo" prefers the
+#: lowest rank; everything else (request files without a class, the
+#: synthetic default) is "standard".
+SLO_CLASSES = ("high", "standard", "batch")
+_RANK = {c: i for i, c in enumerate(SLO_CLASSES)}
+
+
+def parse_slo_mix(spec: str) -> Dict[str, float]:
+    """``--serve.slo-mix`` grammar: ``"high:0.25,batch:0.25"`` —
+    class:fraction pairs, remainder implicitly "standard". Returns the
+    full {class: fraction} map (standard filled in)."""
+    out: Dict[str, float] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" not in part:
+            raise ValueError(
+                f"slo_mix entry {part!r} is not class:fraction")
+        name, frac = (x.strip() for x in part.split(":", 1))
+        if name not in SLO_CLASSES:
+            raise ValueError(
+                f"unknown SLO class {name!r}; have {SLO_CLASSES}")
+        f = float(frac)
+        if not 0.0 <= f <= 1.0:
+            raise ValueError(
+                f"slo_mix fraction for {name!r} must be in [0, 1], "
+                f"got {f}")
+        if name in out:
+            raise ValueError(f"slo_mix names {name!r} twice")
+        out[name] = f
+    rest = 1.0 - sum(out.values())
+    if rest < -1e-9:
+        raise ValueError(
+            f"slo_mix fractions sum to {sum(out.values()):g} > 1")
+    out["standard"] = out.get("standard", 0.0) + max(rest, 0.0)
+    return out
 
 
 class SlotRetryExhausted(RuntimeError):
@@ -63,13 +130,16 @@ class SlotRetryExhausted(RuntimeError):
 class Request:
     """One inference request. ``arrival_s`` is the open-loop offset
     (seconds from run start) at which the request becomes visible to
-    the scheduler; 0 = present from the start."""
+    the scheduler; 0 = present from the start. ``slo``/``tenant``
+    drive the SLO scheduler (policy="slo"); FIFO ignores them."""
 
     rid: int
     prompt: np.ndarray
     max_new_tokens: int
     eos_id: int = -1          # -1 = run to the full budget
     arrival_s: float = 0.0
+    slo: str = "standard"     # high | standard | batch
+    tenant: str = ""          # quota bucket (policy="slo")
 
 
 @dataclasses.dataclass
@@ -84,6 +154,9 @@ class Completion:
     decode_s: float           # first token -> last token
     queue_steps: int          # decode steps endured while admittable
     retries: int = 0          # slot quarantines this request survived
+    preempts: int = 0         # SLO preemptions this request survived
+    slo: str = "standard"
+    tenant: str = ""
     recovery_window: bool = False  # a recovery event (quarantine/
     #                                swap/restart continuation) fell
     #                                inside arrival->first token —
@@ -110,8 +183,9 @@ class _Live:
     t_first: float
     queue_steps: int
     base: List[int]           # tokens from before a continuation
-    #                           (journal replay or slot retry) — the
-    #                           completion reports base + tokens
+    #                           (journal replay, slot retry, or SLO
+    #                           preemption) — the completion reports
+    #                           base + tokens
 
 
 class Scheduler:
@@ -122,13 +196,21 @@ class Scheduler:
                  on_token: Optional[Callable[[int, int, bool], None]] = None,
                  clock=time.perf_counter, fault_plan=None, journal=None,
                  reload_fn=None, slot_retries: int = 2,
-                 summary_extra=None):
+                 summary_extra=None, policy: str = "fifo",
+                 tenant_quota: int = 0, preempt: bool = True,
+                 speculator=None):
         if decode_priority < 1:
             raise ValueError(
                 f"decode_priority must be >= 1, got {decode_priority}")
         if slot_retries < 0:
             raise ValueError(
                 f"slot_retries must be >= 0, got {slot_retries}")
+        if policy not in ("fifo", "slo"):
+            raise ValueError(
+                f"unknown policy {policy!r}; have ('fifo', 'slo')")
+        if tenant_quota < 0:
+            raise ValueError(
+                f"tenant_quota must be >= 0, got {tenant_quota}")
         self.engine = engine
         self.decode_priority = decode_priority
         self.registry = registry
@@ -138,6 +220,10 @@ class Scheduler:
         self.journal = journal
         self.reload_fn = reload_fn    # () -> (params, ckpt_step)
         self.slot_retries = slot_retries
+        self.policy = policy
+        self.tenant_quota = tenant_quota
+        self.preempt = preempt
+        self.speculator = speculator
         # Run-identity fields (seed, trace name) merged into the
         # serve_summary RECORD so the JSONL artifact is reproducible
         # standalone (FIREBENCH re-derives workloads from it).
@@ -147,11 +233,66 @@ class Scheduler:
         if self.registry is not None:
             self.registry.emit(event, **fields)
 
+    # -- SLO selection helpers -------------------------------------------
+
+    def _over_quota(self, tenant: str, tenant_tokens: Dict[str, int]
+                    ) -> bool:
+        return (self.tenant_quota > 0
+                and tenant_tokens.get(tenant, 0) >= self.tenant_quota)
+
+    def _pick_index(self, queue: List[Request],
+                    tenant_tokens: Dict[str, int]) -> int:
+        """Which queued request admits next. FIFO: the head. SLO:
+        under-quota before over-quota (deferral, never starvation —
+        over-quota requests win when nothing else waits), then class
+        rank, then arrival order."""
+        if self.policy != "slo" or len(queue) <= 1:
+            return 0
+        best, best_key = 0, None
+        for i, req in enumerate(queue):
+            key = (1 if self._over_quota(req.tenant, tenant_tokens)
+                   else 0, _RANK.get(req.slo, 1), i)
+            if best_key is None or key < best_key:
+                best, best_key = i, key
+        return best
+
+    def _pick_victim(self, live: Dict[int, _Live], cand: Request,
+                     tenant_tokens: Dict[str, int]) -> Optional[_Live]:
+        """The live request SLO preemption evicts for ``cand``:
+        strictly lower class (or over-quota while cand's tenant is
+        under) — among those, the lowest class with the most tokens
+        already delivered (it loses the least). None = nobody
+        preemptible (equal-class work is never evicted — that would
+        just swap places and thrash). A victim whose continuation
+        prompt would outgrow the bucket ladder is skipped too:
+        preemption is ELECTIVE, and crashing the run over a
+        user-pinned tight --serve.buckets would turn policy into
+        failure (quarantine keeps the loud error — its slot is
+        unrecoverable either way)."""
+        cand_rank = _RANK.get(cand.slo, 1)
+        cand_over = self._over_quota(cand.tenant, tenant_tokens)
+        ladder = max(self.engine.buckets)
+        victims = []
+        for lv in live.values():
+            lower = _RANK.get(lv.req.slo, 1) > cand_rank
+            quota_evict = (not cand_over and self._over_quota(
+                lv.req.tenant, tenant_tokens)
+                and lv.req.tenant != cand.tenant)
+            fits_ladder = (len(lv.req.prompt) + len(lv.tokens)
+                           <= ladder)
+            if (lower or quota_evict) and fits_ladder:
+                victims.append(lv)
+        if not victims:
+            return None
+        return max(victims, key=lambda lv: (_RANK.get(lv.req.slo, 1),
+                                            len(lv.tokens)))
+
     def run(self, requests: Sequence[Request]) -> List[Completion]:
         """Serve every request to completion; returns completions in
         finish order (sort by ``rid`` for submission order)."""
         eng = self.engine
         plan = self.fault_plan
+        spec = self.speculator
         for r in requests:
             if not eng.fits(len(r.prompt), r.max_new_tokens):
                 raise ValueError(
@@ -164,8 +305,8 @@ class Scheduler:
                     f"request {r.rid}: max_new_tokens must be >= 1")
         pending = collections.deque(
             sorted(requests, key=lambda r: (r.arrival_s, r.rid)))
-        queue: collections.deque = collections.deque()
-        live: dict = {}                       # slot -> _Live
+        queue: List[Request] = []
+        live: Dict[int, _Live] = {}           # slot -> _Live
         done: List[Completion] = []
         t0 = self.clock()
         steps_since_admit = 0
@@ -174,9 +315,13 @@ class Scheduler:
         #                spans its whole lifetime — reuse would skew
         #                the occupancy mean)
         retries: dict = {}            # rid -> quarantines survived
+        preempts: dict = {}           # rid -> SLO preemptions survived
         first_seen: dict = {}         # rid -> first-token time (the
         #                               TTFT point survives retries)
+        tenant_tokens: Dict[str, int] = {}  # decoded tokens this run
         total_retries = 0
+        total_preempts = 0
+        spec_stats = {"verify_steps": 0, "proposed": 0, "accepted": 0}
         self._swap_seconds = 0.0
         recovery_ts: List[float] = []  # quarantine/swap times, for the
         #                                recovery-window TTFT flag
@@ -188,25 +333,33 @@ class Scheduler:
             t = now()
             eng.free(lv.slot)
             del live[lv.slot]
+            if spec is not None:
+                spec.observe_free(lv.slot)
             tokens = lv.base + lv.tokens
             t_first = first_seen.get(lv.req.rid, lv.t_first)
             n_retries = retries.get(lv.req.rid, 0)
+            n_preempts = preempts.get(lv.req.rid, 0)
             # Recovery population: a quarantine/swap fell inside this
             # request's arrival->first-token window, OR the request is
             # a restart continuation (its base tokens crossed a
             # process death — the resumed leg consumed the plan, so
             # recovery_ts alone would miss exactly the requests the
-            # restart hit).
+            # restart hit). A PREEMPTION continuation's base is policy,
+            # not recovery — excluded.
             window = (any(lv.req.arrival_s <= rt <= t_first
                           for rt in recovery_ts)
-                      or bool(lv.base))
+                      or (bool(lv.base)
+                          and not getattr(lv.req, "_policy_base",
+                                          False)))
             comp = Completion(
                 rid=lv.req.rid,
                 prompt_len=len(lv.req.prompt) - len(lv.base),
                 tokens=tokens, finish=why,
                 ttft_s=t_first - lv.req.arrival_s,
                 decode_s=t - t_first, queue_steps=lv.queue_steps,
-                retries=n_retries, recovery_window=window,
+                retries=n_retries, preempts=n_preempts,
+                slo=lv.req.slo, tenant=lv.req.tenant,
+                recovery_window=window,
                 decoded=len(lv.tokens))
             done.append(comp)
             self._emit("serve_request", rid=comp.rid,
@@ -215,7 +368,8 @@ class Scheduler:
                        ttft_ms=round(1e3 * comp.ttft_s, 3),
                        tok_ms=round(comp.tok_ms, 4),
                        queue_steps=comp.queue_steps,
-                       retries=n_retries,
+                       retries=n_retries, preempts=n_preempts,
+                       slo=comp.slo, tenant=comp.tenant,
                        recovery_window=window,
                        arrival_s=round(lv.req.arrival_s, 4),
                        t_first_s=round(t_first, 4))
@@ -224,10 +378,17 @@ class Scheduler:
             if self.on_token is not None:
                 self.on_token(comp.rid, comp.tokens[-1], True)
 
+        def count_token(req: Request) -> None:
+            if req.tenant:
+                tenant_tokens[req.tenant] = (
+                    tenant_tokens.get(req.tenant, 0) + 1)
+
         def admit() -> None:
-            req = queue.popleft()
+            req = queue.pop(self._pick_index(queue, tenant_tokens))
             slot = eng.free_slots()[0]
             first = eng.prefill(req.prompt, slot)
+            if spec is not None:
+                spec.observe_admit(slot, req.prompt, first)
             base = list(getattr(req, "_base_tokens", ()))
             lv = _Live(req=req, slot=slot, tokens=[first],
                        t_first=now(), queue_steps=req._waited,
@@ -238,10 +399,12 @@ class Scheduler:
                     # First-ever admission of this request (a replayed
                     # continuation was journaled by the previous leg).
                     self.journal.admit(req.rid, req.prompt,
-                                       req.max_new_tokens, req.eos_id)
+                                       req.max_new_tokens, req.eos_id,
+                                       slo=req.slo, tenant=req.tenant)
                 first_seen[req.rid] = lv.t_first
             if self.journal is not None:
                 self.journal.token(req.rid, first, now())
+            count_token(req)
             if self.on_token is not None and not (
                     first == req.eos_id or req.max_new_tokens == 1):
                 self.on_token(req.rid, first, False)
@@ -250,16 +413,46 @@ class Scheduler:
             elif req.max_new_tokens == 1:
                 finish(lv, "length")
 
+        def continuation(lv: _Live) -> Request:
+            """The PR-6 continuation: prompt + the good tokens so far,
+            remaining budget, class/tenant preserved — greedy decode
+            is deterministic, so the re-prefilled continuation emits
+            exactly the tokens the original slot would have (token
+            identity pinned in tests/test_serve_fire.py and
+            tests/test_serve_slo.py)."""
+            # graftcheck: disable=host-sync-in-loop -- builds the
+            # continuation prompt from HOST token lists (no device
+            # value involved); runs once per quarantine/preemption,
+            # not per step
+            cont = dataclasses.replace(
+                lv.req,
+                prompt=np.concatenate(
+                    [np.asarray(lv.req.prompt, np.int32),
+                     np.asarray(lv.tokens, np.int32)])
+                if lv.tokens else np.asarray(lv.req.prompt, np.int32),
+                max_new_tokens=lv.req.max_new_tokens - len(lv.tokens))
+            if len(cont.prompt) > max(eng.buckets):
+                raise ValueError(
+                    f"request {lv.req.rid}: continuation prompt "
+                    f"{len(cont.prompt)} exceeds the largest bucket "
+                    f"{max(eng.buckets)} — re-admission needs the "
+                    f"ladder sized to prompt+new tokens (serve/run.py "
+                    f"does this when a fault plan, journal resume, or "
+                    f"policy=slo is armed; with --serve.buckets, "
+                    f"cover the full trajectory)")
+            cont._base_tokens = lv.base + lv.tokens
+            cont._waited = lv.queue_steps
+            return cont
+
         def quarantine(lv: _Live) -> None:
             """Contain one poisoned slot: free it, re-queue the
             request as a continuation at the head (prompt + good
-            tokens, remaining budget). Greedy decode is deterministic,
-            so the re-prefilled continuation emits exactly the tokens
-            the poisoned step would have — token identity is preserved
-            (pinned in tests/test_serve_fire.py)."""
+            tokens, remaining budget)."""
             nonlocal total_retries, steps_since_admit
             eng.free(lv.slot)
             del live[lv.slot]
+            if spec is not None:
+                spec.observe_free(lv.slot)
             rid = lv.req.rid
             n = retries[rid] = retries.get(rid, 0) + 1
             if n > self.slot_retries:
@@ -274,32 +467,41 @@ class Scheduler:
             recovery_ts.append(t)
             self._emit("recovery", kind="slot_quarantine", rid=rid,
                        slot=lv.slot, retry=n, t_s=round(t, 4))
-            good = lv.base + lv.tokens
             # graftcheck: disable=host-sync-in-loop -- builds the
             # continuation prompt from HOST token lists (no device
             # value involved); runs once per quarantine, not per step
-            cont = Request(
-                rid=rid,
-                prompt=np.concatenate(
-                    [np.asarray(lv.req.prompt, np.int32),
-                     np.asarray(lv.tokens, np.int32)])
-                if lv.tokens else np.asarray(lv.req.prompt, np.int32),
-                max_new_tokens=lv.req.max_new_tokens - len(lv.tokens),
-                eos_id=lv.req.eos_id, arrival_s=lv.req.arrival_s)
-            if len(cont.prompt) > max(eng.buckets):
-                raise ValueError(
-                    f"request {rid}: continuation prompt "
-                    f"{len(cont.prompt)} exceeds the largest bucket "
-                    f"{max(eng.buckets)} — slot retry needs the "
-                    f"ladder sized to prompt+new tokens (serve/run.py "
-                    f"does this when a fault plan is armed; with "
-                    f"--serve.buckets, cover the full trajectory)")
-            cont._base_tokens = good
-            cont._waited = lv.queue_steps
-            queue.appendleft(cont)
+            queue.insert(0, continuation(lv))
             # Re-admit without waiting out the decode-priority clock:
             # the request was already being served.
             steps_since_admit = self.decode_priority
+
+        def preempt_one(lv: _Live) -> None:
+            """SLO preemption: evict a live lower-class / over-quota
+            request so the waiting higher-class one gets its slot.
+            Same continuation machinery as quarantine (journal-
+            compatible, token-identical), but no retry charge, no
+            recovery event — this is policy, not failure."""
+            nonlocal total_preempts
+            eng.free(lv.slot)
+            del live[lv.slot]
+            if spec is not None:
+                spec.observe_free(lv.slot)
+            rid = lv.req.rid
+            preempts[rid] = preempts.get(rid, 0) + 1
+            total_preempts += 1
+            cont = continuation(lv)
+            # Mark the base as policy-only — UNLESS this request
+            # already carried recovery base tokens (a prior quarantine
+            # or journal replay): preemption must not erase that
+            # provenance, or the completion would drop out of the
+            # recovery-window population.
+            if not lv.base or getattr(lv.req, "_policy_base", False):
+                cont._policy_base = True
+            queue.append(cont)     # class selection orders the queue
+            self._emit("preempt", rid=rid, slot=lv.slot,
+                       slo=lv.req.slo, tenant=lv.req.tenant,
+                       served=len(lv.base) + len(lv.tokens),
+                       t_s=round(now(), 4))
 
         while pending or queue or live:
             # Open-loop arrivals: everything whose time has come.
@@ -315,6 +517,15 @@ class Scheduler:
                 if self.journal is not None:
                     self.journal.flush()
                 continue
+            if (self.policy == "slo" and self.preempt and queue
+                    and live and not eng.free_slots()
+                    and steps_since_admit >= self.decode_priority):
+                cand = queue[self._pick_index(queue, tenant_tokens)]
+                victim = self._pick_victim(live, cand, tenant_tokens)
+                if victim is not None:
+                    preempt_one(victim)
+                    continue   # slot freed — the admission branch
+                    #            admits cand next iteration
             if not live:
                 if pending:
                     # Nothing to decode, nothing admittable: sleep to
@@ -342,35 +553,80 @@ class Scheduler:
                 if plan.take_reload(nstep):
                     self._swap(now, recovery_ts)
                 plan.maybe_signal(nstep)
-            nxt = eng.step()
+            # ONE program dispatch, one host fetch — speculative when
+            # armed and every active slot has verify headroom, plain
+            # otherwise. ``emitted`` maps slot -> the tokens the
+            # target model produced this dispatch, in order.
+            if (spec is not None
+                    and getattr(eng, "can_verify", lambda: False)()):
+                # Full per-slot histories are O(prompt + decoded) host
+                # work per step — built only for proposers that read
+                # them (the k-gram self-draft; a draft MODEL's cache
+                # IS its history and ignores the argument).
+                hists = ({s: list(map(int, lv.req.prompt)) + lv.tokens
+                          for s, lv in live.items()}
+                         if getattr(spec, "needs_histories", True)
+                         else {s: () for s in live})
+                props = spec.propose(hists)
+                toks, acc = eng.verify_step(props)
+                emitted = {s: [int(t) for t in toks[s, :acc[s]]]
+                           for s in live}
+                spec_stats["verify_steps"] += 1
+                spec_stats["proposed"] += int(
+                    eng.spec_tokens * len(live))
+                spec_stats["accepted"] += int(
+                    sum(acc[s] - 1 for s in live))
+                spec.sync_from(eng)
+            else:
+                nxt = eng.step()
+                emitted = {s: [int(nxt[s])] for s in live}
+                if spec is not None:
+                    spec.sync_from(eng)
             occupancy_sum += eng.occupancy()
             run_steps += 1
             if queue and eng.free_slots():
-                # The starvation clock: a decode step taken WHILE the
-                # head-of-queue request waited with a free slot
-                # available. The bound the policy guarantees (and
-                # tests/test_serve.py pins) is head-of-line: admission
-                # within decode_priority such steps.
+                # The starvation clock: a decode step taken WHILE a
+                # queued request waited with a free slot available.
+                # The bound the policy guarantees (and tests pin) is
+                # head-of-line: the request the policy would admit
+                # waits at most decode_priority such steps.
                 steps_since_admit += 1
-                queue[0]._waited += 1
+                queue[self._pick_index(queue,
+                                       tenant_tokens)]._waited += 1
+            elif queue and self.policy == "slo" and self.preempt:
+                # The PREEMPTION wait clock: under policy="slo" a
+                # queued request facing a FULL engine also accrues
+                # wait — without this the admission reset that filled
+                # the last slot would freeze the clock at 0 and the
+                # preemption branch above could never trigger. FIFO
+                # (and slo with preempt off) keeps the original
+                # free-slot-only clock: capacity waits don't count
+                # against the decode-priority policy there.
+                steps_since_admit += 1
+                queue[self._pick_index(queue,
+                                       tenant_tokens)]._waited += 1
             # Containment BEFORE token retirement: a poisoned slot's
-            # token is garbage — quarantine drops it (never appended,
-            # never journaled) and the continuation re-derives it.
+            # tokens are garbage — quarantine drops them (never
+            # appended, never journaled) and the continuation
+            # re-derives them.
             for slot in getattr(eng, "take_bad_slots", lambda: [])():
                 if slot in live:
                     quarantine(live[slot])
             for slot in list(live):
                 lv = live[slot]
-                tok = int(nxt[slot])
-                lv.tokens.append(tok)
-                if self.journal is not None:
-                    self.journal.token(lv.req.rid, tok, now())
-                if tok == lv.req.eos_id:
-                    finish(lv, "eos")
-                elif len(lv.tokens) >= lv.req.max_new_tokens:
-                    finish(lv, "length")
-                elif self.on_token is not None:
-                    self.on_token(lv.req.rid, tok, False)
+                for tok in emitted.get(slot, ()):
+                    lv.tokens.append(tok)
+                    if self.journal is not None:
+                        self.journal.token(lv.req.rid, tok, now())
+                    count_token(lv.req)
+                    if tok == lv.req.eos_id:
+                        finish(lv, "eos")
+                        break
+                    if len(lv.tokens) >= lv.req.max_new_tokens:
+                        finish(lv, "length")
+                        break
+                    if self.on_token is not None:
+                        self.on_token(lv.req.rid, tok, False)
             if self.journal is not None:
                 self.journal.flush()
 
@@ -396,11 +652,22 @@ class Scheduler:
             "buckets": ",".join(str(b) for b in eng.buckets),
             "num_slots": eng.num_slots,
             "decode_priority": self.decode_priority,
+            "policy": self.policy,
+            "preemptions": total_preempts,
             "retries": total_retries,
             "swaps": getattr(eng, "swaps", 0),
             "swap_seconds": round(self._swap_seconds, 4),
             **self.summary_extra,
         }
+        if spec is not None:
+            summary.update(
+                spec_tokens=getattr(eng, "spec_tokens", 0),
+                verify_steps=spec_stats["verify_steps"],
+                spec_proposed=spec_stats["proposed"],
+                spec_accepted=spec_stats["accepted"],
+                accept_rate=round(
+                    spec_stats["accepted"]
+                    / max(1, spec_stats["proposed"]), 4))
         self._emit("serve_summary", **summary)
         self.summary = summary
         if self.journal is not None:

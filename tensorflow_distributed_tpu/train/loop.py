@@ -525,7 +525,8 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
                                 else None),
                 grad_sync_bucket_bytes=(
                     int(cfg.grad_sync_bucket_mb * 2 ** 20)
-                    if cfg.grad_sync_bucket_mb else 0))
+                    if cfg.grad_sync_bucket_mb else 0),
+                grad_clip_norm=cfg.grad_clip_norm or 0.0)
             if cfg.grad_sync == "overlap":
                 # Surface the per-step collective-traffic estimate so
                 # the step records can split comm into exposed vs

@@ -43,7 +43,8 @@ def make_multi_step(mesh: Mesh, seed: int = 0, loss: LossFn = loss_fn,
                     grad_sync: str = "implicit",
                     state_template: Any = None,
                     grad_sync_bucket_bytes: int = 0,
-                    grad_sync_min_size: int = 0
+                    grad_sync_min_size: int = 0,
+                    grad_clip_norm: float = 0.0
                     ) -> Callable[[TrainState, Any],
                                   Tuple[TrainState, Metrics]]:
     """Build ``fn(state, stacked_batches) -> (state, metrics_of_last)``.
@@ -67,7 +68,8 @@ def make_multi_step(mesh: Mesh, seed: int = 0, loss: LossFn = loss_fn,
                            grad_sync=grad_sync,
                            state_template=state_template,
                            grad_sync_bucket_bytes=grad_sync_bucket_bytes,
-                           grad_sync_min_size=grad_sync_min_size)
+                           grad_sync_min_size=grad_sync_min_size,
+                           grad_clip_norm=grad_clip_norm)
 
     def run(state: TrainState, batches: Any) -> Tuple[TrainState, Metrics]:
         def body(s, b):

@@ -71,6 +71,13 @@ def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
             weight_decay_mask=decay_mask if cfg.weight_decay else None)
     else:
         raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
-    if cfg.grad_clip_norm:
+    if cfg.grad_clip_norm and cfg.grad_sync == "implicit":
+        # The chain clip sees the FULL replicated grad tree only on the
+        # implicit path. The explicit shard_map step (grad_sync=serial/
+        # overlap) hands tx SHARDED grad blocks — a chain clip there
+        # would clip by each device's local block norm — so the step
+        # applies the clip itself from a psum-reconstructed global norm
+        # BEFORE tx.update (parallel/overlap.py, grad_clip_norm arg)
+        # and the chain stays clip-free.
         return optax.chain(optax.clip_by_global_norm(cfg.grad_clip_norm), core)
     return core

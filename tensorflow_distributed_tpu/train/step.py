@@ -121,7 +121,8 @@ def make_train_step(mesh: Mesh, seed: int = 0, donate: bool = True,
                     grad_sync: str = "implicit",
                     state_template: Any = None,
                     grad_sync_bucket_bytes: int = 0,
-                    grad_sync_min_size: int = 0
+                    grad_sync_min_size: int = 0,
+                    grad_clip_norm: float = 0.0
                     ) -> Callable[[TrainState, Batch],
                                   Tuple[TrainState, Metrics]]:
     """Build the jitted train step for a mesh.
@@ -144,7 +145,11 @@ def make_train_step(mesh: Mesh, seed: int = 0, donate: bool = True,
     a pure-data mesh; ``grad_sync_bucket_bytes``/``grad_sync_min_size``
     forward the bucket bound and the scatterable-leaf threshold (0 =
     the overlap module's defaults). ``accum_steps`` must stay 1 — the
-    explicit path has no microbatch scan.
+    explicit path has no microbatch scan. ``grad_clip_norm`` also
+    applies ONLY to the explicit dispatch (the step clips by a
+    psum-reconstructed global norm before its sharded update); on the
+    implicit path clipping rides the optax chain (train/optim.py), and
+    this argument is ignored.
 
     ``accum_steps > 1`` splits the global batch into that many
     microbatches and accumulates their mean gradient in a ``lax.scan``
@@ -206,7 +211,7 @@ def make_train_step(mesh: Mesh, seed: int = 0, donate: bool = True,
             ema_decay=ema_decay,
             params_out_shardings=params_out_shardings,
             skip_nonfinite=skip_nonfinite, health_every=health_every,
-            jit=jit)
+            grad_clip_norm=grad_clip_norm, jit=jit)
 
     if batch_shardings is None:
         batch_shardings = default_batch_shardings(mesh)

@@ -602,10 +602,24 @@ def test_census_structure_sane():
                            "serve_decode", "gpt_train_health",
                            "moe_train_health",
                            "pipelined_train_health",
-                           "gpt_train_overlap", "moe_train_overlap"}
+                           "gpt_train_overlap", "moe_train_overlap",
+                           "serve_verify", "serve_decode_int8"}
     assert golden["pipelined_train"]["collectives"].get("ppermute", 0) > 0
     assert golden["gpt_train"]["collectives"] == {}
     assert golden["serve_decode"]["collectives"] == {}
+    # Fast-path serving invariants: the speculative verify and the
+    # int8 decode stay collective-free (per-token cost work is local),
+    # and int8's quantize-on-write/scale-adjusted-attend adds only a
+    # BOUNDED number of converts next to the plain decode program.
+    assert golden["serve_verify"]["collectives"] == {}
+    assert golden["serve_decode_int8"]["collectives"] == {}
+    plain_up = golden["serve_decode"]["upcasts"].get(
+        "bfloat16->float32", 0)
+    int8_up = golden["serve_decode_int8"]["upcasts"].get(
+        "bfloat16->float32", 0)
+    # <= 8 extra converts per layer (tiny = 2): the q8 absmax/scale
+    # math + the two scale-adjusted dots — NOT a chain-wide f32 drift.
+    assert plain_up < int8_up <= plain_up + 16
     # The overlap grad-sync invariant: an explicit reduce-scatter AND
     # an explicit all-gather per scatter bucket (counts equal — a
     # bucket that scatters but never gathers back would train on

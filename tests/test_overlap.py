@@ -242,6 +242,54 @@ def test_overlap_matches_serial_bit_identical(overlap_setup):
     assert _bit_equal(ss.ema, so.ema)
 
 
+def test_clip_tree_matches_optax_semantics():
+    """_clip_tree fed optax's own global_norm reproduces
+    optax.clip_by_global_norm BIT-EXACTLY, on both sides of the
+    trigger — the explicit step's clip is the chain clip with the
+    norm made pluggable (so the shard_map paths can psum-reconstruct
+    it), not a reimplementation with different rounding."""
+    import jax
+    import optax
+
+    from tensorflow_distributed_tpu.parallel.overlap import _clip_tree
+
+    tree = _fake_tree([(8, 12), (5,), (3, 4, 2)])
+    tree = jax.tree_util.tree_map(
+        lambda x: jax.numpy.asarray(x - np.mean(x)), tree)
+    for max_norm in (0.05, 1e6):   # clipping / not clipping
+        clip = optax.clip_by_global_norm(max_norm)
+        ref, _ = clip.update(tree, clip.init(tree))
+        got = _clip_tree(tree, optax.global_norm(tree), max_norm)
+        assert _bit_equal(ref, got), f"max_norm={max_norm}"
+
+
+def test_overlap_matches_serial_bit_identical_with_clip(overlap_setup):
+    """The grad-clip composition gate (ROADMAP item 2's follow-up):
+    with clipping ACTIVE on every step (clip << observed grad norms),
+    serial+clip and overlap+clip stay bit-equal — both modes scale by
+    the same psum-reconstructed global-norm scalar — and the clip
+    demonstrably changed the trajectory vs the unclipped run."""
+    ss, serial = _build(overlap_setup, "serial", grad_clip_norm=0.05,
+                        grad_norm_metric=True)
+    so, over = _build(overlap_setup, "overlap", grad_clip_norm=0.05,
+                      grad_norm_metric=True)
+    su, unclipped = _build(overlap_setup, "serial",
+                           grad_norm_metric=True)
+    for i in range(3):
+        ss, ms = serial(ss, overlap_setup["put"](i))
+        so, mo = over(so, overlap_setup["put"](i))
+        su, _ = unclipped(su, overlap_setup["put"](i))
+        # The pre-clip norm is the reported metric, identical across
+        # formulations (same reconstruction), and far above the bound
+        # (the clip genuinely fires every step).
+        assert float(ms["grad_norm"]) == float(mo["grad_norm"])
+        assert float(ms["grad_norm"]) > 0.05
+    assert _bit_equal(ss.params, so.params)
+    assert _bit_equal(ss.opt_state, so.opt_state)
+    assert _bit_equal(ss.ema, so.ema)
+    assert not _bit_equal(ss.params, su.params)  # clip changed things
+
+
 def test_overlap_slots_stay_sharded(overlap_setup):
     """The point of ZeRO-1 composition: after an overlap step the
     Adam mirrors keep their data-sharded layout (never gathered), and
@@ -355,6 +403,11 @@ def _cfg(**kw):
 def test_config_overlap_valid():
     _cfg().validate()
     _cfg(grad_sync="serial", param_partition="replicated").validate()
+    # grad_clip_norm COMPOSES since the psum-reconstructed pre-scale
+    # landed (the old validate-time rejection is lifted).
+    _cfg(grad_clip_norm=1.0).validate()
+    _cfg(grad_sync="serial", param_partition="replicated",
+         grad_clip_norm=1.0).validate()
 
 
 @pytest.mark.parametrize("kw,match", [
@@ -366,7 +419,6 @@ def test_config_overlap_valid():
     (dict(grad_sync="serial"), "replicated"),
     (dict(optimizer="adafactor"), "ELEMENTWISE"),
     (dict(grad_accum_steps=2, batch_size=16), "microbatch"),
-    (dict(grad_clip_norm=1.0), "clip"),
     (dict(ce_chunk=8), "ce_chunk"),
     (dict(mode="serve"), "mode"),
     (dict(grad_sync="banana"), "unknown grad_sync"),
@@ -391,7 +443,6 @@ def test_config_bucket_knob_needs_overlap():
     dict(optimizer="adafactor"),
     dict(grad_accum_steps=2),
     dict(param_sync_every=2),
-    dict(grad_clip_norm=1.0),
     dict(ce_chunk=8),
     dict(shard_vocab=True),
 ])
