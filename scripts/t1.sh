@@ -217,6 +217,38 @@ if [ "$serve_rc" -ne 0 ]; then
        "$SERVELOG" >&2
 fi
 
+# Slobench smoke (serve observatory: per-request trace validity +
+# span balance across a SIGKILL restart, burn-rate alert fires on the
+# over-capacity burst and stays quiet on the clean control, snapshot
+# agrees with the report — benchmarks/slobench.py). Tiny scale; the
+# overhead A/B gate lives in the committed SLOBENCH.json run, not
+# here (subprocess timing at smoke scale is noise). Same abort-guard
+# shape as the smokes above: a run that dies to the known container
+# XLA:CPU abort prints no slo_checks line and is retried once; a
+# genuine gate failure prints one and is NOT retried.
+SLOLOG="${SLOLOG:-/tmp/_t1_slo.log}"
+run_slobench() {
+  rm -f "$SLOLOG"
+  timeout -k 10 300 env JAX_PLATFORMS=cpu python -m \
+    tensorflow_distributed_tpu.benchmarks.slobench \
+    --requests 10 --new-tokens 32 --seq-len 48 --stall-s 0.15 \
+    --slo "ttft_p95=100ms" --slo-windows "16,64" --skip-overhead \
+    --out "" 2>&1 | tee "$SLOLOG"
+  return "${PIPESTATUS[0]}"
+}
+run_slobench
+slo_rc=$?
+if ! grep -qa '"metric": "slo_checks"' "$SLOLOG"; then
+  echo "[t1] no slo_checks line in $SLOLOG (known container" \
+       "XLA:CPU abort) — rerunning slobench once" >&2
+  run_slobench
+  slo_rc=$?
+fi
+if [ "$slo_rc" -ne 0 ]; then
+  echo "[t1] slobench smoke FAILED (slo_rc=$slo_rc) — see" \
+       "$SLOLOG" >&2
+fi
+
 if [ "$rc" -eq 0 ] && [ "$lint_rc" -ne 0 ]; then
   echo "[t1] suite green but graftcheck red (lint_rc=$lint_rc) — see" \
        "scripts/lint.sh output above" >&2
@@ -236,5 +268,8 @@ if [ "$rc" -eq 0 ] && [ "$gradsync_rc" -ne 0 ]; then
 fi
 if [ "$rc" -eq 0 ] && [ "$serve_rc" -ne 0 ]; then
   exit "$serve_rc"
+fi
+if [ "$rc" -eq 0 ] && [ "$slo_rc" -ne 0 ]; then
+  exit "$slo_rc"
 fi
 exit "$rc"

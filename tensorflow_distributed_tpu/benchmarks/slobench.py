@@ -1,0 +1,411 @@
+"""Serve-observatory benchmark: tracing + SLO burn-rate monitoring
+over a faulted, over-capacity serve run, with validity and overhead
+gates.
+
+What this pins (ISSUE 11 / ROADMAP item 5's measurement layer):
+
+1. **Control** (clean run, gentle open-loop arrivals, full observatory
+   armed): the Perfetto trace is VALID — every request's async spans
+   balance — the exported metrics snapshots parse, the FINAL snapshot's
+   per-class TTFT p95 agrees EXACTLY with the post-run report's number
+   (same nearest-rank formula over the same completions), and the
+   burn-rate monitor stays silent: zero ``slo_alert`` records.
+2. **Fire** (the PR-6 standard fault plan — decode stall, on-device
+   slot NaN, live weight reload, SIGKILL-and-supervise — plus an
+   over-capacity BURST arrival pattern, same observatory): the burn
+   -rate alert FIRES, the one trace file spans the restart (the
+   resumed leg closes the dead leg's in-flight spans and continues
+   the timeline) and still balances, the quarantine/swap recovery
+   instants are present in it, and the journal shows zero lost
+   requests.
+3. **Overhead** (in-process A/B, same seeded workload): aggregate
+   tokens/s with the full observatory armed is >= ``--min-tps-ratio``
+   (default 0.95) of tokens/s with it off — instrumentation must cost
+   <= 5%.
+
+Emits one JSON line per metric plus a checks line; ``--out`` writes
+SLOBENCH.json (overwritten per run); exit 1 on any failed gate
+(``--no-check`` to report without gating).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+
+def _run(cmd, env, timeout, what):
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=timeout)
+    if proc.returncode != 0:
+        print(f"slobench: {what} failed rc={proc.returncode}\n"
+              f"{proc.stdout[-3000:]}\n{proc.stderr[-2000:]}",
+              file=sys.stderr)
+        raise SystemExit(1)
+    return proc
+
+
+def _trace_checks(trace_path: str):
+    """(balanced, instant-name set, event count) for one trace file."""
+    from tensorflow_distributed_tpu.observe.trace import (
+        load_trace, unbalanced_async)
+    events = load_trace(trace_path)
+    stray = unbalanced_async(events)
+    instants = {e.get("name") for e in events if e.get("ph") == "i"}
+    return len(stray) == 0, instants, len(events)
+
+
+def _overhead_ab(args):
+    """In-process A/B: the same seeded fresh-init workload through the
+    scheduler with the observatory off vs fully armed (tracer, SLO
+    monitor, JSONL registry, snapshot export), INTERLEAVED over
+    ``--overhead-repeats`` rounds (host scheduling noise on this box
+    is ~10% run-to-run — alternating the configs and taking each
+    side's best compares steady states, the repo's min-of-interleaved
+    bench convention).
+
+    The A/B model is deliberately BIGGER than the drill legs' tiny
+    config (``--overhead-d-model``, default 256, 4 layers): the
+    instrumentation cost is a fixed ~tens of µs of host bookkeeping
+    per decode step, so measuring it against a sub-ms toy step would
+    gate Python dict overhead against XLA dispatch noise rather than
+    against the step work any real deployment has (where the same µs
+    are well under 1%)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tensorflow_distributed_tpu.models.transformer import gpt_lm
+    from tensorflow_distributed_tpu.observe.registry import (
+        JsonlSink, MetricsRegistry)
+    from tensorflow_distributed_tpu.observe.serve_trace import (
+        ServeTracer)
+    from tensorflow_distributed_tpu.observe.slo import (
+        SLOMonitor, parse_slo, parse_windows)
+    from tensorflow_distributed_tpu.serve.buckets import default_buckets
+    from tensorflow_distributed_tpu.serve.engine import SlotDecodeEngine
+    from tensorflow_distributed_tpu.serve.scheduler import (
+        Request, Scheduler)
+
+    work = tempfile.mkdtemp(prefix="slobench-ab-")
+    max_len = args.prompt_len_max + args.overhead_new_tokens + 4
+    model = gpt_lm(None, size="tiny", d_model=args.overhead_d_model,
+                   n_layers=4, n_heads=8,
+                   d_ff=4 * args.overhead_d_model, max_len=max_len,
+                   dropout_rate=0.0)
+    params = model.init(jax.random.key(args.seed),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    rng = np.random.default_rng(args.seed)
+    prompts = [rng.integers(0, model.cfg.vocab_size,
+                            size=int(n)).astype(np.int32)
+               for n in rng.integers(args.prompt_len_min,
+                                     args.prompt_len_max + 1,
+                                     size=args.overhead_requests)]
+    buckets = default_buckets(args.prompt_len_max, cap=max_len)
+
+    def one(observed: bool, rep: int) -> float:
+        eng_kw, sched_kw, closers = {}, {}, []
+        if observed:
+            tag = f"ab-on{rep}"
+            tracer = ServeTracer(os.path.join(work, f"{tag}.trace"))
+            registry = MetricsRegistry(
+                [JsonlSink(os.path.join(work, f"{tag}.jsonl"))])
+            fast, slow = parse_windows(args.slo_windows)
+            eng_kw["tracer"] = tracer
+            sched_kw.update(
+                tracer=tracer, registry=registry,
+                slo_monitor=SLOMonitor(
+                    parse_slo(args.slo), fast_window=fast,
+                    slow_window=slow, emit=registry.emit,
+                    tracer=tracer),
+                export_every=0.25,
+                export_path=os.path.join(work, f"{tag}.snap"))
+            closers = [tracer.close, registry.close]
+        eng = SlotDecodeEngine(model, params, 4, buckets=buckets,
+                               **eng_kw)
+        eng.warmup()
+        sched = Scheduler(eng, decode_priority=4, **sched_kw)
+        sched.run([Request(rid=i, prompt=p,
+                           max_new_tokens=args.overhead_new_tokens)
+                   for i, p in enumerate(prompts)])
+        for close in closers:
+            close()
+        return float(sched.summary["tokens_per_sec"])
+
+    one(False, -1)                     # warm the A/B shapes untimed
+    tps_off = tps_on = 0.0
+    for r in range(args.overhead_repeats):
+        tps_off = max(tps_off, one(False, r))
+        tps_on = max(tps_on, one(True, r))
+    shutil.rmtree(work, ignore_errors=True)
+    return tps_off, tps_on
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--size", default="tiny")
+    parser.add_argument("--requests", type=int, default=24)
+    parser.add_argument("--num-slots", type=int, default=2)
+    parser.add_argument("--prompt-len-min", type=int, default=4)
+    parser.add_argument("--prompt-len-max", type=int, default=12)
+    parser.add_argument("--new-tokens", type=int, default=96)
+    parser.add_argument("--seq-len", type=int, default=112)
+    parser.add_argument("--control-rate", type=float, default=3.0,
+                        help="control arrivals (req/s) — gentle, the "
+                        "engine keeps up, no alert expected")
+    parser.add_argument("--burst-rate", type=float, default=64.0,
+                        help="fire arrivals (req/s, bursty) — far "
+                        "over capacity, the alert must fire")
+    parser.add_argument("--slo", default="ttft_p95=400ms",
+                        help="targets armed on both legs")
+    parser.add_argument("--slo-windows", default="30,120")
+    parser.add_argument("--stall-s", type=float, default=0.3)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--min-tps-ratio", type=float, default=0.95)
+    parser.add_argument("--overhead-requests", type=int, default=16)
+    parser.add_argument("--overhead-new-tokens", type=int, default=64)
+    parser.add_argument("--overhead-repeats", type=int, default=4)
+    parser.add_argument("--overhead-d-model", type=int, default=256)
+    parser.add_argument("--skip-overhead", action="store_true")
+    parser.add_argument("--ab-only", action="store_true",
+                        help=argparse.SUPPRESS)  # internal: run just
+    # the overhead A/B in a FRESH interpreter (the drill legs leave
+    # the bench process with a warmed-but-fragmented heap that skews
+    # a tight in-process A/B) and print one JSON line
+    parser.add_argument("--timeout", type=float, default=420.0)
+    parser.add_argument("--workdir", default="")
+    parser.add_argument("--no-check", action="store_true")
+    parser.add_argument("--out", default="SLOBENCH.json")
+    args = parser.parse_args(argv)
+
+    work = args.workdir or tempfile.mkdtemp(prefix="slobench-")
+    os.makedirs(work, exist_ok=True)
+    ckpt = os.path.join(work, "ckpt")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYTHONUNBUFFERED"] = "1"
+
+    if args.ab_only:
+        tps_off, tps_on = _overhead_ab(args)
+        print(json.dumps({"ab_tps_off": tps_off, "ab_tps_on": tps_on}))
+        return 0
+
+    # The PR-6 standard fault plan, keyed well inside the decode-step
+    # budget (~requests * new_tokens / slots) so every drill fires.
+    est_steps = max(8, args.requests * args.new_tokens
+                    // args.num_slots)
+    plan = (f"decode_stall@{max(2, est_steps // 8)}:{args.stall_s}s,"
+            f"slot_nan@{max(3, est_steps // 5)}:0,"
+            f"reload@{max(4, est_steps // 3)},"
+            f"sigkill@{max(5, est_steps // 2)}")
+
+    common = [
+        "--model", "gpt_lm", "--model-size", args.size,
+        "--seq-len", str(args.seq_len), "--seed", str(args.seed),
+        "--compute-dtype", "float32",
+    ]
+    observe = lambda leg: [  # noqa: E731 - tiny per-leg path helper
+        "--observe.metrics-jsonl", os.path.join(work, f"{leg}.jsonl"),
+        "--observe.trace", os.path.join(work, f"{leg}.trace.json"),
+        "--observe.slo", args.slo,
+        "--observe.slo-windows", args.slo_windows,
+        "--observe.export-every", "0.25",
+        "--observe.export-path", os.path.join(work, f"{leg}.snap.json"),
+    ]
+    serve_common = common + [
+        "--mode", "serve", "--checkpoint-dir", ckpt,
+        "--serve.num-slots", str(args.num_slots),
+        "--serve.num-requests", str(args.requests),
+        "--serve.prompt-len-min", str(args.prompt_len_min),
+        "--serve.prompt-len-max", str(args.prompt_len_max),
+        "--serve.max-new-tokens", str(args.new_tokens),
+        "--serve.buckets", str(args.seq_len),
+    ]
+
+    # 1. Checkpoint prep (serving weights + the reload swap source).
+    _run([sys.executable, "-m", "tensorflow_distributed_tpu.cli",
+          *common, "--dataset", "synthetic", "--train-steps", "2",
+          "--batch-size", "8", "--eval-every", "0", "--log-every", "0",
+          "--checkpoint-dir", ckpt, "--checkpoint-every", "2"],
+         env, args.timeout, "checkpoint prep")
+
+    # 2. CONTROL: clean, gentle arrivals, observatory armed.
+    _run([sys.executable, "-m", "tensorflow_distributed_tpu.cli",
+          *serve_common, *observe("control"),
+          "--serve.arrival-rate", str(args.control_rate)],
+         env, args.timeout, "control serve")
+
+    # 3. FIRE: over-capacity burst + the standard fault plan, under
+    # the supervisor (SIGKILL -> journal resume; the trace file spans
+    # the restart).
+    fire_journal = os.path.join(work, "fire.journal")
+    fire = _run([sys.executable, "-m",
+                 "tensorflow_distributed_tpu.resilience.supervisor",
+                 "--max-restarts", "2", "--backoff-base-s", "0.2",
+                 "--", *serve_common, *observe("fire"),
+                 "--serve.trace", "bursty",
+                 "--serve.arrival-rate", str(args.burst_rate),
+                 "--serve.journal", fire_journal,
+                 "--resilience.sync-timeout-s", "120",
+                 "--resilience.fault-plan", plan],
+                env, args.timeout, "fire serve")
+    restarts = fire.stdout.count('"kind": "restart"')
+
+    # 4. Gates.
+    from tensorflow_distributed_tpu.observe.report import (
+        load_records, summarize)
+    from tensorflow_distributed_tpu.serve import journal as journal_mod
+
+    def leg_records(leg):
+        return load_records(os.path.join(work, f"{leg}.jsonl"))
+
+    control_recs = leg_records("control")
+    fire_recs = leg_records("fire")
+    control_sum = summarize(control_recs)
+    fire_sum = summarize(fire_recs)
+    control_alerts = sum(1 for r in control_recs
+                         if r.get("event") == "slo_alert")
+    fire_alerts = sum(1 for r in fire_recs
+                      if r.get("event") == "slo_alert")
+
+    control_ok, control_instants, control_events = _trace_checks(
+        os.path.join(work, "control.trace.json"))
+    fire_ok, fire_instants, fire_events = _trace_checks(
+        os.path.join(work, "fire.trace.json"))
+    recovery_marks = fire_instants & {"slot_quarantine", "weight_swap",
+                                      "journal_resume"}
+
+    # Snapshot validity + agreement (control leg: one clean process,
+    # one population). Every snapshot record parses with the core
+    # fields; the final one's standard-class p95 must EQUAL the
+    # report's serve-request-derived p95 (all-standard workload, same
+    # nearest-rank formula).
+    snaps = [r for r in control_recs
+             if r.get("event") == "metrics_snapshot"]
+    snap_fields_ok = bool(snaps) and all(
+        all(k in s for k in ("t_s", "decode_steps", "requests_done",
+                             "queue_depth", "slot_occupancy",
+                             "tokens_per_sec", "slo"))
+        for s in snaps)
+    snap_file = json.load(open(os.path.join(work, "control.snap.json")))
+    final_snap_p95 = snaps[-1].get("ttft_ms_p95_standard") if snaps \
+        else None
+    report_p95 = control_sum.get("serve_ttft_ms_p95")
+    snap_agree = (final_snap_p95 is not None
+                  and final_snap_p95 == report_p95
+                  and snap_file.get("requests_done") == args.requests)
+
+    fire_play = journal_mod.replay(fire_journal)
+    lost = [rid for rid in range(args.requests)
+            if not fire_play.get(rid, {}).get("done")]
+    rec_counts = fire_sum.get("recovery_counts", {})
+
+    # 5. Overhead A/B in a FRESH interpreter (isolated from this
+    # process's post-drill heap state, like every other phase).
+    tps_off = tps_on = ratio = None
+    if not args.skip_overhead:
+        ab = _run([sys.executable, "-m",
+                   "tensorflow_distributed_tpu.benchmarks.slobench",
+                   "--ab-only", "--out", "",
+                   "--seed", str(args.seed),
+                   "--overhead-requests", str(args.overhead_requests),
+                   "--overhead-new-tokens",
+                   str(args.overhead_new_tokens),
+                   "--overhead-repeats", str(args.overhead_repeats),
+                   "--overhead-d-model", str(args.overhead_d_model),
+                   "--prompt-len-min", str(args.prompt_len_min),
+                   "--prompt-len-max", str(args.prompt_len_max),
+                   "--slo", args.slo, "--slo-windows",
+                   args.slo_windows],
+                  env, args.timeout, "overhead A/B")
+        line = [ln for ln in ab.stdout.splitlines()
+                if ln.startswith('{"ab_tps_off"')][-1]
+        parsed = json.loads(line)
+        tps_off, tps_on = parsed["ab_tps_off"], parsed["ab_tps_on"]
+        ratio = tps_on / max(tps_off, 1e-9)
+
+    common_tags = {
+        "model": f"gpt_lm/{args.size}", "requests": args.requests,
+        "new_tokens": args.new_tokens, "num_slots": args.num_slots,
+        "slo": args.slo, "slo_windows": args.slo_windows,
+        "fault_plan": plan, "seed": args.seed,
+        "burst_rate": args.burst_rate,
+        "control_rate": args.control_rate,
+    }
+    lines = [
+        {"metric": "slo_control_alerts", "value": control_alerts,
+         "unit": "slo_alert records",
+         "p95_ttft_ms": control_sum.get("serve_ttft_ms_p95")},
+        {"metric": "slo_fire_alerts", "value": fire_alerts,
+         "unit": "slo_alert records",
+         "p95_ttft_ms": fire_sum.get("serve_ttft_ms_p95"),
+         "budget_remaining_min": fire_sum.get(
+             "serve_slo_budget_remaining_min")},
+        {"metric": "slo_trace_events",
+         "value": {"control": control_events, "fire": fire_events},
+         "unit": "trace events",
+         "recovery_instants": sorted(recovery_marks)},
+        {"metric": "slo_fire_recovery_counts", "value": rec_counts,
+         "unit": "", "restarts": restarts,
+         "p99_ttft_ms_recovery": fire_sum.get(
+             "serve_ttft_ms_p99_recovery")},
+        {"metric": "slo_snapshots",
+         "value": len(snaps), "unit": "metrics_snapshot records",
+         "final_p95_standard": final_snap_p95,
+         "report_p95": report_p95},
+    ]
+    if ratio is not None:
+        lines.append(
+            {"metric": "slo_instrumentation_tokens_per_sec",
+             "value": round(tps_on, 1), "unit": "tokens/sec",
+             "tracing_off": round(tps_off, 1),
+             "ratio": round(ratio, 4)})
+    checks = {
+        "metric": "slo_checks",
+        "control_quiet": control_alerts == 0,
+        "fire_alerted": fire_alerts >= 1,
+        "traces_balanced": bool(control_ok and fire_ok),
+        "recovery_instants_ok": bool(
+            {"slot_quarantine", "weight_swap"} <= recovery_marks),
+        "trace_spans_restart": "journal_resume" in recovery_marks,
+        "snapshots_ok": bool(snap_fields_ok),
+        "snapshot_agrees_with_report": bool(snap_agree),
+        "lost_requests": len(lost),
+        "drills_fired_ok": bool(
+            rec_counts.get("slot_quarantine", 0) >= 1
+            and rec_counts.get("weight_swap", 0) >= 1
+            and restarts >= 1),
+    }
+    if ratio is not None:
+        checks["overhead_ok"] = bool(ratio >= args.min_tps_ratio)
+        checks["min_tps_ratio"] = args.min_tps_ratio
+    lines.append(checks)
+    lines = [dict(ln, **common_tags) for ln in lines]
+    print("\n".join(json.dumps(ln) for ln in lines))
+    if args.out:
+        from tensorflow_distributed_tpu.observe.registry import (
+            write_jsonl)
+        write_jsonl(args.out, lines)
+    ok = (checks["control_quiet"] and checks["fire_alerted"]
+          and checks["traces_balanced"]
+          and checks["recovery_instants_ok"]
+          and checks["trace_spans_restart"]
+          and checks["snapshots_ok"]
+          and checks["snapshot_agrees_with_report"]
+          and not lost and checks["drills_fired_ok"]
+          and checks.get("overhead_ok", True))
+    if not args.no_check and not ok:
+        print(f"slobench: checks FAILED: {checks}", file=sys.stderr)
+        return 1
+    if not args.workdir:
+        shutil.rmtree(work, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

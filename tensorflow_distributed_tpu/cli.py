@@ -42,6 +42,19 @@ Examples:
         --resilience.sync-timeout-s 60 \
         --resilience.fault-plan "slot_nan@6:1,reload@10,sigkill@14"
 
+    # serve observatory (observe/serve_trace.py + observe/slo.py;
+    # README "Serve tracing & SLO monitoring"): per-request Perfetto
+    # trace (open at https://ui.perfetto.dev), live SLO burn-rate
+    # monitor with slo_alert/slo_ok events + a periodic status line,
+    # and atomic rolling-metrics snapshots a router can poll
+    python -m tensorflow_distributed_tpu.cli --mode serve \
+        --model gpt_lm --serve.num-slots 4 --serve.num-requests 32 \
+        --serve.policy slo --serve.slo-mix "high:0.25" \
+        --observe.metrics-jsonl serve.jsonl \
+        --observe.trace serve.trace.json \
+        --observe.slo "high:ttft_p95=100ms,tok_p50=30ms" \
+        --observe.export-every 1 --observe.export-path serve.snap.json
+
     # graftcheck runtime checks (analysis/runtime.py; README "Static
     # analysis"): transfer guard + sharding-contract assertion
     python -m tensorflow_distributed_tpu.cli --train-steps 100 --check true

@@ -118,6 +118,37 @@ class ObserveConfig:
     # same per-layer health records. Transformer families except
     # pipelined_lm (its stages run inside a manual shard_map).
     health_taps: bool = False
+    # --- serve observatory (mode=serve; README "Serve tracing & SLO
+    # monitoring"). With mode=serve, --observe.trace writes the
+    # PER-REQUEST Perfetto trace (observe/serve_trace.py: one async
+    # span tree per request, recovery instants, counter tracks)
+    # instead of the training host-phase trace. -------------------------
+    # Declared SLO targets (observe/slo.py grammar):
+    # "high:ttft_p95=100ms,tok_p50=30ms;standard:ttft_p95=500ms" —
+    # ";"-separated class groups, an entry with no class prefix
+    # applies to every request. Arms the live burn-rate monitor:
+    # slo_alert/slo_ok JSONL events + error-budget accounting.
+    slo: str = ""
+    # Burn-rate windows in DECODE STEPS, "fast,slow" (the 1m/10m
+    # multi-window shape at ~1 step/s, on the deterministic
+    # decode-step clock).
+    slo_windows: str = "60,600"
+    # Burn-rate alert threshold: alert when BOTH windows burn error
+    # budget faster than this multiple of the sustainable rate.
+    slo_burn: float = 1.0
+    # Periodic one-line live status print cadence in decode steps
+    # (occupancy, queue, tokens/s, per-target window percentile +
+    # budget burn). 0 = the fast window's length when slo is armed,
+    # off otherwise.
+    slo_status_every: int = 0
+    # Rolling-metrics snapshot cadence in seconds (scheduler clock):
+    # each snapshot is one "metrics_snapshot" JSONL record — the
+    # payload a router/fleet supervisor polls. 0 = one final snapshot
+    # only when export_path is set, nothing otherwise.
+    export_every: float = 0.0
+    # Atomic snapshot file (tmp+rename per dump): the single file a
+    # poller reads. "" = snapshots ride the JSONL sink only.
+    export_path: str = ""
 
     def validate(self) -> None:
         if self.health_every < 0:
@@ -143,6 +174,34 @@ class ObserveConfig:
             raise ValueError(
                 f"observe.peak_tflops must be >= 0, "
                 f"got {self.peak_tflops}")
+        if self.slo:
+            from tensorflow_distributed_tpu.observe.slo import (
+                parse_slo)
+            parse_slo(self.slo)  # grammar at config time
+        from tensorflow_distributed_tpu.observe.slo import parse_windows
+        parse_windows(self.slo_windows)
+        if self.slo_burn <= 0:
+            raise ValueError(
+                f"observe.slo_burn must be > 0, got {self.slo_burn}")
+        if not self.slo:
+            # The burn-rate shape knobs only matter once targets are
+            # declared — accepting them alone would be a silent no-op.
+            if self.slo_windows != "60,600":
+                raise ValueError(
+                    "observe.slo_windows has no effect without "
+                    "observe.slo; declare targets (--observe.slo)")
+            if self.slo_burn != 1.0:
+                raise ValueError(
+                    "observe.slo_burn has no effect without "
+                    "observe.slo; declare targets (--observe.slo)")
+        if self.slo_status_every < 0:
+            raise ValueError(
+                f"observe.slo_status_every must be >= 0, "
+                f"got {self.slo_status_every}")
+        if self.export_every < 0:
+            raise ValueError(
+                f"observe.export_every must be >= 0, "
+                f"got {self.export_every}")
 
 
 @dataclasses.dataclass
@@ -1190,6 +1249,34 @@ class TrainConfig:
             raise ValueError(
                 "serve.journal is written by the mode=serve "
                 "scheduler; drop the flag")
+        if self.mode != "serve":
+            if self.observe.slo:
+                raise ValueError(
+                    "observe.slo declares SERVING latency targets "
+                    "(mode=serve's live burn-rate monitor); drop the "
+                    "flag or add --mode serve")
+            if self.observe.export_every or self.observe.export_path:
+                raise ValueError(
+                    "observe.export_every/export_path dump the "
+                    "mode=serve scheduler's rolling-metrics "
+                    "snapshots; drop the flags or add --mode serve")
+            if self.observe.slo_status_every:
+                raise ValueError(
+                    "observe.slo_status_every prints the mode=serve "
+                    "scheduler's live status line; drop the flag or "
+                    "add --mode serve")
+        elif self.observe.slo:
+            # Class names in targets must be real scheduler classes —
+            # a typo'd class would silently never match a request.
+            from tensorflow_distributed_tpu.observe.slo import parse_slo
+            from tensorflow_distributed_tpu.serve.scheduler import (
+                SLO_CLASSES)
+            for tgt in parse_slo(self.observe.slo):
+                if tgt.cls and tgt.cls not in SLO_CLASSES:
+                    raise ValueError(
+                        f"observe.slo names unknown class "
+                        f"{tgt.cls!r}; have {SLO_CLASSES} (or no "
+                        f"prefix for all requests)")
         if self.mode == "generate":
             if self.model not in ("gpt_lm", "moe_lm"):
                 raise ValueError(

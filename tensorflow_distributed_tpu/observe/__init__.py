@@ -20,7 +20,14 @@ package turns every run into structured, comparable data:
 - :mod:`observe.health` — on-device per-layer training vitals (grad
   norm, update-to-param ratio, param RMS, activation-RMS taps),
   cadence-gated inside the jitted step;
-- :mod:`observe.hub` — the :class:`Observatory` the train loop drives;
+- :mod:`observe.serve_trace` — per-request async-span trees for
+  ``mode=serve`` (one Perfetto file, balanced even across a
+  supervised restart);
+- :mod:`observe.slo` — live SLO burn-rate monitor: declared
+  percentile targets, fast/slow windows on the decode-step clock,
+  ``slo_alert``/``slo_ok`` events with error-budget accounting;
+- :mod:`observe.hub` — the :class:`Observatory` the train loop drives
+  and the :class:`ServeObservatory` bundle serve/run.py drives;
 - :mod:`observe.report` — ``python -m ...observe.report metrics.jsonl``
   summarizer.
 """

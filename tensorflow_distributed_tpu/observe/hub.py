@@ -29,6 +29,84 @@ from tensorflow_distributed_tpu.observe.steptime import StepTimeBreakdown
 from tensorflow_distributed_tpu.observe.trace import ChromeTracer
 
 
+class ServeObservatory:
+    """mode=serve's observability bundle: the metrics registry (JSONL
+    sink, appended on a journal resume), the per-request
+    :class:`~..serve_trace.ServeTracer` (resumed too — one trace file
+    spans a supervised restart), the :class:`~..slo.SLOMonitor` built
+    from ``--observe.slo``, and the rolling-snapshot export knobs —
+    everything serve/run.py hands the scheduler and engine. Owns the
+    process-level installs (active registry for library-level events,
+    compiled-program registration) and tears them down in
+    :meth:`close`, mirroring the training Observatory."""
+
+    def __init__(self, ocfg, *, chief: bool = True,
+                 tags: Optional[Dict[str, Any]] = None,
+                 process_index: int = 0, resumed: bool = False):
+        from tensorflow_distributed_tpu.observe.serve_trace import (
+            ServeTracer)
+        from tensorflow_distributed_tpu.observe.slo import (
+            SLOMonitor, parse_slo, parse_windows)
+
+        sinks = []
+        if ocfg.metrics_jsonl:
+            # A journal-resumed leg APPENDS: the dead leg's records
+            # are part of the same serving story (the train-side
+            # --resume convention).
+            sinks.append(JsonlSink(ocfg.metrics_jsonl, append=resumed))
+        self.registry = MetricsRegistry(
+            sinks, enabled=chief, tags=tags or {},
+            max_records=ocfg.max_records)
+        self.tracer = None
+        if ocfg.trace:
+            self.tracer = ServeTracer(ocfg.trace, enabled=chief,
+                                      pid=process_index,
+                                      resume=resumed)
+        self.slo_monitor = None
+        self.status_every = 0
+        fast, _slow = parse_windows(ocfg.slo_windows)
+        if ocfg.slo:
+            self.slo_monitor = SLOMonitor(
+                parse_slo(ocfg.slo), fast_window=fast,
+                slow_window=_slow, burn_threshold=ocfg.slo_burn,
+                emit=self.registry.emit, tracer=self.tracer)
+            # The live status line defaults to the fast window's
+            # cadence when the monitor is armed.
+            self.status_every = ocfg.slo_status_every or fast
+        elif ocfg.slo_status_every:
+            self.status_every = ocfg.slo_status_every
+        self.export_every = ocfg.export_every
+        self.export_path = ocfg.export_path
+        # Library-level events (engine program registrations,
+        # generate's compile-cache misses) land in this run's JSONL;
+        # the program registry arms under the same sink-configured
+        # condition the training Observatory uses.
+        registry_mod.set_active(self.registry)
+        self.programs_armed = bool(sinks) and bool(ocfg.programs)
+        if self.programs_armed:
+            device_mod.set_enabled(True)
+
+    def scheduler_kwargs(self) -> Dict[str, Any]:
+        """The scheduler-facing slice of this bundle (serve/run.py
+        splats it into the Scheduler ctor)."""
+        return {
+            "registry": self.registry, "tracer": self.tracer,
+            "slo_monitor": self.slo_monitor,
+            "export_every": self.export_every,
+            "export_path": self.export_path,
+            "status_every": self.status_every,
+        }
+
+    def close(self) -> None:
+        if self.programs_armed:
+            device_mod.set_enabled(False)
+        if registry_mod.get_active() is self.registry:
+            registry_mod.set_active(None)
+        if self.tracer is not None:
+            self.tracer.close()
+        self.registry.close()
+
+
 class Observatory:
     """Run-scoped observability hub; build with :meth:`for_training`."""
 

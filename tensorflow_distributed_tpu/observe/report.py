@@ -51,11 +51,11 @@ def _mean(values: List[float]) -> float:
     return sum(values) / len(values)
 
 
-def _percentile(sorted_vals: List[float], q: float) -> float:
-    """Nearest-rank percentile (no numpy dependency here)."""
-    idx = min(len(sorted_vals) - 1,
-              max(0, round(q / 100.0 * (len(sorted_vals) - 1))))
-    return sorted_vals[idx]
+# THE percentile formula (observe/slo.py, stdlib-only): the live
+# snapshot and this post-run report must agree exactly — slobench
+# gates that equality, so there is ONE definition.
+from tensorflow_distributed_tpu.observe.slo import (  # noqa: E402
+    percentile as _percentile)
 
 
 def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
@@ -112,9 +112,50 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                     "total_new_tokens", "prefill_compiles", "retries",
                     "swaps", "swap_seconds", "seed", "trace",
                     "policy", "preemptions", "spec_tokens",
-                    "verify_steps", "accept_rate"):
+                    "verify_steps", "accept_rate",
+                    "spec_fallback_slots", "slo_alerts",
+                    "slo_budget_remaining_min", "slo_targets"):
             if key in final:
                 out[f"serve_{key}"] = final[key]
+    # Live SLO monitor events (observe/slo.py): alert/clear
+    # transitions per target plus the last reported budget state —
+    # the burn-rate story beside the latency percentiles above.
+    slo_events = [r for r in records
+                  if r.get("event") in ("slo_alert", "slo_ok")]
+    if slo_events:
+        by_target: Dict[str, Dict[str, Any]] = {}
+        for r in slo_events:
+            entry = by_target.setdefault(str(r.get("target", "?")),
+                                         {"alerts": 0, "clears": 0})
+            if r["event"] == "slo_alert":
+                entry["alerts"] += 1
+                entry["worst_burn_fast"] = max(
+                    entry.get("worst_burn_fast", 0.0),
+                    float(r.get("burn_fast", 0.0)))
+            else:
+                entry["clears"] += 1
+            if isinstance(r.get("budget_remaining"), (int, float)):
+                entry["budget_remaining"] = r["budget_remaining"]
+        out["slo"] = dict(sorted(by_target.items()))
+    # Rolling metrics snapshots (scheduler.metrics_snapshot, dumped on
+    # --observe.export-every): count + the final point-in-time view.
+    # The last snapshot is forced at run end over every completion, so
+    # its per-class p95s must AGREE with the serve_request-derived
+    # numbers above (slobench gates the equality).
+    snapshots = [r for r in records
+                 if r.get("event") == "metrics_snapshot"]
+    if snapshots:
+        out["snapshots"] = len(snapshots)
+        last = snapshots[-1]
+        keep = ("t_s", "decode_steps", "requests_done", "queue_depth",
+                "slot_occupancy", "tokens_per_sec",
+                "tokens_per_sec_window", "accept_rate", "retries",
+                "preemptions", "swaps")
+        entry = {k: last[k] for k in keep if k in last}
+        for k in sorted(last):
+            if k.startswith("ttft_ms_p"):
+                entry[k] = last[k]
+        out["snapshot_last"] = entry
     # SLO preempt-and-requeue events (policy, not failure — reported
     # apart from the Recovery section).
     preempts = [r for r in records if r.get("event") == "preempt"]
@@ -281,14 +322,16 @@ def render(summary: Dict[str, Any]) -> str:
              "serve_prefill_compiles", "serve_retries", "serve_swaps",
              "serve_swap_seconds", "serve_policy", "serve_preemptions",
              "serve_preempt_events", "serve_spec_tokens",
-             "serve_verify_steps", "serve_accept_rate", "serve_seed",
-             "serve_trace")
-    # plan/programs/health/recovery render as their own sections
+             "serve_verify_steps", "serve_accept_rate",
+             "serve_spec_fallback_slots", "serve_slo_alerts",
+             "serve_slo_budget_remaining_min", "serve_slo_targets",
+             "serve_seed", "serve_trace", "snapshots")
+    # plan/programs/health/recovery/slo render as their own sections
     # below; peak_hbm_bytes_sum renders as the Programs TOTAL row.
     sections = ("plan", "programs", "health", "peak_hbm_bytes_sum",
                 "recovery_counts", "swap_seconds_total",
                 "mesh_changes", "mesh_change_path",
-                "reshard_seconds_total")
+                "reshard_seconds_total", "slo", "snapshot_last")
     for key in order:
         if key in summary:
             lines.append(f"  {key:<22} {summary[key]}")
@@ -349,6 +392,22 @@ def render(summary: Dict[str, Any]) -> str:
         if "reshard_seconds_total" in summary:
             lines.append(f"  {'reshard_seconds_total':<28} "
                          f"{summary['reshard_seconds_total']}")
+    if "slo" in summary:
+        lines.append("SLO")
+        for target, entry in summary["slo"].items():
+            parts = [f"alerts={entry.get('alerts', 0)}"]
+            if "worst_burn_fast" in entry:
+                parts.append(
+                    f"worst_burn_fast={entry['worst_burn_fast']:.2f}")
+            if "budget_remaining" in entry:
+                parts.append(
+                    f"budget_remaining={entry['budget_remaining']}")
+            lines.append(f"  {target:<28} " + " ".join(parts))
+    if "snapshot_last" in summary:
+        lines.append("Snapshot (final)")
+        entry = summary["snapshot_last"]
+        for key in sorted(entry):
+            lines.append(f"  {key:<28} {entry[key]}")
     if "health" in summary:
         lines.append("Health")
         for module, entry in summary["health"].items():

@@ -11,10 +11,18 @@ even when the TPU tunnel is down — the exact situation where you most
 want to see what the host was doing.
 
 Events carry the standard keys: ``ph`` (phase: "X" complete span,
-"i" instant, "C" counter, "M" metadata), ``ts``/``dur`` in
-microseconds, ``name``, ``pid``/``tid``. The file is written
-tmp+rename on ``flush()``/``close()`` (idempotent), and flushed
-periodically so a killed run still leaves an openable trace.
+"i" instant, "C" counter, "M" metadata, "b"/"e" async span
+begin/end), ``ts``/``dur`` in microseconds, ``name``, ``pid``/
+``tid``. The file is written tmp+rename on ``flush()``/``close()``
+(idempotent), and flushed periodically so a killed run still leaves
+an openable trace.
+
+Async events (``async_begin``/``async_end``) exist for spans that do
+NOT nest with the call stack — a serve request's lifecycle interleaves
+with every other request's, so its queue/prefill/decode phases are
+``b``/``e`` pairs keyed by ``id`` (Perfetto groups same-``id`` events
+onto one request track). ``observe/serve_trace.py`` builds the
+per-request span trees on top of these primitives.
 """
 
 from __future__ import annotations
@@ -60,24 +68,58 @@ class ChromeTracer:
         # traced steps — far past what a human opens in Perfetto.
         self.max_events = max_events
         self.dropped = 0
+        self._ts_offset = 0.0  # microseconds; preload() moves it
+        # Async span balance across the max_events cap: counts of
+        # RECORDED (appended) vs DROPPED "b" events per (cat, name,
+        # id), so async_end can keep the file balanced — an "e" whose
+        # "b" made it into the buffer is appended even past the cap
+        # (bounded overflow: at most the spans open at the drop
+        # point), and an "e" whose "b" was dropped is dropped with it
+        # (a stray "e" would unbalance just the same).
+        self._open_b: Dict[tuple, int] = {}
+        self._dropped_b: Dict[tuple, int] = {}
         if self.enabled and process_name:
             self._events.append({
                 "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
                 "args": {"name": process_name}})
 
     def _ts(self) -> float:
-        return (self._clock() - self._t0) * 1e6  # microseconds
+        return (self._clock() - self._t0) * 1e6 + self._ts_offset
+
+    def preload(self, events: List[Dict[str, Any]],
+                gap_us: float = 1_000.0) -> None:
+        """Seed previously-written events (trace RESUME: a restarted
+        serve leg continues the dead leg's file) and shift this
+        tracer's clock so every new event lands ``gap_us`` after the
+        last preloaded one — one file, one monotone timeline across
+        process deaths."""
+        if not self.enabled or not events:
+            return
+        self._events = list(events) + self._events
+        last = max((float(e.get("ts", 0.0)) + float(e.get("dur", 0.0))
+                    for e in events), default=0.0)
+        self._ts_offset = last + gap_us
+        # Unmatched preloaded "b" spans count as OPEN here, so the
+        # caller (ServeTracer resume) can close them with async_end.
+        for ev in unbalanced_async(events):
+            if ev.get("ph") == "b":
+                key = self._async_key(ev.get("name"), ev.get("id"),
+                                      ev.get("cat"))
+                self._open_b[key] = self._open_b.get(key, 0) + 1
 
     def _tid(self) -> int:
         return threading.get_ident() & 0xFFFF
 
-    def _add(self, event: Dict[str, Any]) -> None:
-        if len(self._events) >= self.max_events:
+    def _add(self, event: Dict[str, Any], force: bool = False) -> None:
+        if len(self._events) >= self.max_events and not force:
             self.dropped += 1
             return
         self._events.append(event)
         if self._clock() - self._last_flush >= _FLUSH_INTERVAL_S:
             self.flush()
+
+    def _async_key(self, name: str, id: Any, cat: str) -> tuple:
+        return (cat, name, str(id))
 
     @contextlib.contextmanager
     def span(self, name: str, cat: str = "host",
@@ -97,6 +139,57 @@ class ChromeTracer:
             if args:
                 ev["args"] = args
             self._add(ev)
+
+    def async_begin(self, name: str, id: Any, cat: str = "host",
+                    **args: Any) -> None:
+        """Open an async ("b") span. ``id`` groups related spans onto
+        one track (Perfetto renders same-(cat, id) events together);
+        close with :meth:`async_end` using the SAME (name, id, cat).
+        Unlike :meth:`span`, begin and end may come from different
+        stack frames — the serve scheduler opens a request's queue
+        span at arrival and closes it at admission, many iterations
+        later."""
+        if not self.enabled:
+            return
+        ev: Dict[str, Any] = {
+            "ph": "b", "name": name, "cat": cat, "pid": self.pid,
+            "tid": 0, "id": str(id), "ts": round(self._ts(), 3)}
+        if args:
+            ev["args"] = args
+        key = self._async_key(name, id, cat)
+        before = len(self._events)
+        self._add(ev)
+        tally = (self._open_b if len(self._events) > before
+                 else self._dropped_b)
+        tally[key] = tally.get(key, 0) + 1
+
+    def async_end(self, name: str, id: Any, cat: str = "host",
+                  **args: Any) -> None:
+        """Close the async span opened by ``async_begin(name, id,
+        cat)``. Balance survives the ``max_events`` cap: an "e" whose
+        "b" is in the buffer is recorded even past the cap, one whose
+        "b" was dropped is dropped with it."""
+        if not self.enabled:
+            return
+        key = self._async_key(name, id, cat)
+        if self._dropped_b.get(key, 0) > 0:
+            self._dropped_b[key] -= 1
+            if not self._dropped_b[key]:
+                del self._dropped_b[key]
+            self.dropped += 1
+            return
+        if self._open_b.get(key, 0) <= 0:
+            return          # no matching begin (double-end) — a stray
+            #                 "e" would unbalance just like a stray "b"
+        self._open_b[key] -= 1
+        if not self._open_b[key]:
+            del self._open_b[key]
+        ev: Dict[str, Any] = {
+            "ph": "e", "name": name, "cat": cat, "pid": self.pid,
+            "tid": 0, "id": str(id), "ts": round(self._ts(), 3)}
+        if args:
+            ev["args"] = args
+        self._add(ev, force=True)
 
     def instant(self, name: str, cat: str = "host", **args: Any) -> None:
         if not self.enabled:
@@ -141,3 +234,26 @@ def load_trace(path: str) -> List[Dict[str, Any]]:
     """Read back a trace file's event list (tests, tooling)."""
     with open(path) as f:
         return json.load(f)["traceEvents"]
+
+
+def unbalanced_async(events: List[Dict[str, Any]]
+                     ) -> List[Dict[str, Any]]:
+    """The async "b" events with no matching "e" (same cat/name/id,
+    counted multiset-style) — the span-balance check slobench gates
+    and :class:`~..serve_trace.ServeTracer` uses to close a dead leg's
+    in-flight spans on journal resume. An "e" without a "b" also
+    counts (returned with its own ``ph``) — balance means NEITHER."""
+    open_spans: Dict[tuple, List[Dict[str, Any]]] = {}
+    stray: List[Dict[str, Any]] = []
+    for ev in events:
+        key = (ev.get("cat"), ev.get("name"), ev.get("id"))
+        if ev.get("ph") == "b":
+            open_spans.setdefault(key, []).append(ev)
+        elif ev.get("ph") == "e":
+            if open_spans.get(key):
+                open_spans[key].pop()
+            else:
+                stray.append(ev)
+    for evs in open_spans.values():
+        stray.extend(evs)
+    return stray

@@ -39,6 +39,7 @@ TTFT.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 from typing import Optional, Sequence, Tuple
 
@@ -186,7 +187,8 @@ class SlotDecodeEngine:
     def __init__(self, model, params, num_slots: int,
                  buckets: Optional[Sequence[int]] = None,
                  min_bucket: int = 16, check: bool = False,
-                 fault_plan=None, watchdog=None, spec_tokens: int = 0):
+                 fault_plan=None, watchdog=None, spec_tokens: int = 0,
+                 tracer=None):
         cfg = model.cfg
         if not cfg.causal:
             raise ValueError("SlotDecodeEngine needs a causal model")
@@ -227,7 +229,13 @@ class SlotDecodeEngine:
         # program's per-slot finiteness flags for take_bad_slots().
         self._plan = fault_plan
         self._watchdog = watchdog
+        # Per-request tracing (observe/serve_trace.py): engine
+        # dispatches land as complete spans on the engine track —
+        # decode ticks batched per STEP, prefill/insert per admission.
+        # None = zero cost.
+        self._tracer = tracer
         self._last_ok: Optional[np.ndarray] = None
+        self._last_verify_fallback: list = []
         self._step_fn = lookup_program(_compiled_step, self.model)
         self._verify_fn = (lookup_program(_compiled_verify, self.model,
                                           spec_tokens)
@@ -243,6 +251,11 @@ class SlotDecodeEngine:
 
     def _zero_cache(self):
         return zero_cache(self.model, self.params, self.num_slots)
+
+    def _span(self, name: str, **args):
+        if self._tracer is None:
+            return contextlib.nullcontext()
+        return self._tracer.engine_span(name, **args)
 
     def cache_bytes_per_slot(self) -> int:
         """HBM the decode cache spends per slot (scale leaves of an
@@ -261,16 +274,24 @@ class SlotDecodeEngine:
         """Distinct prefill programs invoked (one per bucket used)."""
         return len(self._buckets_used)
 
-    def warmup(self) -> None:
+    def warmup(self, speculator=None) -> None:
         """Dispatch every engine program once — each bucket's prefill,
-        the row insert, the decode step — against throwaway inputs,
-        then roll the cache reference back. First-dispatch cost
-        (trace/compile or persistent-cache deserialize, ~hundreds of
-        ms per program on this box) moves to startup instead of
-        landing in the first requests' TTFT — and, under a restart,
-        inside the recovery window. Host bookkeeping is untouched and
-        the pre-warmup cache object is restored, so a warmed engine is
-        byte-identical to a fresh one."""
+        the row insert, the decode step (and the verify program when
+        speculation is armed) — against throwaway inputs, then roll
+        the cache reference back. First-dispatch cost (trace/compile
+        or persistent-cache deserialize, ~hundreds of ms per program
+        on this box) moves to startup instead of landing in the first
+        requests' TTFT — and, under a restart, inside the recovery
+        window. Host bookkeeping is untouched and the pre-warmup cache
+        object is restored, so a warmed engine is byte-identical to a
+        fresh one.
+
+        ``speculator``: a draft-model speculator's mirror programs
+        (its bucketed prefills, row insert, and the proposal scan) are
+        warmed too via its own ``warmup()`` — without this, the FIRST
+        speculative round paid the draft's compiles inside the serving
+        wall (pinned by a compile-counter test in
+        tests/test_serve_observe.py)."""
         cache0 = self.cache
         for b in self.buckets:
             fn = lookup_program(_compiled_prefill, self.model, b)
@@ -292,6 +313,9 @@ class SlotDecodeEngine:
         # the decode loop
         jax.block_until_ready(out)
         self.cache = cache0
+        warm = getattr(speculator, "warmup", None)
+        if warm is not None:
+            warm()
 
     def free_slots(self):
         return [s for s in range(self.num_slots) if not self.active[s]]
@@ -312,15 +336,43 @@ class SlotDecodeEngine:
 
     def can_verify(self) -> bool:
         """Every active slot has verify write headroom (a continuation
-        resumed onto a tightly-sized cache may not — the scheduler
-        falls back to the plain decode step for those iterations)."""
+        resumed onto a tightly-sized cache may not — those slots take
+        the PLAIN path inside the verify dispatch instead; see
+        :meth:`verify_fallback_slots`)."""
         if self._verify_fn is None:
             return False
         act = self.active
         return bool((self.pos[act] + self.spec_tokens + 1
                      <= self.max_len).all())
 
-    def verify_step(self, props: np.ndarray
+    def verify_fallback_slots(self) -> Optional[list]:
+        """Which ACTIVE slots lack verify write headroom this
+        iteration. ``None`` = speculation cannot run at all
+        (``spec_tokens`` off, or a tight slot is too shallow to
+        re-feed — the scheduler takes the whole-batch plain step);
+        ``[]`` = full verify; a non-empty list = MIXED dispatch: the
+        named slots take the plain path INSIDE the verify program
+        (``verify_step``'s ``tails``) while every other slot
+        speculates — one tight slot no longer costs the whole batch
+        its speculation."""
+        if self._verify_fn is None:
+            return None
+        k = self.spec_tokens
+        out = []
+        for s in range(self.num_slots):
+            if not self.active[s]:
+                continue
+            if self.pos[s] + k + 1 <= self.max_len:
+                continue
+            if self.pos[s] < k:
+                # Too shallow to re-feed a k-token window (only
+                # possible when max_len < ~2k: a tiny user-pinned
+                # cache) — whole-batch fallback keeps correctness.
+                return None
+            out.append(s)
+        return out
+
+    def verify_step(self, props: np.ndarray, tails=None
                     ) -> Tuple[np.ndarray, np.ndarray]:
         """One SPECULATIVE decode step: verify ``props``
         [num_slots, spec_tokens] draft proposals for every slot in one
@@ -333,7 +385,23 @@ class SlotDecodeEngine:
         a rejected proposal's cache row sits PAST the slot's new
         authoritative position, and the next verify (or insert) writes
         over it before any attend can reach it — positions, not the
-        cache, are the source of truth on depth."""
+        cache, are the source of truth on depth.
+
+        **Per-slot fallback** (``tails``): a slot named by
+        :meth:`verify_fallback_slots` lacks ``pos + k + 1`` write
+        headroom, so instead of proposals it is fed its OWN last ``k``
+        accepted tokens plus the pending one at positions
+        ``pos-k .. pos`` — deterministic re-computation rewrites
+        bit-identical K/V over what the cache already holds (K/V at a
+        position depend only on that position's token and the cache
+        BELOW it, all unchanged), and the argmax at the LAST fed
+        position is exactly the plain step's next token. Same program,
+        same shapes, zero census drift; the slot retires 1 token
+        (``acc == 1``, surfaced in ``toks[s, 0]``) while every other
+        slot speculates. ``tails[s]`` must hold the slot's last
+        ``k + 1`` history tokens (ending in the pending token — the
+        scheduler's ``prompt + tokens`` tail). Which slots fell back
+        this dispatch is readable at ``last_verify_fallback``."""
         from tensorflow_distributed_tpu.serve.speculate import (
             accept_length)
         if self._verify_fn is None:
@@ -344,12 +412,34 @@ class SlotDecodeEngine:
         # graftcheck: disable=host-sync-in-loop -- normalizes the HOST
         # proposal array the speculator handed in; no device value
         props = np.asarray(props, np.int32).reshape(self.num_slots, k)
-        if (self.pos[self.active] + k + 1 > self.max_len).any():
-            raise RuntimeError(
-                "an active slot lacks verify headroom — can_verify() "
-                "is the guard (the scheduler falls back to step())")
+        tails = dict(tails or {})
+        fallback = []
+        start = self.pos.copy()
         toks_in = np.concatenate([self.tok[:, None], props], axis=1)
-        tok, pos = jnp.asarray(toks_in), jnp.asarray(self.pos)
+        for s in range(self.num_slots):
+            if not self.active[s]:
+                continue
+            if self.pos[s] + k + 1 <= self.max_len:
+                continue
+            tail = tails.get(s)
+            if tail is None or len(tail) < k + 1 or self.pos[s] < k:
+                raise RuntimeError(
+                    f"slot {s} lacks verify headroom and no usable "
+                    f"tail was provided — verify_fallback_slots() is "
+                    f"the guard (the scheduler supplies tails or "
+                    f"falls back to step())")
+            # graftcheck: disable=host-sync-in-loop -- normalizes the
+            # HOST history tail the scheduler handed in (no device
+            # value); only the rare headroom-starved slots
+            window = np.asarray(list(tail)[-(k + 1):], np.int32)
+            if window[-1] != self.tok[s]:
+                raise RuntimeError(
+                    f"slot {s} fallback tail must end in the pending "
+                    f"token {int(self.tok[s])}, got {int(window[-1])}")
+            toks_in[s] = window
+            start[s] = self.pos[s] - k
+            fallback.append(s)
+        tok, pos = jnp.asarray(toks_in), jnp.asarray(start)
         with graftcheck.transfer_guard(self._check):
             self.cache, nxt, ok = self._verify_fn(
                 self.params, self.cache, tok, pos)
@@ -364,15 +454,32 @@ class SlotDecodeEngine:
             # acceptance, streaming, and NaN containment
             return jax.device_get((nxt, ok))
 
-        if (self._watchdog is not None
-                and self._watchdog.sync_timeout_s > 0):
-            nxt, ok = self._watchdog.decode(fetch, step_no)
-        else:
-            nxt, ok = fetch()
+        with self._span("verify_step",
+                        live=int(self.active.sum()),
+                        fallback=len(fallback)):
+            if (self._watchdog is not None
+                    and self._watchdog.sync_timeout_s > 0):
+                nxt, ok = self._watchdog.decode(fetch, step_no)
+            else:
+                nxt, ok = fetch()
         self._last_ok = ok
+        # graftcheck: disable=host-sync-in-loop -- nxt is already the
+        # fetched HOST array (the one watched fetch above); this is a
+        # view, not a second sync
+        nxt = np.asarray(nxt).copy()
         acc = np.zeros((self.num_slots,), np.int32)
         for s in range(self.num_slots):
             if not self.active[s]:
+                continue
+            if s in fallback:
+                # Plain path inside the verify dispatch: the target's
+                # next token sits at the LAST fed index (after the
+                # pending token); surface it where the scheduler reads
+                # retired tokens (toks[s, :acc]).
+                nxt[s, 0] = nxt[s, k]
+                acc[s] = 1
+                self.tok[s] = nxt[s, 0]
+                self.pos[s] += 1
                 continue
             a = accept_length(props[s], nxt[s])
             acc[s] = a + 1                       # + the bonus token
@@ -380,10 +487,15 @@ class SlotDecodeEngine:
             self.pos[s] += a + 1
         self.decode_steps += 1
         self.verify_steps += 1
-        # graftcheck: disable=host-sync-in-loop -- nxt is already the
-        # fetched HOST array (the one watched fetch above); this is a
-        # view, not a second sync
-        return np.asarray(nxt), acc
+        self._last_verify_fallback = fallback
+        return nxt, acc
+
+    @property
+    def last_verify_fallback(self) -> list:
+        """Slots that took the per-slot plain path in the most recent
+        verify dispatch (the scheduler excludes them from speculation
+        accounting)."""
+        return list(self._last_verify_fallback)
 
     def prefill(self, prompt: np.ndarray, slot: int) -> int:
         """Admit a request into ``slot``: bucketed prefill, row insert,
@@ -401,14 +513,22 @@ class SlotDecodeEngine:
         padded[0, :plen] = prompt
         fn = lookup_program(_compiled_prefill, self.model, bucket)
         self._buckets_used.add(bucket)
-        row, first = fn(self.params, jnp.asarray(padded),
-                        jnp.asarray(plen, jnp.int32))
-        self.cache = _insert_row(self.cache, row,
-                                 jnp.asarray(slot, jnp.int32))
-        # graftcheck: disable=host-sync-in-loop -- the TTFT point: the
-        # first token must reach the host to be streamed; one scalar
-        # per ADMISSION, not per decode step
-        first_tok = int(jax.device_get(first)[0])
+        # The prefill span covers the whole admission wall — dispatch,
+        # row insert (nested), and the blocking first-token fetch that
+        # actually waits for the compute (dispatches are async, so a
+        # span around the calls alone would show ~0 and misattribute
+        # the wall to whatever blocks next).
+        with self._span(f"prefill_b{bucket}", slot=slot,
+                        prompt_len=plen):
+            row, first = fn(self.params, jnp.asarray(padded),
+                            jnp.asarray(plen, jnp.int32))
+            with self._span("insert_row", slot=slot):
+                self.cache = _insert_row(self.cache, row,
+                                         jnp.asarray(slot, jnp.int32))
+            # graftcheck: disable=host-sync-in-loop -- the TTFT point:
+            # the first token must reach the host to be streamed; one
+            # scalar per ADMISSION, not per decode step
+            first_tok = int(jax.device_get(first)[0])
         self.tok[slot] = first_tok
         self.pos[slot] = plen
         self.active[slot] = True
@@ -451,11 +571,12 @@ class SlotDecodeEngine:
             # contract, and the decode program stays dispatched ahead
             return jax.device_get((nxt, ok))
 
-        if (self._watchdog is not None
-                and self._watchdog.sync_timeout_s > 0):
-            nxt, ok = self._watchdog.decode(fetch, step_no)
-        else:
-            nxt, ok = fetch()
+        with self._span("decode_step", live=int(self.active.sum())):
+            if (self._watchdog is not None
+                    and self._watchdog.sync_timeout_s > 0):
+                nxt, ok = self._watchdog.decode(fetch, step_no)
+            else:
+                nxt, ok = fetch()
         self._last_ok = ok
         act = self.active
         self.tok[act] = nxt[act]

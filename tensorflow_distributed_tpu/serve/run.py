@@ -18,6 +18,14 @@ of per-request ``{"arrival_s": t}`` offsets.
 mode=eval/generate); without one the model serves FRESH-INIT params —
 a load-testing/benchmarking mode, clearly labeled in the output.
 
+Serve observatory (README "Serve tracing & SLO monitoring"):
+``--observe.trace`` writes the per-request Perfetto span tree,
+``--observe.slo`` arms the live burn-rate monitor (with a periodic
+one-line status print), and ``--observe.export-every`` /
+``--observe.export-path`` dump atomic rolling-metrics snapshots — all
+bundled by :class:`observe.hub.ServeObservatory` and continued across
+a journal resume (trace and JSONL both).
+
 Serve-under-fire wiring (README "Serving under faults"): a
 ``--resilience.fault-plan`` with serve kinds drives the scheduler's
 containment paths, ``--resilience.sync-timeout-s`` arms the decode
@@ -179,10 +187,8 @@ def serve_run(cfg: TrainConfig) -> Dict:
     cfg.validate()
     from tensorflow_distributed_tpu.observe import (
         device as observe_device)
-    from tensorflow_distributed_tpu.observe import (
-        registry as registry_mod)
-    from tensorflow_distributed_tpu.observe.registry import (
-        JsonlSink, MetricsRegistry, host_tags)
+    from tensorflow_distributed_tpu.observe.hub import ServeObservatory
+    from tensorflow_distributed_tpu.observe.registry import host_tags
     from tensorflow_distributed_tpu.parallel.mesh import (
         bootstrap, is_chief, make_mesh)
     from tensorflow_distributed_tpu.train import checkpoint as ckpt
@@ -300,25 +306,17 @@ def serve_run(cfg: TrainConfig) -> Dict:
         restored = True
     params = state.params if state.ema is None else state.ema
 
-    sinks = []
-    if cfg.observe.metrics_jsonl:
-        # A journal-resumed leg APPENDS: the dead leg's serve_request/
-        # recovery records are part of the same serving story (exactly
-        # the train-side --resume convention in observe.hub).
-        sinks.append(JsonlSink(cfg.observe.metrics_jsonl,
-                               append=resumed_journal))
-    registry = MetricsRegistry(sinks=sinks, enabled=is_chief(),
-                               tags=host_tags(mesh, cfg),
-                               max_records=cfg.observe.max_records)
-    # Install as the process's active registry so library-level events
-    # (the engine's compiled-program registrations, generate's
-    # compile-cache misses) land in this run's JSONL; arm the program
-    # registry under the same sink-configured condition the training
-    # Observatory uses.
-    registry_mod.set_active(registry)
-    programs_armed = bool(sinks) and cfg.observe.programs
-    if programs_armed:
-        observe_device.set_enabled(True)
+    # The serve observatory (observe/hub.py): metrics registry +
+    # per-request tracer + SLO monitor + snapshot export, with the
+    # process-level installs (active registry, compiled-program
+    # registration) owned and torn down in obs.close(). Trace and
+    # JSONL both continue across a journal resume.
+    tags = host_tags(mesh, cfg)
+    obs = ServeObservatory(cfg.observe, chief=is_chief(), tags=tags,
+                           process_index=int(tags.get("process_index",
+                                                      0)),
+                           resumed=resumed_journal)
+    registry = obs.registry
     on_token = None
     if cfg.serve.stream and is_chief():
         def on_token(rid: int, tok: int, done: bool) -> None:
@@ -334,17 +332,20 @@ def serve_run(cfg: TrainConfig) -> Dict:
                               buckets=buckets, check=cfg.check,
                               fault_plan=plan if plan else None,
                               watchdog=watchdog,
-                              spec_tokens=cfg.serve.spec_tokens)
+                              spec_tokens=cfg.serve.spec_tokens,
+                              tracer=obs.tracer)
     # Speculative decoding: the proposer (k-gram self-draft, or a
     # draft model mirroring the slot cache — serve/speculate.py).
     from tensorflow_distributed_tpu.serve.speculate import (
         build_speculator)
     speculator = build_speculator(cfg, model, cfg.seed + 1,
                                   cfg.serve.num_slots, buckets)
-    # Every program dispatches once BEFORE the scheduler's clock
-    # starts: first-request TTFT (and, on a supervised restart, the
-    # recovery window) pays compute, not compile/cache-load.
-    engine.warmup()
+    # Every program — the engine's AND a draft speculator's mirror —
+    # dispatches once BEFORE the scheduler's clock starts:
+    # first-request TTFT (and, on a supervised restart, the recovery
+    # window) pays compute, not compile/cache-load, and the measured
+    # serving wall (tokens/s) starts clean after warmup.
+    engine.warmup(speculator)
     reload_fn = None
     if cfg.checkpoint_dir:
         def reload_fn():
@@ -358,8 +359,12 @@ def serve_run(cfg: TrainConfig) -> Dict:
                if cfg.serve.journal else None)
     trace_name = cfg.serve.trace or (
         "file" if cfg.serve.requests else "uniform")
+    status_fn = None
+    if is_chief() and obs.status_every:
+        def status_fn(line: str) -> None:
+            print(line, flush=True)
     sched = Scheduler(engine, decode_priority=cfg.serve.decode_priority,
-                      registry=registry, on_token=on_token,
+                      on_token=on_token,
                       fault_plan=plan if plan else None,
                       journal=journal, reload_fn=reload_fn,
                       slot_retries=cfg.serve.slot_retries,
@@ -367,12 +372,14 @@ def serve_run(cfg: TrainConfig) -> Dict:
                       tenant_quota=cfg.serve.tenant_quota,
                       preempt=cfg.serve.preempt,
                       speculator=speculator,
+                      status_fn=status_fn,
                       summary_extra={"seed": cfg.seed,
                                      "trace": trace_name,
-                                     "resumed": resumed_journal})
+                                     "resumed": resumed_journal},
+                      **obs.scheduler_kwargs())
     try:
         done = sched.run(requests)
-        if programs_armed:
+        if obs.programs_armed:
             budget = observe_device.hbm_budget()
             if budget:
                 registry.emit("hbm_budget", **budget)
@@ -381,11 +388,7 @@ def serve_run(cfg: TrainConfig) -> Dict:
             journal.close()
         if watchdog is not None:
             watchdog.close()
-        if programs_armed:
-            observe_device.set_enabled(False)
-        if registry_mod.get_active() is registry:
-            registry_mod.set_active(None)
-        registry.close()
+        obs.close()
     summary = dict(sched.summary)
     ttfts = np.asarray([c.ttft_s for c in done])
     summary["ttft_ms_p50"] = round(1e3 * float(np.percentile(ttfts, 50)), 3)
@@ -435,6 +438,21 @@ def serve_run(cfg: TrainConfig) -> Dict:
                   f"swap_s={summary['swap_seconds']} "
                   f"resumed={summary['resumed']} "
                   f"ttft p99 {summary['ttft_ms_p99']}ms", flush=True)
+        if cfg.observe.slo:
+            print(f"[serve] slo monitor: "
+                  f"alerts={summary.get('slo_alerts', 0)} "
+                  f"budget_remaining_min="
+                  f"{summary.get('slo_budget_remaining_min')} "
+                  f"targets={summary.get('slo_targets')}", flush=True)
+        if cfg.observe.trace:
+            print(f"[observe] serve trace: {cfg.observe.trace} "
+                  f"(open at https://ui.perfetto.dev)", flush=True)
+        if cfg.observe.export_path:
+            print(f"[observe] metrics snapshot: "
+                  f"{cfg.observe.export_path} (atomic; rewritten "
+                  f"every {cfg.observe.export_every or 'run-end'}"
+                  f"{'s' if cfg.observe.export_every else ''})",
+                  flush=True)
         if cfg.observe.metrics_jsonl:
             print(f"[observe] serve metrics: "
                   f"{cfg.observe.metrics_jsonl} (summarize: python -m "

@@ -66,17 +66,40 @@ Serve-under-fire (all optional; zero cost unconfigured):
   train.checkpoint.restore_params) supplies fresh params; the engine
   swaps them in between steps with slots live; swap latency lands in
   the summary and a ``weight_swap`` recovery event.
+
+Serve observatory (README "Serve tracing & SLO monitoring"; all
+optional, zero cost unconfigured):
+
+- **tracer** (observe/serve_trace.py): every request becomes an async
+  span tree in one Perfetto trace (queue -> prefill -> decode),
+  quarantine/swap/preempt drop instant markers, and counter tracks
+  carry occupancy/queue/tokens-per-s/accept-rate per decode step.
+- **slo_monitor** (observe/slo.py): per-completion window accounting
+  + per-step multi-window burn-rate evaluation on the decode-step
+  clock; ``slo_alert``/``slo_ok`` records flow through the registry.
+- **metrics_snapshot() / export**: a point-in-time JSON-able view of
+  the engine (queue depth, occupancy, rolling tokens/s, per-class
+  TTFT percentiles, SLO budget state), emitted as
+  ``metrics_snapshot`` records on ``export_every`` and atomically
+  rewritten at ``export_path`` for a router/supervisor to poll.
+- **status_fn/status_every**: the periodic one-line live status
+  print.
 """
 
 from __future__ import annotations
 
 import collections
+import contextlib
 import dataclasses
+import json
+import os
 import time
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from tensorflow_distributed_tpu.observe.slo import percentile
+from tensorflow_distributed_tpu.serve.buckets import pick_bucket
 from tensorflow_distributed_tpu.serve.engine import SlotDecodeEngine
 
 #: SLO classes, best first — admission under policy="slo" prefers the
@@ -198,7 +221,9 @@ class Scheduler:
                  reload_fn=None, slot_retries: int = 2,
                  summary_extra=None, policy: str = "fifo",
                  tenant_quota: int = 0, preempt: bool = True,
-                 speculator=None):
+                 speculator=None, tracer=None, slo_monitor=None,
+                 export_every: float = 0.0, export_path: str = "",
+                 status_fn=None, status_every: int = 0):
         if decode_priority < 1:
             raise ValueError(
                 f"decode_priority must be >= 1, got {decode_priority}")
@@ -224,14 +249,31 @@ class Scheduler:
         self.tenant_quota = tenant_quota
         self.preempt = preempt
         self.speculator = speculator
+        # The serve observatory (observe/serve_trace.py + observe/
+        # slo.py + snapshot export): every hook below is None-safe so
+        # an unobserved run pays nothing.
+        self.tracer = tracer
+        self.slo_monitor = slo_monitor
+        if export_every < 0:
+            raise ValueError(
+                f"export_every must be >= 0, got {export_every}")
+        self.export_every = float(export_every)
+        self.export_path = export_path
+        self.status_fn = status_fn
+        self.status_every = int(status_every)
         # Run-identity fields (seed, trace name) merged into the
         # serve_summary RECORD so the JSONL artifact is reproducible
         # standalone (FIREBENCH re-derives workloads from it).
         self.summary_extra = dict(summary_extra or {})
+        self._snap_state: Optional[dict] = None
 
     def _emit(self, event: str, **fields) -> None:
         if self.registry is not None:
             self.registry.emit(event, **fields)
+
+    def _trace_instant(self, name: str, **args) -> None:
+        if self.tracer is not None:
+            self.tracer.instant(name, **args)
 
     # -- SLO selection helpers -------------------------------------------
 
@@ -310,10 +352,6 @@ class Scheduler:
         done: List[Completion] = []
         t0 = self.clock()
         steps_since_admit = 0
-        occupancy_sum = 0.0
-        run_steps = 0  # THIS run's decode steps (the engine counter
-        #                spans its whole lifetime — reuse would skew
-        #                the occupancy mean)
         retries: dict = {}            # rid -> quarantines survived
         preempts: dict = {}           # rid -> SLO preemptions survived
         first_seen: dict = {}         # rid -> first-token time (the
@@ -321,10 +359,27 @@ class Scheduler:
         tenant_tokens: Dict[str, int] = {}  # decoded tokens this run
         total_retries = 0
         total_preempts = 0
-        spec_stats = {"verify_steps": 0, "proposed": 0, "accepted": 0}
+        spec_stats = {"verify_steps": 0, "proposed": 0, "accepted": 0,
+                      "fallback_slots": 0}
         self._swap_seconds = 0.0
         recovery_ts: List[float] = []  # quarantine/swap times, for the
         #                                recovery-window TTFT flag
+        tracer = self.tracer
+        slo = self.slo_monitor
+        # THIS run's decode-step tallies (the engine counters span its
+        # whole lifetime — reuse would skew the occupancy mean) plus
+        # the decoded-token count, shared with metrics_snapshot().
+        tally = {"steps": 0, "occ_sum": 0.0, "decoded": 0}
+        # Rolling (t, decoded) samples for the tokens/s counter track
+        # and the snapshot's windowed rate.
+        rate_win: collections.deque = collections.deque(maxlen=64)
+        self._snap_state = {
+            "t0": t0, "tally": tally, "rate_win": rate_win,
+            "queue": queue, "live": live, "done": done,
+            "pending": pending, "retries_map": retries,
+            "preempts_map": preempts, "spec_stats": spec_stats,
+        }
+        self._last_export = t0
 
         def now() -> float:
             return self.clock() - t0
@@ -362,6 +417,12 @@ class Scheduler:
                 recovery_window=window,
                 decoded=len(lv.tokens))
             done.append(comp)
+            if slo is not None:
+                slo.observe(comp.slo, 1e3 * comp.ttft_s, comp.tok_ms,
+                            tally["steps"])
+            if tracer is not None:
+                tracer.request_done(comp.rid, why, len(comp.tokens),
+                                    1e3 * comp.ttft_s)
             self._emit("serve_request", rid=comp.rid,
                        prompt_len=comp.prompt_len,
                        new_tokens=len(comp.tokens), finish=why,
@@ -386,7 +447,13 @@ class Scheduler:
         def admit() -> None:
             req = queue.pop(self._pick_index(queue, tenant_tokens))
             slot = eng.free_slots()[0]
-            first = eng.prefill(req.prompt, slot)
+            ctx = (tracer.prefill(req.rid,
+                                  pick_bucket(len(req.prompt),
+                                              eng.buckets), slot)
+                   if tracer is not None else contextlib.nullcontext())
+            with ctx:
+                first = eng.prefill(req.prompt, slot)
+            tally["decoded"] += 1
             if spec is not None:
                 spec.observe_admit(slot, req.prompt, first)
             base = list(getattr(req, "_base_tokens", ()))
@@ -467,6 +534,10 @@ class Scheduler:
             recovery_ts.append(t)
             self._emit("recovery", kind="slot_quarantine", rid=rid,
                        slot=lv.slot, retry=n, t_s=round(t, 4))
+            if tracer is not None:
+                tracer.instant("slot_quarantine", rid=rid,
+                               slot=lv.slot, retry=n)
+                tracer.request_evicted(rid, "quarantine")
             # graftcheck: disable=host-sync-in-loop -- builds the
             # continuation prompt from HOST token lists (no device
             # value involved); runs once per quarantine, not per step
@@ -502,6 +573,10 @@ class Scheduler:
                        slo=lv.req.slo, tenant=lv.req.tenant,
                        served=len(lv.base) + len(lv.tokens),
                        t_s=round(now(), 4))
+            if tracer is not None:
+                tracer.instant("preempt", cat="policy", rid=rid,
+                               slot=lv.slot, slo=lv.req.slo)
+                tracer.request_evicted(rid, "preempt")
 
         while pending or queue or live:
             # Open-loop arrivals: everything whose time has come.
@@ -509,6 +584,10 @@ class Scheduler:
                 req = pending.popleft()
                 req._waited = 0
                 queue.append(req)
+                if tracer is not None:
+                    tracer.request_queued(req.rid, slo=req.slo,
+                                          prompt_len=len(req.prompt),
+                                          tenant=req.tenant)
             if queue and eng.free_slots() and (
                     not live or steps_since_admit
                     >= self.decode_priority):
@@ -554,11 +633,22 @@ class Scheduler:
                     self._swap(now, recovery_ts)
                 plan.maybe_signal(nstep)
             # ONE program dispatch, one host fetch — speculative when
-            # armed and every active slot has verify headroom, plain
-            # otherwise. ``emitted`` maps slot -> the tokens the
-            # target model produced this dispatch, in order.
-            if (spec is not None
-                    and getattr(eng, "can_verify", lambda: False)()):
+            # armed, plain otherwise. ``emitted`` maps slot -> the
+            # tokens the target model produced this dispatch, in
+            # order. ``fb`` is the verify plan: None = whole-batch
+            # plain step, [] = full verify, a slot list = MIXED
+            # dispatch (those slots take the plain path INSIDE the
+            # verify program — engine.verify_fallback_slots; fake
+            # engines that only implement can_verify() keep the old
+            # all-or-nothing semantics).
+            fb = None
+            if spec is not None:
+                fb_fn = getattr(eng, "verify_fallback_slots", None)
+                if fb_fn is not None:
+                    fb = fb_fn()
+                elif getattr(eng, "can_verify", lambda: False)():
+                    fb = []
+            if fb is not None:
                 # Full per-slot histories are O(prompt + decoded) host
                 # work per step — built only for proposers that read
                 # them (the k-gram self-draft; a draft MODEL's cache
@@ -568,22 +658,35 @@ class Scheduler:
                          if getattr(spec, "needs_histories", True)
                          else {s: () for s in live})
                 props = spec.propose(hists)
-                toks, acc = eng.verify_step(props)
+                if fb:
+                    # graftcheck: disable=host-sync-in-loop -- builds
+                    # the fallback slots' HOST history tails (no
+                    # device value); only tight slots, only the rare
+                    # headroom-starved iterations
+                    tails = {s: list(map(int, live[s].req.prompt))
+                             + live[s].tokens for s in fb}
+                    toks, acc = eng.verify_step(props, tails=tails)
+                else:
+                    toks, acc = eng.verify_step(props)
+                fb_set = set(getattr(eng, "last_verify_fallback", fb))
                 emitted = {s: [int(t) for t in toks[s, :acc[s]]]
                            for s in live}
                 spec_stats["verify_steps"] += 1
+                spec_live = [s for s in live if s not in fb_set]
                 spec_stats["proposed"] += int(
-                    eng.spec_tokens * len(live))
+                    eng.spec_tokens * len(spec_live))
                 spec_stats["accepted"] += int(
-                    sum(acc[s] - 1 for s in live))
+                    sum(acc[s] - 1 for s in spec_live))
+                spec_stats["fallback_slots"] += len(
+                    fb_set & set(live))
                 spec.sync_from(eng)
             else:
                 nxt = eng.step()
                 emitted = {s: [int(nxt[s])] for s in live}
                 if spec is not None:
                     spec.sync_from(eng)
-            occupancy_sum += eng.occupancy()
-            run_steps += 1
+            tally["occ_sum"] += eng.occupancy()
+            tally["steps"] += 1
             if queue and eng.free_slots():
                 # The starvation clock: a decode step taken WHILE a
                 # queued request waited with a free slot available.
@@ -616,6 +719,7 @@ class Scheduler:
                 lv = live[slot]
                 for tok in emitted.get(slot, ()):
                     lv.tokens.append(tok)
+                    tally["decoded"] += 1
                     if self.journal is not None:
                         self.journal.token(lv.req.rid, tok, now())
                     count_token(lv.req)
@@ -629,6 +733,25 @@ class Scheduler:
                         self.on_token(lv.req.rid, tok, False)
             if self.journal is not None:
                 self.journal.flush()
+            # --- live observability, on the decode-step clock -------
+            rate_win.append((now(), tally["decoded"]))
+            if tracer is not None:
+                counters = {"slots": eng.occupancy(),
+                            "queue": float(len(queue))}
+                rate = self._window_rate()
+                if rate is not None:
+                    counters["tokens_per_s"] = round(rate, 2)
+                if spec is not None and spec_stats["proposed"]:
+                    counters["accept_rate"] = round(
+                        spec_stats["accepted"]
+                        / spec_stats["proposed"], 4)
+                tracer.counters(**counters)
+            if slo is not None:
+                slo.on_step(tally["steps"])
+            if (self.status_fn is not None and self.status_every > 0
+                    and tally["steps"] % self.status_every == 0):
+                self.status_fn(self.status_line())
+            self._maybe_export()
 
         wall = now()
         total_new = sum(len(c.tokens) for c in done)
@@ -645,8 +768,8 @@ class Scheduler:
             "wall_s": round(wall, 4),
             "tokens_per_sec": round(decoded / max(wall, 1e-9), 2),
             "mean_slot_occupancy": round(
-                occupancy_sum / max(1, run_steps), 4),
-            "decode_steps": run_steps,
+                tally["occ_sum"] / max(1, tally["steps"]), 4),
+            "decode_steps": tally["steps"],
             "prefills": eng.prefills,
             "prefill_compiles": eng.prefill_compiles,
             "buckets": ",".join(str(b) for b in eng.buckets),
@@ -665,14 +788,123 @@ class Scheduler:
                 verify_steps=spec_stats["verify_steps"],
                 spec_proposed=spec_stats["proposed"],
                 spec_accepted=spec_stats["accepted"],
+                spec_fallback_slots=spec_stats["fallback_slots"],
                 accept_rate=round(
                     spec_stats["accepted"]
                     / max(1, spec_stats["proposed"]), 4))
+        if slo is not None:
+            summary.update(slo.summary())
         self._emit("serve_summary", **summary)
         self.summary = summary
+        # One FINAL snapshot covering every completion, so the export
+        # artifact's last point agrees exactly with the post-run
+        # report's per-class percentiles (slobench gates this).
+        if self.export_every or self.export_path:
+            self._maybe_export(force=True)
         if self.journal is not None:
             self.journal.flush()
         return done
+
+    # -- exportable rolling metrics ---------------------------------------
+
+    def _window_rate(self) -> Optional[float]:
+        """Decoded tokens/s over the rolling rate window (None until
+        two samples exist)."""
+        st = self._snap_state
+        if st is None or len(st["rate_win"]) < 2:
+            return None
+        (ta, da), (tb, db) = st["rate_win"][0], st["rate_win"][-1]
+        if tb <= ta:
+            return None
+        return (db - da) / (tb - ta)
+
+    def metrics_snapshot(self) -> Dict[str, Any]:
+        """Atomic point-in-time view of the serving engine — the exact
+        payload a router / fleet supervisor polls (``--observe.
+        export-every`` dumps it; ROADMAP item 1's replica router and
+        item 5's Fleetbench read these fields). Callable between
+        decode steps and after :meth:`run` returns; everything is a
+        plain JSON-able scalar. Per-class TTFT percentiles use the
+        same nearest-rank formula as ``observe.report``, so the final
+        snapshot agrees exactly with the post-run report."""
+        st = self._snap_state
+        if st is None:
+            raise RuntimeError(
+                "metrics_snapshot() is available once run() has "
+                "started")
+        tally = st["tally"]
+        now = self.clock() - st["t0"]
+        snap: Dict[str, Any] = {
+            "t_s": round(now, 4),
+            "decode_steps": tally["steps"],
+            "requests_done": len(st["done"]),
+            "requests_live": len(st["live"]),
+            "queue_depth": len(st["queue"]),
+            "pending_arrivals": len(st["pending"]),
+            "slot_occupancy": round(self.engine.occupancy(), 4),
+            "mean_slot_occupancy": round(
+                tally["occ_sum"] / max(1, tally["steps"]), 4),
+            "decoded_tokens": tally["decoded"],
+            "tokens_per_sec": round(
+                tally["decoded"] / max(now, 1e-9), 2),
+            "retries": sum(st["retries_map"].values()),
+            "preemptions": sum(st["preempts_map"].values()),
+            "swaps": getattr(self.engine, "swaps", 0),
+            "policy": self.policy,
+        }
+        rate = self._window_rate()
+        if rate is not None:
+            snap["tokens_per_sec_window"] = round(rate, 2)
+        spec_stats = st["spec_stats"]
+        if self.speculator is not None and spec_stats["proposed"]:
+            snap["accept_rate"] = round(
+                spec_stats["accepted"] / spec_stats["proposed"], 4)
+        by_cls: Dict[str, List[float]] = {}
+        for c in st["done"]:
+            by_cls.setdefault(c.slo, []).append(1e3 * c.ttft_s)
+        for cls, vals in sorted(by_cls.items()):
+            vals.sort()
+            snap[f"ttft_ms_p50_{cls}"] = round(percentile(vals, 50), 3)
+            snap[f"ttft_ms_p95_{cls}"] = round(percentile(vals, 95), 3)
+        if self.slo_monitor is not None:
+            snap["slo"] = self.slo_monitor.snapshot()
+        return snap
+
+    def _maybe_export(self, force: bool = False) -> None:
+        """On the export cadence (or forced at run end): emit one
+        ``metrics_snapshot`` record through the registry (the durable
+        history) and atomically rewrite ``export_path`` (tmp+rename —
+        the single file a poller reads is always a complete
+        point-in-time snapshot, never a torn write)."""
+        if not force and not self.export_every:
+            return
+        now = self.clock()
+        if not force and now - self._last_export < self.export_every:
+            return
+        self._last_export = now
+        snap = self.metrics_snapshot()
+        self._emit("metrics_snapshot", **snap)
+        if self.export_path:
+            tmp = self.export_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(snap, f)
+            os.replace(tmp, self.export_path)
+
+    def status_line(self) -> str:
+        """The periodic one-line live status: occupancy, queue depth,
+        throughput, and (when the monitor is armed) per-target window
+        percentiles + budget burn."""
+        snap = self.metrics_snapshot()
+        rate = snap.get("tokens_per_sec_window",
+                        snap.get("tokens_per_sec", 0.0))
+        line = (f"[serve] step={snap['decode_steps']} "
+                f"occ={snap['slot_occupancy']:.2f} "
+                f"queue={snap['queue_depth']} "
+                f"done={snap['requests_done']} "
+                f"tok/s={rate:.1f}")
+        if self.slo_monitor is not None:
+            line += " | " + self.slo_monitor.status_bits()
+        return line
 
     def _swap(self, now, recovery_ts: List[float]) -> None:
         """One live weight swap: fetch fresh params via ``reload_fn``
@@ -694,3 +926,5 @@ class Scheduler:
         self._emit("recovery", kind="weight_swap",
                    seconds=round(dt, 4), ckpt_step=ckpt_step,
                    t_s=round(t, 4))
+        self._trace_instant("weight_swap", seconds=round(dt, 4),
+                            ckpt_step=ckpt_step)

@@ -141,6 +141,9 @@ class SelfDraft:
     def sync_from(self, engine):  # pragma: no cover
         pass
 
+    def warmup(self):  # pragma: no cover - nothing to compile
+        pass
+
 
 @functools.lru_cache(maxsize=8)
 def _compiled_draft(model, k: int):
@@ -248,6 +251,30 @@ class DraftSpeculator:
     def observe_free(self, slot: int) -> None:
         self.tok[slot] = 0
         self.pos[slot] = 0
+
+    def warmup(self) -> None:
+        """Dispatch every draft-mirror program once — each bucket's
+        prefill, the row insert, the proposal scan — so the FIRST
+        speculative round pays compute, not compile.
+        ``SlotDecodeEngine.warmup(speculator)`` calls this right after
+        warming its own programs; the pre-warmup cache object is
+        restored, so a warmed draft is byte-identical to a fresh one
+        (compile-counter pinned in tests/test_serve_observe.py)."""
+        cache0 = self.cache
+        for b in self.buckets:
+            fn = lookup_program(self._prefill_factory, self.model, b)
+            row, _ = fn(self.params, jnp.zeros((1, b), jnp.int32),
+                        jnp.asarray(1, jnp.int32))
+            self.cache = self._insert(self.cache, row,
+                                      jnp.asarray(0, jnp.int32))
+        out = self._propose_fn(self.params, self.cache,
+                               jnp.asarray(self.tok),
+                               jnp.asarray(self.pos))
+        # graftcheck: disable=host-sync-in-loop -- startup-only drain
+        # of the warmup dispatches; runs once per process, never in
+        # the decode loop
+        jax.block_until_ready(out)
+        self.cache = cache0
 
     def sync_from(self, engine) -> None:
         """Adopt the engine's authoritative pending token/position per
