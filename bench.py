@@ -146,6 +146,11 @@ def main(argv=None) -> None:
         # compared against a TPU trajectory unlabeled.
         "platform": platform,
     }
+    # Provenance for the regress ledger: git sha + the calibration
+    # profile id in effect (observe.registry.artifact_stamp).
+    from tensorflow_distributed_tpu.observe.registry import (
+        artifact_stamp, default_calibration_path)
+    record.update(artifact_stamp(default_calibration_path()))
     print(json.dumps(record))
     if args.out:
         from tensorflow_distributed_tpu.observe.registry import write_jsonl

@@ -249,6 +249,30 @@ if [ "$slo_rc" -ne 0 ]; then
        "$SLOLOG" >&2
 fi
 
+# Regress smoke (cross-run regression ledger — observe/regress.py):
+# every committed artifact in the manifest compared against its own
+# HEAD baseline; an untouched tree must pass CLEAN, and any slide in
+# a committed gate (goodput, token identity, pick quality, ...) fails
+# here before a human eyeballs a JSON diff. Pure stdlib/jax-free, so
+# no XLA abort-guard rerun is needed; the summary-line check below
+# still catches a silently-dead interpreter.
+REGRESSLOG="${REGRESSLOG:-/tmp/_t1_regress.log}"
+rm -f "$REGRESSLOG"
+timeout -k 10 120 python -m tensorflow_distributed_tpu.observe.regress \
+  2>&1 | tee "$REGRESSLOG"
+regress_rc="${PIPESTATUS[0]}"
+if ! grep -qa 'regress: .* checks' "$REGRESSLOG"; then
+  echo "[t1] no regress summary line in $REGRESSLOG — rerunning once" >&2
+  rm -f "$REGRESSLOG"
+  timeout -k 10 120 python -m \
+    tensorflow_distributed_tpu.observe.regress 2>&1 | tee "$REGRESSLOG"
+  regress_rc="${PIPESTATUS[0]}"
+fi
+if [ "$regress_rc" -ne 0 ]; then
+  echo "[t1] regress smoke FAILED (regress_rc=$regress_rc) — see" \
+       "$REGRESSLOG" >&2
+fi
+
 if [ "$rc" -eq 0 ] && [ "$lint_rc" -ne 0 ]; then
   echo "[t1] suite green but graftcheck red (lint_rc=$lint_rc) — see" \
        "scripts/lint.sh output above" >&2
@@ -271,5 +295,8 @@ if [ "$rc" -eq 0 ] && [ "$serve_rc" -ne 0 ]; then
 fi
 if [ "$rc" -eq 0 ] && [ "$slo_rc" -ne 0 ]; then
   exit "$slo_rc"
+fi
+if [ "$rc" -eq 0 ] && [ "$regress_rc" -ne 0 ]; then
+  exit "$regress_rc"
 fi
 exit "$rc"

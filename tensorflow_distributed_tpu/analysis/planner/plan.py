@@ -42,19 +42,28 @@ def make_plan(family: str, devices: int, batch_size: int,
               compute_dtype: str = "bfloat16",
               hw: Optional[score_lib.Hardware] = None,
               hbm_budget: Optional[float] = None,
-              overlap_conflict: Optional[str] = None) -> Dict[str, Any]:
+              overlap_conflict: Optional[str] = None,
+              calibration: str = "") -> Dict[str, Any]:
     """Enumerate + score + rank: the whole planning pass, as a dict
     (the ``plan.json`` schema). ``chosen`` is the best feasible scored
     candidate, or None when nothing is feasible. ``overlap_conflict``
     prunes the overlap strategy with that reason (see
     enumerate_candidates — apply_auto threads the run's knob
-    conflicts)."""
+    conflicts). ``calibration`` is a calibration.json path
+    (calibrate.py): its measured effective rates replace the static
+    roofline peaks (ignored when an explicit ``hw`` is passed)."""
     facts = cand_lib.model_facts(family, size, moe_experts=moe_experts)
     seq_len = seq_len or 128
     feasible, pruned = cand_lib.enumerate_candidates(
         facts, devices, batch_size, strategies=strategies,
         microbatches=microbatches, overlap_conflict=overlap_conflict)
-    hw = hw or score_lib.detect_hardware()
+    if hw is None:
+        cal = None
+        if calibration:
+            from tensorflow_distributed_tpu.analysis.planner.calibrate \
+                import load_calibration
+            cal = load_calibration(calibration)
+        hw = score_lib.detect_hardware(calibration=cal)
     rows = score_lib.score_candidates(
         feasible, facts, batch_size, hw, seq_len=seq_len, size=size,
         dropout_rate=dropout_rate, compute_dtype=compute_dtype,
@@ -154,6 +163,11 @@ def plan_record(plan: Dict[str, Any]) -> Dict[str, Any]:
         "feasible": sum(1 for r in rows if r.get("feasible")),
         "infeasible": sum(1 for r in rows if not r.get("feasible")),
         "pruned": len(plan.get("pruned", [])),
+        # Which roofline predicted: None = static tables, else the
+        # calibration profile's id (the train loop's plan_drift record
+        # and the bench stamps carry the same id).
+        "calibration_id": (plan.get("hardware") or {}).get(
+            "calibration_id"),
     }
 
 
@@ -181,7 +195,8 @@ def apply_auto(cfg) -> Dict[str, Any]:
         # Knobs the overlap launch would reject (non-elementwise
         # optimizer, grad clip, ce_chunk, ...) prune the strategy here
         # — picking it would just crash the re-validate after the plan.
-        overlap_conflict=cfg.overlap_grad_sync_conflict())
+        overlap_conflict=cfg.overlap_grad_sync_conflict(),
+        calibration=cfg.plan_calibration)
     if is_chief():
         print(render_table(plan), flush=True)
     chosen = plan["chosen"]
@@ -269,6 +284,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument("--peak-tflops", type=float, default=0.0)
     parser.add_argument("--hbm-gbps", type=float, default=0.0)
     parser.add_argument("--ici-gbps", type=float, default=0.0)
+    parser.add_argument("--calibration", default="",
+                        help="calibration.json (calibrate.py): "
+                        "measured effective rates replace the static "
+                        "table peaks; explicit --peak-tflops/"
+                        "--hbm-gbps/--ici-gbps still win")
     parser.add_argument("--out", default="plan.json",
                         help="plan JSON path ('' = don't write)")
     args = parser.parse_args(argv)
@@ -281,9 +301,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
               f"before the CLI could force a CPU topology?)",
               file=sys.stderr)
         return 2
+    cal = None
+    if args.calibration:
+        from tensorflow_distributed_tpu.analysis.planner.calibrate \
+            import load_calibration
+        cal = load_calibration(args.calibration)
     hw = score_lib.detect_hardware(
         peak_tflops=args.peak_tflops, hbm_gbps=args.hbm_gbps,
-        ici_gbps=args.ici_gbps, hbm_budget_gb=args.hbm_budget_gb)
+        ici_gbps=args.ici_gbps, hbm_budget_gb=args.hbm_budget_gb,
+        calibration=cal)
     strategies = ([s.strip() for s in args.strategies.split(",")
                    if s.strip()] or None)
     plan = make_plan(

@@ -35,6 +35,7 @@ behind lazy imports in the build path.
 from __future__ import annotations
 
 import dataclasses
+import sys
 from typing import Any, Dict, List, Optional, Sequence
 
 from tensorflow_distributed_tpu.analysis.planner.candidates import (
@@ -61,7 +62,9 @@ GENERIC_PEAK_FLOPS = 1.0e12
 class Hardware:
     """Per-device peaks the roofline divides by (plus the HBM budget
     candidates are marked infeasible against; None = unknown/no
-    budget)."""
+    budget). ``calibration_id`` names the measured profile the rates
+    came from (analysis/planner/calibrate.py) — None means the static
+    tables."""
 
     platform: str
     device_kind: str
@@ -69,6 +72,11 @@ class Hardware:
     hbm_bw: float
     ici_bw: float
     hbm_bytes: Optional[float] = None
+    calibration_id: Optional[str] = None
+    # Fixed per-dispatch launch cost a calibration profile measured
+    # (0 for the static tables): rank-neutral at fixed scale, but the
+    # difference between a ranking device and a wall-clock predictor.
+    overhead_ms: float = 0.0
 
     def as_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -76,19 +84,47 @@ class Hardware:
 
 def detect_hardware(peak_tflops: float = 0.0, hbm_gbps: float = 0.0,
                     ici_gbps: float = 0.0,
-                    hbm_budget_gb: float = 0.0) -> Hardware:
+                    hbm_budget_gb: float = 0.0,
+                    calibration: Optional[Dict[str, Any]] = None
+                    ) -> Hardware:
     """Peaks for ``jax.devices()[0]``: the known-TPU tables
     (observe.mfu.PEAK_BF16_FLOPS + TPU_HW), the device's own
-    ``memory_stats`` for capacity when it reports one, explicit
-    overrides beating both, GENERIC_HW for unknown kinds."""
+    ``memory_stats`` for capacity when it reports one, a CALIBRATION
+    profile (calibrate.load_calibration) beating the tables — measured
+    effective rates beat a fixed ratio every time, and on unknown
+    kinds they replace GENERIC_HW's arbitrary ones — and explicit
+    overrides beating everything. A profile whose platform or device
+    kind doesn't match the live device is IGNORED with a stderr note
+    (a CPU fit must never masquerade as TPU truth)."""
     import jax
 
     from tensorflow_distributed_tpu.observe import mfu
 
     dev = jax.devices()[0]
     kind = getattr(dev, "device_kind", "unknown")
+    platform = jax.default_backend()
     hbm_bw, ici_bw, hbm = TPU_HW.get(kind, GENERIC_HW)
     flops = mfu.PEAK_BF16_FLOPS.get(kind, GENERIC_PEAK_FLOPS)
+    calibration_id = None
+    overhead_ms = 0.0
+    if calibration:
+        cal_kind = calibration.get("device_kind")
+        cal_platform = calibration.get("platform")
+        if (cal_platform, cal_kind) != (platform, kind):
+            print(f"planner: ignoring calibration profile for "
+                  f"{cal_platform}/{cal_kind} on a live "
+                  f"{platform}/{kind} device", file=sys.stderr)
+        else:
+            eff = calibration.get("effective", {})
+            if isinstance(eff.get("peak_flops"), (int, float)):
+                flops = float(eff["peak_flops"])
+            if isinstance(eff.get("hbm_bw"), (int, float)):
+                hbm_bw = float(eff["hbm_bw"])
+            if isinstance(eff.get("ici_bw"), (int, float)):
+                ici_bw = float(eff["ici_bw"])
+            if isinstance(eff.get("overhead_ms"), (int, float)):
+                overhead_ms = float(eff["overhead_ms"])
+            calibration_id = calibration.get("calibration_id")
     try:
         stats = dev.memory_stats()
     except Exception:
@@ -103,9 +139,10 @@ def detect_hardware(peak_tflops: float = 0.0, hbm_gbps: float = 0.0,
         ici_bw = ici_gbps * 1e9
     if hbm_budget_gb:
         hbm = hbm_budget_gb * 1e9
-    return Hardware(platform=jax.default_backend(), device_kind=kind,
+    return Hardware(platform=platform, device_kind=kind,
                     peak_flops=flops, hbm_bw=hbm_bw, ici_bw=ici_bw,
-                    hbm_bytes=hbm)
+                    hbm_bytes=hbm, calibration_id=calibration_id,
+                    overhead_ms=overhead_ms)
 
 
 # --- the scoring math (pure; unit-tested on canned dicts) --------------
@@ -136,6 +173,8 @@ def roofline_ms(costs: Dict[str, Any], collective_bytes: float,
     collective = 1e3 * float(collective_bytes or 0.0) / hw.ici_bw
     step = (max(compute, memory, collective) if overlap
             else max(compute, memory) + collective)
+    # Calibrated per-dispatch overhead (0 for table hardware).
+    step += getattr(hw, "overhead_ms", 0.0)
     return {"compute_ms": round(compute, 6),
             "memory_ms": round(memory, 6),
             "collective_ms": round(collective, 6),

@@ -1,0 +1,260 @@
+"""CALIBBENCH: the predicted→measured loop's acceptance gate.
+
+Three claims, one artifact:
+
+1. **Calibration tightens the roofline.** Run the planbench-style
+   sweep (tiny gpt, every feasible candidate ACTUALLY EXECUTED via the
+   same builders), fit effective device rates from the (AOT costs,
+   measured step) pairs (analysis/planner/calibrate.py), and require
+   the calibrated roofline's median relative error on the sweep to be
+   STRICTLY below the uncalibrated one (GENERIC_HW on this CPU host is
+   wall-clock-meaningless by design — committed PLANBENCH predicted
+   0.26 ms where 18.6 ms was measured) AND inside ``--band`` of
+   measured.
+2. **The regress ledger bites.** Synthetically degrade a committed
+   artifact (FIREBENCH goodput halved, throughput slashed) and require
+   ``observe.regress`` to flag it; run the ledger over the committed
+   set and require it clean.
+3. **The profile is reusable.** The fitted ``calibration.json``
+   (atomic, platform/device-kind tagged, git-sha stamped) is written
+   beside the artifact — the file ``--plan-calibration`` and the
+   planner CLI's ``--calibration`` consume, and whose id stamps every
+   bench artifact regenerated after it.
+
+Emits one JSON line per phase plus ``calib_checks``; ``--out`` writes
+CALIBBENCH.json; exit 1 on any failed gate (``--no-check`` reports
+without gating).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+from typing import Any, Dict, List
+
+from tensorflow_distributed_tpu.analysis.planner.plan import init_backend
+
+
+def run_sweep(family: str, devices: int, batch: int, seq_len: int,
+              size: str, steps: int, warmup: int
+              ) -> List[Dict[str, Any]]:
+    """Execute every feasible candidate of the planner sweep and
+    return calibration samples: per-device AOT costs + measured
+    min-of-interleaved step ms (the planbench measurement discipline —
+    round-robin so host noise degrades every candidate equally)."""
+    from tensorflow_distributed_tpu.analysis.planner import (
+        candidates as cand_lib)
+    from tensorflow_distributed_tpu.analysis.planner import (
+        plan as plan_lib)
+    from tensorflow_distributed_tpu.benchmarks.planbench import (
+        _measure_round_robin, _prepare_candidate)
+
+    plan = plan_lib.make_plan(
+        family, devices, batch, size=size, seq_len=seq_len,
+        strategies=["data", "fsdp", "zero1", "expert"])
+    facts = cand_lib.model_facts(family, size)
+    pending = []
+    for row in plan["candidates"]:
+        if not row.get("feasible"):
+            continue
+        cand = cand_lib.Candidate.make(
+            row["mesh"], row["partition"],
+            microbatches=row.get("microbatches", 0))
+        try:
+            ctx = _prepare_candidate(cand, facts, batch, seq_len,
+                                     size, warmup, 0)
+        except Exception as e:
+            print(f"calibbench: candidate {row['strategy']} failed to "
+                  f"build: {type(e).__name__}: {e}", file=sys.stderr)
+            continue
+        pending.append((row, ctx))
+    _measure_round_robin([ctx for _, ctx in pending], steps)
+    samples = []
+    for row, ctx in pending:
+        walls = sorted(ctx["walls"])
+        samples.append({
+            "key": (f"{family}/b{batch}/"
+                    f"{cand_lib.format_mesh(row['mesh'])}/"
+                    f"{row['strategy']}"),
+            "flops": row.get("flops"),
+            "bytes_accessed": row.get("bytes_accessed"),
+            "collective_bytes": row.get("collective_bytes"),
+            "measured_ms": round(1e3 * walls[0], 4),
+        })
+    return samples
+
+
+def degraded_copy(name: str, scale: Dict[str, float]) -> str:
+    """A committed JSONL artifact with named metrics' values scaled —
+    the injected slowdown the regress gate must flag. Returns the
+    temp path."""
+    from tensorflow_distributed_tpu.observe.regress import (
+        REPO_ROOT, baseline_text)
+
+    text = baseline_text(name)
+    if text is None:  # working tree fallback (fresh clone, no git)
+        with open(os.path.join(REPO_ROOT, name)) as f:
+            text = f.read()
+    lines = []
+    for line in text.splitlines():
+        try:
+            rec = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            lines.append(line)
+            continue
+        if isinstance(rec, dict) and rec.get("metric") in scale \
+                and isinstance(rec.get("value"), (int, float)):
+            rec["value"] = round(rec["value"] * scale[rec["metric"]], 4)
+        lines.append(json.dumps(rec))
+    fd, path = tempfile.mkstemp(prefix="calibbench_degraded_",
+                                suffix=".json")
+    with os.fdopen(fd, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return path
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--families", default="gpt,moe",
+                        help="families swept (each adds cost-shape "
+                        "diversity to the fit)")
+    parser.add_argument("--devices", type=int, default=8)
+    parser.add_argument("--batches", default="16,64",
+                        help="global batches swept — two points per "
+                        "candidate keeps the fit from interpolating "
+                        "a single cost shape")
+    parser.add_argument("--seq-len", type=int, default=32)
+    parser.add_argument("--size", default="tiny")
+    parser.add_argument("--steps", type=int, default=10)
+    parser.add_argument("--warmup", type=int, default=2)
+    parser.add_argument("--band", type=float, default=0.35,
+                        help="calibrated median relative error must "
+                        "be within this fraction of measured")
+    parser.add_argument("--calibration-out", default="calibration.json",
+                        help="where the fitted profile lands ('' = "
+                        "don't write)")
+    parser.add_argument("--no-check", action="store_true")
+    parser.add_argument("--out", default="CALIBBENCH.json")
+    args = parser.parse_args(argv)
+
+    platform = init_backend(args.devices, tag="calibbench")
+    from tensorflow_distributed_tpu.analysis.planner import calibrate
+    from tensorflow_distributed_tpu.analysis.planner.score import (
+        detect_hardware)
+    from tensorflow_distributed_tpu.observe import regress
+    from tensorflow_distributed_tpu.observe.registry import (
+        artifact_stamp, write_jsonl)
+
+    families = [f.strip() for f in args.families.split(",")
+                if f.strip()]
+    batches = [int(b) for b in args.batches.split(",") if b.strip()]
+    samples: List[Dict[str, Any]] = []
+    for family in families:
+        for batch in batches:
+            samples.extend(run_sweep(
+                family, args.devices, batch, args.seq_len, args.size,
+                args.steps, args.warmup))
+    lines: List[Dict[str, Any]] = [{
+        "metric": "calib_sweep", "families": args.families,
+        "batches": args.batches,
+        "candidates": len(samples),
+        "samples": samples,
+    }]
+
+    # Fit + error A/B against the uncalibrated tables.
+    try:
+        fit = calibrate.fit_rates(samples)
+    except ValueError as e:
+        # Every candidate failed to build/measure: the clean one-line
+        # failure calibrate's own CLI gives, not a raw traceback.
+        print(f"calibbench: {e}", file=sys.stderr)
+        return 1
+    profile = calibrate.make_profile(
+        fit, platform,
+        detect_hardware().device_kind,
+        source=f"calibbench:{args.families}", devices=args.devices)
+    if args.calibration_out:
+        calibrate.write_calibration(profile, args.calibration_out)
+    uncal = detect_hardware()
+    cal = detect_hardware(calibration=profile)
+
+    def median(xs):
+        xs = sorted(xs)
+        return xs[len(xs) // 2] if xs else None
+
+    err_uncal = median(calibrate.rel_errors(
+        samples, uncal.peak_flops, uncal.hbm_bw, uncal.ici_bw,
+        uncal.overhead_ms))
+    err_cal = median(calibrate.rel_errors(
+        samples, cal.peak_flops, cal.hbm_bw, cal.ici_bw,
+        cal.overhead_ms))
+    lines.append({
+        "metric": "calib_fit",
+        "calibration_id": profile["calibration_id"],
+        "effective": profile["effective"],
+        "samples": fit["samples"],
+        "uncalibrated_median_rel_err": round(err_uncal, 4),
+        "calibrated_median_rel_err": round(err_cal, 4),
+        "calibration_path": args.calibration_out or None,
+    })
+
+    # Regress drills: the ledger must flag an injected slowdown and
+    # pass the committed set untouched.
+    degraded = degraded_copy("FIREBENCH.json",
+                             {"fire_goodput": 0.5,
+                              "fire_tokens_per_sec": 0.3})
+    try:
+        flagged = [f for f in regress.compare_artifact(
+            "FIREBENCH.json", fresh_path=degraded)
+            if f["verdict"] == "regression"]
+    finally:
+        os.unlink(degraded)
+    committed_findings: List[Dict[str, Any]] = []
+    for name in regress.manifest_names():
+        committed_findings.extend(regress.compare_artifact(name))
+    committed_bad = [f for f in committed_findings
+                     if f["verdict"] == "regression"]
+    lines.append({
+        "metric": "calib_regress_drill",
+        "degraded_artifact": "FIREBENCH.json",
+        "degraded_regressions": len(flagged),
+        "degraded_checks": [f["check"] for f in flagged],
+        "committed_checks": len(committed_findings),
+        "committed_regressions": len(committed_bad),
+    })
+
+    checks = {
+        "metric": "calib_checks",
+        "band": args.band,
+        "calibrated_better": bool(err_cal < err_uncal),
+        "within_band": bool(err_cal <= args.band),
+        "regress_flags_degraded": bool(flagged),
+        "regress_clean_on_committed": not committed_bad,
+    }
+    if committed_bad:
+        checks["committed_regressions"] = [
+            f"{f.get('artifact')}:{f.get('check')}"
+            for f in committed_bad]
+    lines.append(checks)
+    tags = {"devices": args.devices,
+            "seq_len": args.seq_len, "size": args.size,
+            "steps": args.steps, "platform": platform,
+            **artifact_stamp(args.calibration_out)}
+    lines = [dict(ln, **tags) for ln in lines]
+    print("\n".join(json.dumps(ln) for ln in lines))
+    if args.out:
+        write_jsonl(args.out, lines)
+    ok = (checks["calibrated_better"] and checks["within_band"]
+          and checks["regress_flags_degraded"]
+          and checks["regress_clean_on_committed"])
+    if not args.no_check and not ok:
+        print(f"calibbench: checks FAILED: {checks}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

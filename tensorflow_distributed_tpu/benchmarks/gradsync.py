@@ -312,10 +312,15 @@ def _overlap_ab(args) -> int:
     print(json.dumps(line))
 
     if args.out:
+        from tensorflow_distributed_tpu.observe.registry import (
+            artifact_stamp, default_calibration_path)
         artifact = {"meta": meta, "identity": identity, "steps": stats,
                     "exposed_comm_ms": exposed,
                     "allreduce_floor_ms": round(1e3 * floor_s, 4),
-                    "checks": checks, "tol": tol, "ok": ok}
+                    "checks": checks, "tol": tol, "ok": ok,
+                    # Provenance for the regress ledger: what built
+                    # this number, under which calibration profile.
+                    **artifact_stamp(default_calibration_path())}
         with open(args.out, "w") as f:
             json.dump(artifact, f, indent=2)
             f.write("\n")

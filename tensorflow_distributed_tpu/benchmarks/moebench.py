@@ -175,8 +175,9 @@ def main(argv=None) -> None:
     out = "\n".join(json.dumps(l) for l in lines)
     print(out)
     if args.out:
-        with open(args.out, "w") as f:
-            f.write(out + "\n")
+        from tensorflow_distributed_tpu.observe.registry import (
+            write_jsonl)
+        write_jsonl(args.out, lines)  # git-sha/calibration stamped
 
 
 if __name__ == "__main__":

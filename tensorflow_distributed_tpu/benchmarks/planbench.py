@@ -142,6 +142,10 @@ def main(argv=None) -> int:
     parser.add_argument("--pick-tol", type=float, default=0.15,
                         help="top pick must measure within this "
                         "fraction of the best measured candidate")
+    parser.add_argument("--calibration", default="",
+                        help="calibration.json whose effective rates "
+                        "replace the static roofline peaks (the "
+                        "artifact is stamped with its id)")
     parser.add_argument("--no-check", action="store_true")
     parser.add_argument("--out", default="PLANBENCH.json")
     args = parser.parse_args(argv)
@@ -153,11 +157,14 @@ def main(argv=None) -> int:
 
     strategies = [s.strip() for s in args.strategies.split(",")
                   if s.strip()]
+    from tensorflow_distributed_tpu.observe.registry import (
+        artifact_stamp)
     common_tags = {
         "devices": args.devices, "batch": args.batch,
         "seq_len": args.seq_len, "size": args.size,
         "steps": args.steps, "strategies": args.strategies,
         "platform": platform,
+        **artifact_stamp(args.calibration),
     }
     lines: List[Dict[str, Any]] = []
     checks: Dict[str, Any] = {"metric": "plan_checks",
@@ -168,7 +175,8 @@ def main(argv=None) -> int:
         plan = plan_lib.make_plan(
             family, args.devices, args.batch, size=args.size,
             seq_len=args.seq_len, strategies=strategies,
-            moe_experts=args.moe_experts)
+            moe_experts=args.moe_experts,
+            calibration=args.calibration)
         facts = cand_lib.model_facts(family, args.size,
                                      moe_experts=args.moe_experts)
         chosen = plan["chosen"]
@@ -183,6 +191,12 @@ def main(argv=None) -> int:
                 "partition": row["partition"],
                 "predicted_step_ms": row.get("step_ms"),
                 "predicted_peak_hbm_bytes": row.get("peak_hbm_bytes"),
+                # Per-device AOT costs beside the prediction they
+                # fed: the (costs, measured) pairs calibrate.py fits
+                # effective rates from.
+                "flops": row.get("flops"),
+                "bytes_accessed": row.get("bytes_accessed"),
+                "collective_bytes": row.get("collective_bytes"),
                 "feasible": bool(row.get("feasible")),
             }
             lines.append(line)

@@ -93,6 +93,18 @@ Examples:
         --mesh.data 8 --param-partition zero1 --grad-sync overlap \
         --grad-sync-bucket-mb 4 \
         --observe.metrics-jsonl /tmp/m.jsonl
+
+    # ground-truth observatory (observe/xprof.py + planner/calibrate;
+    # README "Ground-truth observatory"): the profiler window is
+    # parsed into per-program device_time records beside the compile
+    # records, --plan auto scores on measured effective rates, and a
+    # plan_drift record closes predicted -> measured at run end
+    python -m tensorflow_distributed_tpu.cli --model gpt_lm \
+        --model-size tiny --plan auto \
+        --plan-calibration calibration.json \
+        --profile-dir /tmp/prof --observe.metrics-jsonl /tmp/m.jsonl
+    # did a rerun regress any committed bench gate?
+    python -m tensorflow_distributed_tpu.observe.regress
 """
 
 from __future__ import annotations

@@ -697,6 +697,12 @@ class TrainConfig:
     # infeasible against. 0 = the device's own memory_stats limit
     # when it reports one (TPUs do; CPU hosts don't -> no budget).
     plan_hbm_budget_gb: float = 0.0
+    # Calibration profile path (analysis/planner/calibrate.py writes
+    # it; platform/device-kind tagged, git-sha stamped): its MEASURED
+    # effective rates replace the GENERIC_HW/TPU-table peaks in the
+    # planner roofline (--plan auto) and in the device-time
+    # predicted-vs-measured join (--profile-dir). "" = table rates.
+    plan_calibration: str = ""
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     # "fsdp": ZeRO-3-style sharding of params + optimizer slots over
     # the data axis (parallel.sharding.param_sharding) — memory per
@@ -1412,6 +1418,13 @@ class TrainConfig:
             raise ValueError(
                 "plan_hbm_budget_gb has no effect without --plan auto; "
                 "drop the flag")
+        if (self.plan_calibration and self.plan != "auto"
+                and not self.profile_dir):
+            # The profile feeds exactly two consumers: the planner's
+            # roofline and the profiled device-time comparison.
+            raise ValueError(
+                "plan_calibration has no effect without --plan auto "
+                "or --profile-dir; drop the flag")
         if self.plan == "auto":
             if self.mode != "train":
                 raise ValueError(

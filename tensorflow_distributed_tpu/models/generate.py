@@ -128,7 +128,6 @@ def _compiled(model, max_new_tokens: int, temperature: float,
     dataclasses, hence hashable cache keys.
     """
 
-    @jax.jit
     def run(params, prompt, key):
         P = prompt.shape[1]
         # Prefill: one pass over the prompt populates every layer cache.
@@ -163,7 +162,7 @@ def _compiled(model, max_new_tokens: int, temperature: float,
     name = f"generate_n{max_new_tokens}"
     if temperature != 0.0:
         name += f"_t{temperature:g}_k{top_k}_p{top_p:g}"
-    return observe_device.instrument(name, run)
+    return observe_device.instrument_jit(name, run)
 
 
 def generate(model, params, prompt: jax.Array, max_new_tokens: int, *,
@@ -217,7 +216,6 @@ def _compiled_beam(model, max_new_tokens: int, num_beams: int,
     pytree along the flat beam dim.
     """
 
-    @jax.jit
     def run(params, prompt):
         B, P = prompt.shape
         K = num_beams
@@ -304,7 +302,7 @@ def _compiled_beam(model, max_new_tokens: int, num_beams: int,
         seq = jnp.take_along_axis(seq, order[:, :, None], axis=1)
         return seq, jnp.take_along_axis(norm, order, axis=1)
 
-    return observe_device.instrument(
+    return observe_device.instrument_jit(
         f"beam_search_n{max_new_tokens}_k{num_beams}"
         f"_lp{length_penalty:g}_eos{eos_id}", run)
 

@@ -28,8 +28,17 @@ package turns every run into structured, comparable data:
   ``slo_alert``/``slo_ok`` events with error-budget accounting;
 - :mod:`observe.hub` — the :class:`Observatory` the train loop drives
   and the :class:`ServeObservatory` bundle serve/run.py drives;
-- :mod:`observe.report` — ``python -m ...observe.report metrics.jsonl``
-  summarizer.
+- :mod:`observe.xprof` — device-time attribution: parse the
+  profiler's Perfetto export into per-program ``device_time`` records
+  (measured device wall + collective families vs roofline predicted);
+- :mod:`observe.regress` — the cross-run regression ledger:
+  ``python -m ...observe.regress`` compares fresh bench artifacts
+  against the committed baselines, exit nonzero on regression;
+- :mod:`observe.report` — ``python -m ...observe.report metrics.jsonl
+  [more.jsonl ...]`` summarizer (multi-host streams merge, per-host
+  sections).
+
+The full record schema every module emits is documented in RECORDS.md.
 """
 
 from tensorflow_distributed_tpu.observe.goodput import (  # noqa: F401

@@ -240,6 +240,28 @@ def instrument(name: str, jitted: Callable) -> Callable:
     return _InstrumentedProgram(name, jitted)
 
 
+def named_for_trace(name: str, fn: Callable) -> Callable:
+    """Rename a PRE-jit function to its program name (sanitized —
+    observe.xprof.sanitize is the one rule) so the XLA module lowers
+    as ``jit_<program>`` and the profiler's ``hlo_module`` op tags
+    attribute straight back to the registry name. Returns ``fn``."""
+    from tensorflow_distributed_tpu.observe.xprof import sanitize
+
+    fn.__name__ = sanitize(name)
+    return fn
+
+
+def instrument_jit(name: str, fn: Callable, **jit_kwargs) -> Callable:
+    """``instrument(name, jax.jit(named_for_trace(name, fn), ...))`` —
+    THE way a framework jit site registers: one name flows to the
+    program registry, the compile record, the XLA module, and so the
+    device-time attribution (observe/xprof.py)."""
+    import jax
+
+    return instrument(name, jax.jit(named_for_trace(name, fn),
+                                    **jit_kwargs))
+
+
 def _register_from(name: str, jitted: Callable, args, kwargs) -> None:
     """AOT lower+compile for the record; exceptions degrade to a
     null-field record (e.g. a non-jit callable, or an argument set the

@@ -29,6 +29,53 @@ from tensorflow_distributed_tpu.observe.steptime import StepTimeBreakdown
 from tensorflow_distributed_tpu.observe.trace import ChromeTracer
 
 
+def _emit_device_time(registry: MetricsRegistry, profile_dir: str,
+                      calibration: str = "") -> list:
+    """Parse the profiler capture under ``profile_dir``
+    (observe/xprof.py), join each attributed program with the roofline
+    prediction from its registered compile costs (at the calibration
+    profile when one is given), and emit one ``device_time`` record
+    per program through ``registry``. The measured-vs-predicted pair
+    observe.report's "Device time" section renders. Never raises —
+    xprof degrades to explicit-null records, and anything past that is
+    swallowed (telemetry must not take down a finished run)."""
+    try:
+        from tensorflow_distributed_tpu.observe import xprof
+
+        costs = {r["program"]: r for r in device_mod.programs()
+                 if r.get("program")}
+        recs = xprof.device_time_records(profile_dir,
+                                         programs=list(costs))
+        cal = None
+        if calibration:
+            try:
+                from tensorflow_distributed_tpu.analysis.planner \
+                    .calibrate import load_calibration
+                cal = load_calibration(calibration)
+            except Exception as e:
+                # A mis-pointed profile must not die silently: the
+                # run finishes, but the user is told the device-time
+                # predictions fell back to the static tables.
+                import sys
+
+                print(f"observe: --plan-calibration {calibration}: "
+                      f"{e} — device-time predictions use the static "
+                      f"tables", file=sys.stderr)
+        hw = None
+        try:
+            from tensorflow_distributed_tpu.analysis.planner.score \
+                import detect_hardware
+            hw = detect_hardware(calibration=cal)
+        except Exception:
+            pass  # no backend — measured-only records
+        recs = xprof.with_predictions(recs, costs, hw)
+        for rec in recs:
+            registry.emit("device_time", **rec)
+        return recs
+    except Exception:
+        return []
+
+
 class ServeObservatory:
     """mode=serve's observability bundle: the metrics registry (JSONL
     sink, appended on a journal resume), the per-request
@@ -96,6 +143,13 @@ class ServeObservatory:
             "export_path": self.export_path,
             "status_every": self.status_every,
         }
+
+    def emit_device_time(self, profile_dir: str,
+                         calibration: str = "") -> list:
+        """Device-time attribution for a serve capture (see
+        :func:`_emit_device_time`) — call before :meth:`close`."""
+        return _emit_device_time(self.registry, profile_dir,
+                                 calibration)
 
     def close(self) -> None:
         if self.programs_armed:
@@ -355,6 +409,17 @@ class Observatory:
         rec = {**steps, **self._comm_fields(steps.get("step_ms_p50")),
                **self.goodput.summary(total_seconds), **fields}
         self.registry.emit("summary", **rec)
+
+    def emit_device_time(self, profile_dir: str,
+                         calibration: str = "") -> list:
+        """Device-time attribution after a profiler window closed
+        (train/loop.py calls this once the StepProfiler stopped):
+        parse the capture, join roofline predictions, emit
+        ``device_time`` records (see :func:`_emit_device_time`)."""
+        if not self.active:
+            return []
+        return _emit_device_time(self.registry, profile_dir,
+                                 calibration)
 
     # -- lifecycle --------------------------------------------------------
     def flush(self) -> None:

@@ -21,6 +21,7 @@ from __future__ import annotations
 import collections
 import hashlib
 import json
+import os
 import sys
 import threading
 import time
@@ -55,6 +56,40 @@ def host_tags(mesh: Any = None, cfg: Any = None) -> Dict[str, Any]:
     if cfg is not None:
         tags["config_hash"] = config_hash(cfg)
     return tags
+
+
+def git_sha(short: bool = True) -> Optional[str]:
+    """The repo's HEAD sha (short by default), or None outside a git
+    checkout / without git — artifacts degrade to an explicit null
+    stamp rather than failing a bench over provenance."""
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short" if short else "HEAD",
+             *(["HEAD"] if short else [])],
+            capture_output=True, text=True, timeout=10,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+    except Exception:
+        return None
+    sha = out.stdout.strip()
+    return sha if out.returncode == 0 and sha else None
+
+
+def artifact_stamp(calibration: str = "") -> Dict[str, Any]:
+    """Provenance tags every bench artifact carries (the regress
+    ledger names what changed between two artifacts with them):
+    the git sha the run was built at and the calibration-profile id
+    in effect (None when uncalibrated / unstamped). ``calibration``
+    is a calibration.json path; unreadable files degrade to None."""
+    cal_id = None
+    if calibration:
+        try:
+            with open(calibration) as f:
+                cal_id = json.load(f).get("calibration_id")
+        except Exception:
+            cal_id = None
+    return {"git_sha": git_sha(), "calibration_id": cal_id}
 
 
 class Sink:
@@ -125,12 +160,28 @@ class JsonlSink(Sink):
             self._f = None
 
 
-def write_jsonl(path: str, records: Iterable[Mapping[str, Any]]) -> None:
+def default_calibration_path() -> str:
+    """The repo-root ``calibration.json`` when one exists (the profile
+    benchmarks/calibbench.py fits and commits), else "" — the
+    calibration id benches stamp artifacts with by default."""
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))),
+        "calibration.json")
+    return path if os.path.exists(path) else ""
+
+
+def write_jsonl(path: str, records: Iterable[Mapping[str, Any]],
+                stamp: bool = True) -> None:
     """One-shot JSONL writer for benchmark outputs (overwrites — reruns
-    replace, never silently accumulate stale lines)."""
+    replace, never silently accumulate stale lines). Every record is
+    STAMPED with provenance — the git sha the bench ran at and the
+    repo calibration profile's id (explicit record keys win; nulls
+    when untracked/uncalibrated) — so the regress ledger can name what
+    changed between two artifacts."""
+    extra = artifact_stamp(default_calibration_path()) if stamp else {}
     with open(path, "w") as f:
         for rec in records:
-            f.write(json.dumps(dict(rec)) + "\n")
+            f.write(json.dumps({**extra, **dict(rec)}) + "\n")
 
 
 class CsvSink(Sink):

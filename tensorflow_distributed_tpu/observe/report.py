@@ -1,9 +1,15 @@
-"""Summarize a metrics JSONL: ``python -m tensorflow_distributed_tpu.observe.report <metrics.jsonl>``.
+"""Summarize metrics JSONLs: ``python -m tensorflow_distributed_tpu.observe.report <metrics.jsonl> [more.jsonl ...]``.
 
 Regenerates the headline numbers a BENCH artifact wants — p50/p95 step
 time, mean throughput and MFU, goodput % — from the raw JSONL the
 :mod:`observe.registry` JSONL sink wrote, so bench records can always
 be re-derived from (and audited against) the primary artifact.
+
+Multiple paths merge into ONE report (each process of a multi-host
+run writes its own host-tagged stream — registry.host_tags stamps
+``process_index`` on every record); when records from more than one
+host are present, a per-host section breaks the headline stats down
+by origin.
 
 ``--json`` prints one machine-readable JSON object instead of the
 human table.
@@ -167,7 +173,8 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         # the window's p50/p95 at that point; the last one covers the
         # run's tail — the steady state).
         for key in ("step_ms_p50", "step_ms_p95", "data_ms",
-                    "dispatch_ms", "device_ms"):
+                    "dispatch_ms", "device_ms", "comm_ms_est",
+                    "comm_exposed_ms_est"):
             vals = [r[key] for r in steps if key in r]
             if vals:
                 out[key] = round(vals[-1], 3)
@@ -249,9 +256,48 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             "feasible": p.get("feasible"),
             "infeasible": p.get("infeasible"),
         }
+        if p.get("calibration_id"):
+            entry["calibration_id"] = p["calibration_id"]
         if "step_ms_p50" in out:
             entry["measured_step_ms_p50"] = out["step_ms_p50"]
+        # Predicted -> measured drift the loop emitted at run end
+        # (train/loop.py "plan_drift"): the cost model's error on this
+        # very run, the signal a calibration refit consumes.
+        drifts = [r for r in records if r.get("event") == "plan_drift"]
+        if drifts:
+            d = drifts[-1]
+            entry["drift_ratio"] = d.get("drift_ratio")
+            entry["measured_step_ms_p50"] = d.get(
+                "measured_step_ms_p50", entry.get(
+                    "measured_step_ms_p50"))
         out["plan"] = entry
+    # Device-time attribution (observe/xprof.py "device_time"
+    # records): measured device wall per program beside its roofline
+    # prediction — the ground-truth layer. Latest record per program
+    # (or per module for unmatched ones); explicit-null parses are
+    # counted, not rendered as rows.
+    dts = [r for r in records if r.get("event") == "device_time"]
+    if dts:
+        by_prog: Dict[str, Dict[str, Any]] = {}
+        nulls = 0
+        for r in dts:
+            key = r.get("program") or r.get("module")
+            if key is None or r.get("device_ms") is None:
+                nulls += 1
+                continue
+            by_prog[str(key)] = r
+        entries = []
+        for key, r in sorted(by_prog.items(),
+                             key=lambda kv: -(kv[1].get("device_ms")
+                                              or 0)):
+            entries.append({k: r.get(k) for k in (
+                "program", "module", "device_ms",
+                "device_ms_per_call", "calls", "predicted_ms_per_call",
+                "collective_ms", "exposed_collective_ms", "coarse",
+                "calibration_id") if r.get(k) is not None})
+        out["device_time"] = entries
+        if nulls:
+            out["device_time_null_records"] = nulls
     # Compiled-program registry (observe/device.py "compile" records):
     # latest record per program — name, flops, peak-HBM estimate,
     # compile seconds — the device-side cost/memory inventory.
@@ -301,6 +347,32 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                     entry[f"{key}_last"] = round(vals[-1], 8)
             health_out[module] = entry
         out["health"] = health_out
+    # Per-host breakdown, only when records from more than one host
+    # tag are merged (multi-host runs: one JSONL per process, each
+    # stamped with its process_index by registry.host_tags).
+    hosts = sorted({r.get("process_index") for r in records
+                    if r.get("process_index") is not None})
+    if len(hosts) > 1:
+        per_host: Dict[str, Dict[str, Any]] = {}
+        for host in hosts:
+            recs = [r for r in records
+                    if r.get("process_index") == host]
+            hsteps = [r for r in recs if r.get("event") == "step"]
+            entry = {"records": len(recs)}
+            if hsteps:
+                entry["step_records"] = len(hsteps)
+                entry["last_step"] = max(int(r.get("step", 0))
+                                         for r in hsteps)
+                p50s = [r["step_ms_p50"] for r in hsteps
+                        if "step_ms_p50" in r]
+                if p50s:
+                    entry["step_ms_p50"] = round(p50s[-1], 3)
+            hserve = [r for r in recs
+                      if r.get("event") == "serve_request"]
+            if hserve:
+                entry["serve_requests"] = len(hserve)
+            per_host[str(host)] = entry
+        out["hosts"] = per_host
     return out
 
 
@@ -331,7 +403,11 @@ def render(summary: Dict[str, Any]) -> str:
     sections = ("plan", "programs", "health", "peak_hbm_bytes_sum",
                 "recovery_counts", "swap_seconds_total",
                 "mesh_changes", "mesh_change_path",
-                "reshard_seconds_total", "slo", "snapshot_last")
+                "reshard_seconds_total", "slo", "snapshot_last",
+                "device_time", "device_time_null_records", "hosts",
+                # rendered inside the Device time section, not the
+                # generic stats list (one print per number).
+                "comm_ms_est", "comm_exposed_ms_est")
     for key in order:
         if key in summary:
             lines.append(f"  {key:<22} {summary[key]}")
@@ -363,6 +439,11 @@ def render(summary: Dict[str, Any]) -> str:
         lines.append(f"  {'candidates':<28} {p.get('candidates')} "
                      f"({p.get('feasible')} feasible, "
                      f"{p.get('infeasible')} infeasible)")
+        if p.get("drift_ratio") is not None:
+            drift = (f"{p['drift_ratio']}x measured/predicted")
+            if p.get("calibration_id"):
+                drift += f" (calibration {p['calibration_id']})"
+            lines.append(f"  {'drift':<28} {drift}")
     if "programs" in summary:
         lines.append("Programs")
         for p in summary["programs"]:
@@ -378,6 +459,49 @@ def render(summary: Dict[str, Any]) -> str:
             lines.append(f"  {'TOTAL (all resident)':<28} "
                          f"peak_hbm="
                          f"{_device.human_bytes(summary['peak_hbm_bytes_sum'])}")
+    if "device_time" in summary:
+        lines.append("Device time")
+        for e in summary["device_time"]:
+            name = e.get("program") or e.get("module") or "?"
+            meas = e.get("device_ms_per_call")
+            pred = e.get("predicted_ms_per_call")
+            parts = []
+            if meas is not None:
+                parts.append(f"measured={meas}ms/call"
+                             + (f" x{e['calls']}" if e.get("calls")
+                                else ""))
+            elif e.get("device_ms") is not None:
+                parts.append(f"total={e['device_ms']}ms")
+            if pred is not None:
+                parts.append(f"predicted={pred}ms")
+                if isinstance(meas, (int, float)) and pred:
+                    parts.append(f"ratio={meas / pred:.2f}")
+            if e.get("collective_ms"):
+                parts.append(f"comm={e['collective_ms']}ms"
+                             f"(exposed "
+                             f"{e.get('exposed_collective_ms')}ms)")
+            if e.get("coarse"):
+                parts.append("[coarse]")
+            lines.append(f"  {name:<28} " + " ".join(parts))
+        for key in ("comm_ms_est", "comm_exposed_ms_est"):
+            # The overlap grad-sync ESTIMATES next to the trace-derived
+            # measurement above — predicted vs ground truth for the
+            # exposed-comm story too.
+            if key in summary:
+                lines.append(f"  {key:<28} {summary[key]}ms "
+                             f"(step-record estimate)")
+        if "device_time_null_records" in summary:
+            lines.append(f"  {'null_records':<28} "
+                         f"{summary['device_time_null_records']} "
+                         f"(absent/coarse profiler data)")
+    if "hosts" in summary:
+        lines.append("Hosts")
+        for host, entry in summary["hosts"].items():
+            parts = [f"records={entry.get('records')}"]
+            for key in ("last_step", "step_ms_p50", "serve_requests"):
+                if key in entry:
+                    parts.append(f"{key}={entry[key]}")
+            lines.append(f"  process {host:<20} " + " ".join(parts))
     if "recovery_counts" in summary:
         lines.append("Recovery")
         for kind, n in summary["recovery_counts"].items():
@@ -431,13 +555,17 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="tensorflow_distributed_tpu.observe.report",
         description=__doc__)
-    parser.add_argument("jsonl", help="metrics JSONL written by the "
-                        "observe JSONL sink")
+    parser.add_argument("jsonl", nargs="+",
+                        help="metrics JSONL(s) written by the observe "
+                        "JSONL sink — multiple host-tagged streams "
+                        "merge into one report")
     parser.add_argument("--json", action="store_true",
                         help="print one JSON object instead of text")
     args = parser.parse_args(argv)
     try:
-        records = load_records(args.jsonl)
+        records = []
+        for path in args.jsonl:
+            records.extend(load_records(path))
     except (OSError, ValueError) as e:
         print(f"observe.report: {e}", file=sys.stderr)
         return 1

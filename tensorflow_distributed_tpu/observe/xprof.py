@@ -1,0 +1,332 @@
+"""Device-time attribution: parse the profiler's trace, charge wall
+time to programs.
+
+``utils/profiling.py`` captures a step-windowed ``jax.profiler`` trace;
+until now the artifact was write-only — a TensorBoard/Perfetto file a
+human might open. This module closes the loop: it parses the captured
+``.trace.json.gz`` (the Perfetto-format export ``start_trace(...,
+create_perfetto_trace=True)`` writes beside the XPlane) and attributes
+device wall time per INSTRUMENTED PROGRAM NAME (train_step,
+serve_decode_step, serve_prefill_b*, ...) and per collective family,
+emitting one ``device_time`` JSONL record per program beside the
+``compile`` records the program registry (observe/device.py) already
+writes. Predicted (roofline-from-cost_analysis) and measured
+(trace-derived) step time finally sit in the same artifact — the
+ground truth the planner's calibration loop
+(analysis/planner/calibrate.py) fits against.
+
+Attribution key: every op event in the trace carries
+``args.hlo_module`` — the XLA module name, ``jit_<fn.__name__>`` —
+and observe.device.instrument_jit names the pre-jit function after the
+program, so module names match registry names exactly. Per-module
+device time is the UNION of op-event intervals (ops run concurrently
+across device lanes / host threadpool threads; summing would
+double-count), op_ms is the plain sum, and collective time is split by
+HLO family (all-reduce, all-gather, reduce-scatter, collective-permute,
+all-to-all) with an EXPOSED slice: collective wall not overlapped by
+any non-collective op of the same module — the measured counterpart of
+the overlap grad-sync's ``comm_exposed_ms_est``.
+
+Degradation is a contract (the registry's): a missing trace, a
+backend that wrote no attributable op events, or any parse failure
+yields records whose measurement fields are explicitly ``None`` (with
+a ``reason``), never an exception into the run. On CPU there is no
+device timeline — op events come from the host threadpool — so
+records are tagged ``coarse: true``; the numbers are real XLA
+execution walls, but host-scheduling noise rides them.
+
+Pure stdlib on purpose: the parse tier (and its tests) runs jax-free.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import re
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+#: HLO op-name prefix -> collective family (record field suffix).
+COLLECTIVE_FAMILIES = (
+    ("all-reduce", "all_reduce"),
+    ("all-gather", "all_gather"),
+    ("reduce-scatter", "reduce_scatter"),
+    ("collective-permute", "collective_permute"),
+    ("all-to-all", "all_to_all"),
+)
+
+#: Every measurement field a device_time record carries, in record
+#: order — explicitly None when the trace yields nothing (the
+#: compile-record contract: stable SHAPE everywhere).
+DEVICE_TIME_FIELDS = (
+    "device_ms", "device_ms_per_call", "op_ms", "calls",
+    "collective_ms", "exposed_collective_ms",
+)
+
+
+def sanitize(name: str) -> str:
+    """The trace-name normalization instrument_jit applies to
+    ``fn.__name__`` (XLA module names come from it): one place, so
+    attribution can re-apply it when matching registry names."""
+    return re.sub(r"[^0-9A-Za-z_]", "_", name)
+
+
+def find_trace_file(log_dir: str) -> Optional[str]:
+    """Newest captured Perfetto trace under a ``jax.profiler`` log
+    dir (``plugins/profile/<run>/<host>.trace.json.gz``). None when
+    nothing was captured."""
+    runs = sorted(glob.glob(os.path.join(
+        log_dir, "plugins", "profile", "*")))
+    for run in reversed(runs):  # newest run dir first (timestamp names)
+        files = sorted(glob.glob(os.path.join(run, "*.trace.json.gz")))
+        named = [f for f in files
+                 if not f.endswith("perfetto_trace.json.gz")]
+        if named or files:
+            return (named or files)[0]
+    return None
+
+
+def load_trace_events(path: str) -> List[Dict[str, Any]]:
+    """Event list of one Chrome/Perfetto trace file (.json or
+    .json.gz)."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        data = json.load(f)
+    if isinstance(data, dict):
+        return list(data.get("traceEvents", []))
+    return list(data)  # bare event-array form
+
+
+def _union_us(intervals: List[Tuple[float, float]]) -> float:
+    """Total length of the union of [start, end) intervals (µs)."""
+    total, cur_s, cur_e = 0.0, None, None
+    for s, e in sorted(intervals):
+        if cur_e is None or s > cur_e:
+            if cur_e is not None:
+                total += cur_e - cur_s
+            cur_s, cur_e = s, e
+        else:
+            cur_e = max(cur_e, e)
+    if cur_e is not None:
+        total += cur_e - cur_s
+    return total
+
+
+def _overlap_us(a: List[Tuple[float, float]],
+                b: List[Tuple[float, float]]) -> float:
+    """Length of union(a) ∩ union(b) (µs) — two-pointer merge over the
+    already-unioned interval lists."""
+    def merged(iv):
+        out: List[List[float]] = []
+        for s, e in sorted(iv):
+            if out and s <= out[-1][1]:
+                out[-1][1] = max(out[-1][1], e)
+            else:
+                out.append([s, e])
+        return out
+
+    xs, ys = merged(a), merged(b)
+    i = j = 0
+    total = 0.0
+    while i < len(xs) and j < len(ys):
+        s = max(xs[i][0], ys[j][0])
+        e = min(xs[i][1], ys[j][1])
+        if e > s:
+            total += e - s
+        if xs[i][1] <= ys[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
+def _collective_family(op: str) -> Optional[str]:
+    low = op.lower()
+    for prefix, family in COLLECTIVE_FAMILIES:
+        if low.startswith(prefix):
+            return family
+    return None
+
+
+def attribute(events: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
+    """Per-HLO-module device-time attribution over one trace's events.
+
+    Returns ``{"coarse": bool, "modules": {module: entry}}`` where each
+    entry carries ``wall_us`` (union of op intervals — concurrent lanes
+    counted once), ``op_us`` (plain sum), ``ops`` (event count),
+    ``calls`` (estimated invocations: the modal per-op-name occurrence
+    count — most ops run exactly once per call; ops inside scans
+    inflate their own count, not the mode), ``collective_us`` /
+    ``exposed_collective_us`` and per-family ``collective_families``.
+
+    ``coarse`` is True when no ``/device:`` process appears in the
+    trace (CPU: op events are host-threadpool walls). When device
+    processes exist, only THEIR op events are attributed — the device
+    timeline is the ground truth, host mirrors are ignored.
+    """
+    events = list(events)
+    device_pids = set()
+    for ev in events:
+        if (ev.get("ph") == "M" and ev.get("name") == "process_name"
+                and str(ev.get("args", {}).get("name", ""))
+                .startswith("/device:")):
+            device_pids.add(ev.get("pid"))
+    coarse = not device_pids
+
+    per: Dict[str, Dict[str, Any]] = {}
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        if device_pids and ev.get("pid") not in device_pids:
+            continue
+        args = ev.get("args") or {}
+        module = args.get("hlo_module")
+        if not module:
+            continue
+        op = str(args.get("hlo_op") or ev.get("name") or "")
+        ts = float(ev.get("ts", 0.0))
+        dur = float(ev.get("dur", 0.0))
+        entry = per.setdefault(module, {
+            "intervals": [], "coll_intervals": [],
+            "compute_intervals": [], "op_us": 0.0, "ops": 0,
+            "op_counts": {}, "families": {}})
+        entry["intervals"].append((ts, ts + dur))
+        entry["op_us"] += dur
+        entry["ops"] += 1
+        entry["op_counts"][op] = entry["op_counts"].get(op, 0) + 1
+        family = _collective_family(op)
+        if family:
+            entry["coll_intervals"].append((ts, ts + dur))
+            entry["families"][family] = (
+                entry["families"].get(family, 0.0) + dur)
+        else:
+            entry["compute_intervals"].append((ts, ts + dur))
+
+    modules: Dict[str, Dict[str, Any]] = {}
+    for module, e in per.items():
+        counts = sorted(e["op_counts"].values())
+        # Modal occurrence count = invocations (ties -> smallest mode,
+        # the conservative estimate).
+        calls = 0
+        if counts:
+            best, best_n = counts[0], 0
+            for c in set(counts):
+                n = counts.count(c)
+                if n > best_n or (n == best_n and c < best):
+                    best, best_n = c, n
+            calls = best
+        coll_us = _union_us(e["coll_intervals"])
+        exposed_us = coll_us - _overlap_us(e["coll_intervals"],
+                                           e["compute_intervals"])
+        modules[module] = {
+            "wall_us": _union_us(e["intervals"]),
+            "op_us": e["op_us"],
+            "ops": e["ops"],
+            "calls": calls,
+            "collective_us": coll_us,
+            "exposed_collective_us": max(exposed_us, 0.0),
+            "collective_families": dict(sorted(e["families"].items())),
+        }
+    return {"coarse": coarse, "modules": modules}
+
+
+def match_program(module: str, programs: Iterable[str]) -> Optional[str]:
+    """Map an HLO module name back to its instrumented program name:
+    ``jit_<sanitized program>`` exactly, else the longest program whose
+    sanitized name prefixes the module stem (lowered modules sometimes
+    grow numeric suffixes)."""
+    stem = module[4:] if module.startswith("jit_") else module
+    by_sanitized = {}
+    for p in programs:
+        by_sanitized.setdefault(sanitize(p), p)
+    if stem in by_sanitized:
+        return by_sanitized[stem]
+    for s in sorted(by_sanitized, key=len, reverse=True):
+        if stem.startswith(s):
+            return by_sanitized[s]
+    return None
+
+
+def _null_record(reason: str) -> Dict[str, Any]:
+    rec: Dict[str, Any] = {"program": None, "module": None,
+                           **{k: None for k in DEVICE_TIME_FIELDS},
+                           "coarse": None, "reason": reason}
+    return rec
+
+
+def device_time_records(log_dir: str,
+                        programs: Iterable[str] = (),
+                        max_unmatched: int = 8) -> List[Dict[str, Any]]:
+    """The ``device_time`` record payloads for one capture: one record
+    per attributed module, matched against ``programs`` (registry
+    names). Unmatched modules are still reported (largest first,
+    capped) with ``program: null`` — nothing silently dropped. NEVER
+    raises; absent or unparseable traces degrade to one explicit-null
+    record with the reason."""
+    try:
+        path = find_trace_file(log_dir)
+        if path is None:
+            return [_null_record(f"no trace under {log_dir}")]
+        attr = attribute(load_trace_events(path))
+    except Exception as e:  # degrade, never die: telemetry contract
+        return [_null_record(f"{type(e).__name__}: {e}"[:300])]
+    modules = attr["modules"]
+    if not modules:
+        return [_null_record(
+            f"{os.path.basename(path)}: no attributable op events "
+            f"(profiler data absent or too coarse)")]
+    records: List[Dict[str, Any]] = []
+    unmatched = 0
+    for module, e in sorted(modules.items(),
+                            key=lambda kv: -kv[1]["wall_us"]):
+        program = match_program(module, programs)
+        if program is None:
+            unmatched += 1
+            if unmatched > max_unmatched:
+                continue
+        calls = e["calls"] or None
+        rec: Dict[str, Any] = {
+            "program": program,
+            "module": module,
+            "device_ms": round(e["wall_us"] / 1e3, 4),
+            "device_ms_per_call": (round(e["wall_us"] / 1e3 / calls, 4)
+                                   if calls else None),
+            "op_ms": round(e["op_us"] / 1e3, 4),
+            "calls": calls,
+            "collective_ms": round(e["collective_us"] / 1e3, 4),
+            "exposed_collective_ms": round(
+                e["exposed_collective_us"] / 1e3, 4),
+            "coarse": attr["coarse"],
+        }
+        for family, us in e["collective_families"].items():
+            rec[f"coll_{family}_ms"] = round(us / 1e3, 4)
+        records.append(rec)
+    return records
+
+
+def with_predictions(records: List[Dict[str, Any]],
+                     costs_by_program: Dict[str, Dict[str, Any]],
+                     hw: Any = None) -> List[Dict[str, Any]]:
+    """Join measured records with each program's roofline prediction
+    from its compile-record costs (analysis.planner.score.roofline_ms
+    at ``hw``) — the measured-vs-predicted pair observe.report's
+    "Device time" section renders and calibrate.py fits. Pure function
+    over dicts; records without costs (or a null hw) pass through
+    unchanged."""
+    if hw is None:
+        return records
+    from tensorflow_distributed_tpu.analysis.planner.score import (
+        roofline_ms)
+
+    out = []
+    for rec in records:
+        rec = dict(rec)
+        costs = costs_by_program.get(rec.get("program") or "")
+        if costs:
+            pred = roofline_ms(costs, 0.0, hw)
+            rec["predicted_ms_per_call"] = pred["step_ms"]
+            if getattr(hw, "calibration_id", None):
+                rec["calibration_id"] = hw.calibration_id
+        out.append(rec)
+    return out

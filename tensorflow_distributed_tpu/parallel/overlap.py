@@ -650,11 +650,10 @@ def make_explicit_train_step(mesh: Mesh, state_template: TrainState,
         step.bucket_plan = plan
         return step
     with mesh:
-        wrapped = observe_device.instrument(
-            f"train_step_{grad_sync}", jax.jit(
-                step,
-                in_shardings=(None, batch_shardings),
-                donate_argnums=(0,) if donate else (),
-            ))
+        wrapped = observe_device.instrument_jit(
+            f"train_step_{grad_sync}", step,
+            in_shardings=(None, batch_shardings),
+            donate_argnums=(0,) if donate else (),
+        )
     wrapped.bucket_plan = plan
     return wrapped

@@ -63,14 +63,13 @@ def _compiled_prefill(model, bucket: int):
     scalar, so every prompt length sharing a bucket shares the
     executable."""
 
-    @jax.jit
     def run(params, prompt, true_len):
         logits, cache = prefill_cache(model, params, prompt)
         last = jax.lax.dynamic_index_in_dim(
             logits, true_len - 1, axis=1, keepdims=False)   # [1, V]
         return cache, jnp.argmax(last, axis=-1).astype(jnp.int32)
 
-    return observe_device.instrument(f"serve_prefill_b{bucket}", run)
+    return observe_device.instrument_jit(f"serve_prefill_b{bucket}", run)
 
 
 @functools.lru_cache(maxsize=8)
@@ -87,7 +86,6 @@ def _compiled_verify(model, k: int):
     executable for the engine's lifetime, censused as ``serve_verify``
     in the jaxpr goldens."""
 
-    @jax.jit
     def run(params, cache, toks, pos):
         # toks [S, k+1] (pending token + proposals), pos [S].
         positions = pos[:, None] + jnp.arange(k + 1)[None, :]
@@ -98,7 +96,7 @@ def _compiled_verify(model, k: int):
         ok = jnp.isfinite(logits).all(axis=(-1, -2))
         return state["cache"], nxt, ok
 
-    return observe_device.instrument(f"serve_verify_k{k}", run)
+    return observe_device.instrument_jit(f"serve_verify_k{k}", run)
 
 
 @functools.lru_cache(maxsize=8)
@@ -112,16 +110,14 @@ def _compiled_step(model):
     Compiled once per (model, num_slots) — the shapes come from the
     arguments, so one engine reuses one executable forever."""
 
-    @jax.jit
     def run(params, cache, tok, pos):
         last, cache = decode_token(model, params, cache, tok, pos)
         ok = jnp.isfinite(last).all(axis=-1)
         return cache, jnp.argmax(last, axis=-1).astype(jnp.int32), ok
 
-    return observe_device.instrument("serve_decode_step", run)
+    return observe_device.instrument_jit("serve_decode_step", run)
 
 
-@jax.jit
 def _insert_row_jit(cache, row, slot):
     """Drop a prefilled [1, ...] cache row into ``slot`` of the engine
     cache — ``slot`` is traced, so all slots share the program. Scalar
@@ -137,8 +133,8 @@ def _insert_row_jit(cache, row, slot):
     return jax.tree_util.tree_map(put, cache, row)
 
 
-_insert_row = observe_device.instrument("serve_insert_row",
-                                        _insert_row_jit)
+_insert_row = observe_device.instrument_jit("serve_insert_row",
+                                            _insert_row_jit)
 
 
 @jax.jit

@@ -378,7 +378,20 @@ def serve_run(cfg: TrainConfig) -> Dict:
                                      "resumed": resumed_journal},
                       **obs.scheduler_kwargs())
     try:
-        done = sched.run(requests)
+        if cfg.profile_dir and is_chief():
+            # Whole-serving-window capture (warmup already dispatched
+            # every program, so the trace is steady-state serving):
+            # the Perfetto export is parsed below into device_time
+            # records per engine program (decode/verify/prefill
+            # buckets/insert) — observe/xprof.py.
+            from tensorflow_distributed_tpu.utils.profiling import (
+                trace as profile_trace)
+            with profile_trace(cfg.profile_dir):
+                done = sched.run(requests)
+            obs.emit_device_time(cfg.profile_dir,
+                                 calibration=cfg.plan_calibration)
+        else:
+            done = sched.run(requests)
         if obs.programs_armed:
             budget = observe_device.hbm_budget()
             if budget:

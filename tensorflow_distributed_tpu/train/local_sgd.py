@@ -165,7 +165,7 @@ def make_local_sgd_train_step(mesh: Mesh, sync_every: int, seed: int = 0,
     with mesh:
         from tensorflow_distributed_tpu.observe import (
             device as observe_device)
-        return observe_device.instrument(
-            "local_sgd_step",
-            jax.jit(step, in_shardings=(None, batch_shardings),
-                    donate_argnums=(0,) if donate else ()))
+        return observe_device.instrument_jit(
+            "local_sgd_step", step,
+            in_shardings=(None, batch_shardings),
+            donate_argnums=(0,) if donate else ())

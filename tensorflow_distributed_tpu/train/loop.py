@@ -373,6 +373,10 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
         # (it already did its job inside apply_auto).
         cfg.plan = ""
         cfg.plan_hbm_budget_gb = 0.0
+        if not cfg.profile_dir:
+            # Consumed by apply_auto; with a profile window it also
+            # feeds the device-time prediction join, so keep it then.
+            cfg.plan_calibration = ""
         cfg.validate()
     logger = logger or MetricLogger(enabled=is_chief(),
                                 max_records=cfg.observe.max_records)
@@ -934,6 +938,14 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
             # reason (the JSONL sink already flushes per record).
             guard.close()
             profiler.stop(pending=metrics)
+            if profiler.captured:
+                # Ground truth beside the predictions: parse the
+                # closed window's Perfetto export and emit one
+                # device_time record per attributed program
+                # (observe/xprof.py; explicit-null on absent or
+                # unusable profiler data).
+                obs.emit_device_time(cfg.profile_dir,
+                                     calibration=cfg.plan_calibration)
             obs.flush()
             if wdog is not None:
                 wdog.close()
@@ -991,6 +1003,20 @@ def train(cfg: TrainConfig, logger: Optional[MetricLogger] = None
             "images_per_sec": round(result.images_per_sec, 1),
             **{f"val_{k}": round(v, 5) for k, v in final.items()},
         })
+        if plan_rec is not None:
+            # Predicted -> measured drift for the auto-layout choice:
+            # the cost model's error on THIS run, durable next to the
+            # plan record it audits (and the signal a calibration
+            # refit consumes). Emitted only when the run measured a
+            # steady-state p50.
+            measured = obs.steptime.summary().get("step_ms_p50")
+            pred = plan_rec.get("predicted_step_ms")
+            if (isinstance(measured, (int, float))
+                    and isinstance(pred, (int, float)) and pred > 0):
+                obs.emit("plan_drift", predicted_step_ms=pred,
+                         measured_step_ms_p50=round(measured, 4),
+                         drift_ratio=round(measured / pred, 4),
+                         calibration_id=plan_rec.get("calibration_id"))
         # Final rollup: rolling step-time stats + goodput ledger (counted
         # since the Observatory was built — restores, compile, eval and
         # checkpoint stalls all charged) + steady-state throughput/MFU.

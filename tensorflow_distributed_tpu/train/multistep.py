@@ -83,9 +83,9 @@ def make_multi_step(mesh: Mesh, seed: int = 0, loss: LossFn = loss_fn,
         return state, jax.tree_util.tree_map(lambda m: m[-1], metrics)
 
     with mesh:
-        return observe_device.instrument("multi_step", jax.jit(
-            run,
+        return observe_device.instrument_jit(
+            "multi_step", run,
             in_shardings=(None, stacked_batch_shardings(mesh,
                                                         batch_shardings)),
             donate_argnums=(0,),
-        ))
+        )

@@ -217,11 +217,11 @@ def make_1f1b_train_step(model: PipelinedLM, mesh: Mesh, seed: int = 0,
         step.observe_hw_recompute = (backward == "recompute")
         return step
     with mesh:
-        jitted = observe_device.instrument("pipelined_train_step", jax.jit(
-            step,
+        jitted = observe_device.instrument_jit(
+            "pipelined_train_step", step,
             in_shardings=(None, batch_shardings),
             donate_argnums=(0,) if donate else (),
-        ))
+        )
     # Observability metadata: the recompute backward EXECUTES ~4x-forward
     # for the block stack while model-FLOPs accounting credits 3x;
     # observe.hub reads this to report hw_mfu alongside model MFU

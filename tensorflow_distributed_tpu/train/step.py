@@ -342,11 +342,11 @@ def make_train_step(mesh: Mesh, seed: int = 0, donate: bool = True,
         # program (train.multistep's scan).
         return step
     with mesh:
-        return observe_device.instrument("train_step", jax.jit(
-            step,
+        return observe_device.instrument_jit(
+            "train_step", step,
             in_shardings=(None, batch_shardings),
             donate_argnums=(0,) if donate else (),
-        ))
+        )
 
 
 def make_eval_step(mesh: Mesh, loss: LossFn = loss_fn,
@@ -367,8 +367,8 @@ def make_eval_step(mesh: Mesh, loss: LossFn = loss_fn,
         return metrics
 
     with mesh:
-        return observe_device.instrument("eval_step", jax.jit(
-            step,
+        return observe_device.instrument_jit(
+            "eval_step", step,
             in_shardings=(None, batch_shardings),
             out_shardings=replicated(mesh),
-        ))
+        )

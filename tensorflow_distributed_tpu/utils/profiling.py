@@ -7,6 +7,11 @@ profiling is a first-class switch: a step-windowed `jax.profiler`
 trace (XPlane/TensorBoard format, viewable in Perfetto) captures the
 XLA execution timeline — per-op device time, HBM traffic, and the ICI
 collectives that replaced the reference's gRPC ps round-trip.
+
+Captures also write the Perfetto JSON export
+(``create_perfetto_trace``) beside the XPlane, which is what
+``observe/xprof.py`` PARSES to attribute device wall time back to the
+instrumented programs — the capture is no longer write-only.
 """
 
 from __future__ import annotations
@@ -16,6 +21,20 @@ import dataclasses
 from typing import Iterator, Optional
 
 import jax
+
+
+def _start_trace(log_dir: str, perfetto: bool) -> None:
+    """start_trace with the Perfetto JSON export when this jax
+    supports the kwarg (older versions write XPlane only — xprof then
+    degrades to its explicit-null records)."""
+    if perfetto:
+        try:
+            jax.profiler.start_trace(log_dir,
+                                     create_perfetto_trace=True)
+            return
+        except TypeError:
+            pass
+    jax.profiler.start_trace(log_dir)
 
 
 @contextlib.contextmanager
@@ -37,6 +56,13 @@ class StepProfiler:
     log_dir: str = ""
     start_step: int = 10
     num_steps: int = 5
+    # Also write the Perfetto JSON export observe/xprof.py parses for
+    # device-time attribution (XPlane alone is write-only here).
+    perfetto: bool = True
+    # True once a window actually started — the loop's device-time
+    # emission keys on it (a run whose horizon never reached the
+    # window has nothing to parse).
+    captured: bool = dataclasses.field(default=False, init=False)
     _running: bool = dataclasses.field(default=False, init=False)
 
     def observe(self, step: int, pending=None) -> None:
@@ -54,8 +80,9 @@ class StepProfiler:
         if not self._running and in_window:
             # Window test, not equality: a resumed run whose first step
             # is already past start_step still gets (the tail of) a trace.
-            jax.profiler.start_trace(self.log_dir)
+            _start_trace(self.log_dir, self.perfetto)
             self._running = True
+            self.captured = True
         elif self._running and step >= self.start_step + self.num_steps:
             self.stop(pending)
 
@@ -74,12 +101,13 @@ class StepProfiler:
 
 
 @contextlib.contextmanager
-def trace(log_dir: Optional[str]) -> Iterator[None]:
+def trace(log_dir: Optional[str], perfetto: bool = True
+          ) -> Iterator[None]:
     """Whole-span trace: ``with trace('/tmp/tb'): run()``."""
     if not log_dir:
         yield
         return
-    jax.profiler.start_trace(log_dir)
+    _start_trace(log_dir, perfetto)
     try:
         yield
     finally:

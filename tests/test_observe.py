@@ -450,3 +450,102 @@ def test_observe_cli_flags():
     assert cfg.observe.metrics_jsonl == "/tmp/m.jsonl"
     assert cfg.observe.trace == "/tmp/t.json"
     assert cfg.observe.peak_tflops == 275.0
+
+
+# --- multi-stream report + device-time section (ISSUE 12) ----------------
+
+def _write_stream(path, host, steps):
+    import json as _json
+
+    with open(path, "w") as f:
+        for i, ms in enumerate(steps, 1):
+            f.write(_json.dumps({"event": "step", "t": i * 1.0,
+                                 "process_index": host, "step": i,
+                                 "loss": 3.0 - 0.1 * i,
+                                 "step_ms_p50": ms}) + "\n")
+
+
+def test_report_merges_multiple_host_streams(tmp_path, capsys):
+    """Satellite: report.main accepts multiple JSONL paths; records
+    merge into one summary and a per-host section appears exactly when
+    more than one host tag is present."""
+    from tensorflow_distributed_tpu.observe import report
+
+    a = str(tmp_path / "h0.jsonl")
+    b = str(tmp_path / "h1.jsonl")
+    _write_stream(a, 0, [10.0, 11.0])
+    _write_stream(b, 1, [20.0, 21.0, 22.0])
+    assert report.main([a, b]) == 0
+    out = capsys.readouterr().out
+    assert "Hosts" in out
+    records = report.load_records(a) + report.load_records(b)
+    s = report.summarize(records)
+    assert s["step_records"] == 5
+    assert set(s["hosts"]) == {"0", "1"}
+    assert s["hosts"]["0"]["step_records"] == 2
+    assert s["hosts"]["1"]["step_ms_p50"] == 22.0
+    # One stream alone: no Hosts section (shape-stable plain reports).
+    assert "hosts" not in report.summarize(report.load_records(a))
+
+
+def test_report_device_time_section(tmp_path, capsys):
+    """device_time records fold into a "Device time" section: latest
+    record per program, measured beside predicted, null parses counted
+    but not rendered as rows."""
+    import json as _json
+
+    from tensorflow_distributed_tpu.observe import report
+
+    path = str(tmp_path / "m.jsonl")
+    recs = [
+        {"event": "device_time", "program": "train_step",
+         "module": "jit_train_step", "device_ms": 90.0,
+         "device_ms_per_call": 30.0, "calls": 3,
+         "predicted_ms_per_call": 25.0, "collective_ms": 4.0,
+         "exposed_collective_ms": 1.5, "coarse": True},
+        {"event": "device_time", "program": None, "module": None,
+         "device_ms": None, "reason": "no trace"},
+        {"event": "step", "step": 1, "loss": 1.0,
+         "comm_exposed_ms_est": 2.1},
+    ]
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(_json.dumps(r) + "\n")
+    s = report.summarize(report.load_records(path))
+    assert len(s["device_time"]) == 1
+    entry = s["device_time"][0]
+    assert entry["program"] == "train_step"
+    assert entry["device_ms_per_call"] == 30.0
+    assert s["device_time_null_records"] == 1
+    assert s["comm_exposed_ms_est"] == 2.1
+    assert report.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "Device time" in out
+    assert "measured=30.0ms/call" in out
+    assert "predicted=25.0ms" in out
+    assert "[coarse]" in out
+
+
+def test_report_plan_drift_folds_into_plan_section(tmp_path):
+    import json as _json
+
+    from tensorflow_distributed_tpu.observe import report
+
+    path = str(tmp_path / "m.jsonl")
+    recs = [
+        {"event": "plan", "family": "gpt", "mesh": {"data": 8},
+         "strategy": "data", "partition": "replicated",
+         "predicted_step_ms": 2.5, "candidates": 3, "feasible": 3,
+         "infeasible": 0, "calibration_id": "cpu-abc123"},
+        {"event": "plan_drift", "predicted_step_ms": 2.5,
+         "measured_step_ms_p50": 20.0, "drift_ratio": 8.0,
+         "calibration_id": "cpu-abc123"},
+    ]
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(_json.dumps(r) + "\n")
+    s = report.summarize(report.load_records(path))
+    assert s["plan"]["drift_ratio"] == 8.0
+    assert s["plan"]["measured_step_ms_p50"] == 20.0
+    assert s["plan"]["calibration_id"] == "cpu-abc123"
+    assert "drift" in report.render(s)
