@@ -249,6 +249,38 @@ if [ "$slo_rc" -ne 0 ]; then
        "$SLOLOG" >&2
 fi
 
+# Detectbench smoke (incident observatory: anomaly recall on the
+# standard train+serve fault plans, zero false positives on the
+# seeded clean runs, SIGKILL flight-recorder bundle named in the
+# supervisor's restart event + postmortem CLI renders it —
+# benchmarks/detectbench.py). Skips the overhead phase (subprocess
+# timing at smoke scale is noise; the committed DETECTBENCH.json run
+# carries it). Same abort-guard shape as the smokes above: a run that
+# dies to the known container XLA:CPU abort prints no detect_checks
+# line and is retried once; a genuine gate failure prints one and is
+# NOT retried.
+DETECTLOG="${DETECTLOG:-/tmp/_t1_detect.log}"
+run_detectbench() {
+  rm -f "$DETECTLOG"
+  timeout -k 10 300 env JAX_PLATFORMS=cpu python -m \
+    tensorflow_distributed_tpu.benchmarks.detectbench \
+    --phases train,serve,bundle --train-steps 24 --serve-requests 8 \
+    --new-tokens 32 --out "" 2>&1 | tee "$DETECTLOG"
+  return "${PIPESTATUS[0]}"
+}
+run_detectbench
+detect_rc=$?
+if ! grep -qa '"metric": "detect_checks"' "$DETECTLOG"; then
+  echo "[t1] no detect_checks line in $DETECTLOG (known container" \
+       "XLA:CPU abort) — rerunning detectbench once" >&2
+  run_detectbench
+  detect_rc=$?
+fi
+if [ "$detect_rc" -ne 0 ]; then
+  echo "[t1] detectbench smoke FAILED (detect_rc=$detect_rc) — see" \
+       "$DETECTLOG" >&2
+fi
+
 # Regress smoke (cross-run regression ledger — observe/regress.py):
 # every committed artifact in the manifest compared against its own
 # HEAD baseline; an untouched tree must pass CLEAN, and any slide in
@@ -295,6 +327,9 @@ if [ "$rc" -eq 0 ] && [ "$serve_rc" -ne 0 ]; then
 fi
 if [ "$rc" -eq 0 ] && [ "$slo_rc" -ne 0 ]; then
   exit "$slo_rc"
+fi
+if [ "$rc" -eq 0 ] && [ "$detect_rc" -ne 0 ]; then
+  exit "$detect_rc"
 fi
 if [ "$rc" -eq 0 ] && [ "$regress_rc" -ne 0 ]; then
   exit "$regress_rc"

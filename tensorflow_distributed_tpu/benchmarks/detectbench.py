@@ -1,0 +1,336 @@
+"""Incident-detection benchmark: recall / precision / forensics gates.
+
+The resilience fault plans are deterministic ground truth (PR 2/6:
+every injection step is known and every injection leaves a
+``fault_injected`` record), which makes the anomaly layer's quality a
+GATEABLE benchmark, not a judgment call:
+
+1. **train recall**: a tiny train run under a standard train fault
+   plan (``nan_grad@A,data_stall@B``, non-finite policy = skip so the
+   run survives its own faults) must flag EVERY injected fault kind
+   with the expected detector within ``--within`` steps of injection
+   (nan_grad -> ``loss_nonfinite``, data_stall -> ``step_time_spike``);
+2. **serve recall**: the same for a serve run under
+   ``decode_stall@A,slot_nan@B`` (decode_stall ->
+   ``decode_time_spike``, slot_nan -> ``slot_nonfinite``), on the
+   decode-step clock;
+3. **precision**: the SAME seeded runs with no fault plan must emit
+   ZERO anomaly records — the detectors' envelopes hold on clean
+   traffic;
+4. **bundle**: a supervised train leg (``nan_grad@A,sigkill@B``)
+   dies without notice; the supervisor's restart event must name the
+   dead leg's flight-recorder bundle, the bundle must parse
+   (truncated-tail tolerant), its anomaly tail must name the last
+   pre-death anomaly (the nan at A), and the postmortem CLI must
+   render it;
+5. **overhead**: min-of-interleaved A/B — the armed run (anomaly +
+   flight recorder) keeps >= ``1 - overhead_tol`` of the control's
+   steps/s (instrumentation <= 5% by default).
+
+Emits one JSON line per metric plus a ``detect_checks`` line;
+``--out`` writes DETECTBENCH.json (overwritten per run, like the
+sibling benchmarks); exit 1 on any failed gate (``--no-check`` to
+report without gating). ``--phases`` selects a subset (the t1 smoke
+runs ``train,serve,bundle``; subprocess timing at smoke scale is
+noise, so the overhead gate lives in the committed artifact run).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+#: fault kind -> anomaly detectors that count as detecting it.
+TRAIN_EXPECT = {"nan_grad": ("loss_nonfinite",),
+                "data_stall": ("step_time_spike",)}
+SERVE_EXPECT = {"decode_stall": ("decode_time_spike",),
+                "slot_nan": ("slot_nonfinite",)}
+
+
+def _run(cmd, env, timeout, what, check=True):
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=timeout)
+    if check and proc.returncode != 0:
+        print(f"detectbench: {what} failed rc={proc.returncode}\n"
+              f"{proc.stdout[-3000:]}\n{proc.stderr[-2000:]}",
+              file=sys.stderr)
+        raise SystemExit(1)
+    return proc
+
+
+def _records(path):
+    from tensorflow_distributed_tpu.observe.report import load_records
+    return load_records(path)
+
+
+def _recall(records, expect, within):
+    """Per injected fault: was an expected-detector anomaly raised
+    within ``within`` steps of the injection step the ground-truth
+    ``fault_injected`` record names?"""
+    injected = {}
+    for r in records:
+        if (r.get("event") == "recovery"
+                and r.get("kind") == "fault_injected"
+                and r.get("fault") in expect):
+            injected.setdefault(str(r["fault"]), int(r.get("step", 0)))
+    anoms = [r for r in records if r.get("event") == "anomaly"]
+    detail = {}
+    for fault, step in sorted(injected.items()):
+        hits = [int(a.get("step", 0)) for a in anoms
+                if str(a.get("detector", "")).split("/", 1)[0]
+                in expect[fault] and int(a.get("step", 0)) >= step]
+        detected = min(hits) if hits else None
+        detail[fault] = {
+            "detector": expect[fault][0], "injected": step,
+            "detected": detected,
+            "delay": None if detected is None else detected - step,
+            "flagged": bool(detected is not None
+                            and detected - step <= within),
+        }
+    return detail, len(injected)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--phases", default="train,serve,bundle,overhead")
+    parser.add_argument("--train-steps", type=int, default=28)
+    parser.add_argument("--serve-requests", type=int, default=10)
+    parser.add_argument("--new-tokens", type=int, default=40)
+    parser.add_argument("--seq-len", type=int, default=64)
+    parser.add_argument("--stall-s", type=float, default=0.8)
+    parser.add_argument("--within", type=int, default=3,
+                        help="max detection delay (steps of the "
+                        "phase's clock) the recall gate allows")
+    parser.add_argument("--overhead-steps", type=int, default=40)
+    parser.add_argument("--overhead-tol", type=float, default=0.05)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--timeout", type=float, default=420.0)
+    parser.add_argument("--workdir", default="",
+                        help="scratch dir (default: a fresh tempdir, "
+                        "removed on success)")
+    parser.add_argument("--no-check", action="store_true")
+    parser.add_argument("--out", default="DETECTBENCH.json")
+    args = parser.parse_args(argv)
+    phases = [p.strip() for p in args.phases.split(",") if p.strip()]
+
+    work = args.workdir or tempfile.mkdtemp(prefix="detectbench-")
+    os.makedirs(work, exist_ok=True)
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYTHONUNBUFFERED"] = "1"
+    cli = [sys.executable, "-m", "tensorflow_distributed_tpu.cli"]
+
+    # The float-input family (nan_grad poisons the batch's float
+    # leaves; token streams have none) at log_every=1 so every step's
+    # loss/wall is a detector sample.
+    train_common = [
+        "--model", "mnist_cnn", "--dataset", "synthetic",
+        "--batch-size", "64", "--eval-every", "0", "--log-every", "1",
+        "--seed", str(args.seed), "--observe.anomaly", "true",
+    ]
+    serve_common = [
+        "--mode", "serve", "--model", "gpt_lm", "--model-size", "tiny",
+        "--compute-dtype", "float32", "--seq-len", str(args.seq_len),
+        "--seed", str(args.seed),
+        "--serve.num-slots", "2",
+        "--serve.num-requests", str(args.serve_requests),
+        "--serve.prompt-len-min", "4", "--serve.prompt-len-max", "8",
+        "--serve.max-new-tokens", str(args.new_tokens),
+        # Gentle spaced arrivals: the CLEAN leg must have no queueing
+        # regime shift for the TTFT/queue detectors to misread as an
+        # incident — precision is half the gate.
+        "--serve.arrival-rate", "2",
+        "--serve.buckets", str(args.seq_len),
+        "--observe.anomaly", "true",
+    ]
+    k_nan = max(4, args.train_steps // 3)
+    k_stall = max(k_nan + 4, (2 * args.train_steps) // 3)
+    train_plan = f"nan_grad@{k_nan},data_stall@{k_stall}:{args.stall_s}s"
+    est_steps = args.serve_requests * args.new_tokens // 2
+    s_stall = max(10, est_steps // 8)
+    s_nan = max(s_stall + 6, est_steps // 4)
+    serve_plan = (f"decode_stall@{s_stall}:{args.stall_s}s,"
+                  f"slot_nan@{s_nan}:0")
+
+    lines, checks = [], {"metric": "detect_checks"}
+
+    if "train" in phases:
+        fire_jsonl = os.path.join(work, "train_fire.jsonl")
+        _run(cli + train_common + [
+            "--train-steps", str(args.train_steps),
+            "--observe.metrics-jsonl", fire_jsonl,
+            "--resilience.nonfinite", "skip_batch",
+            "--resilience.fault-plan", train_plan,
+        ], env, args.timeout, "train fire leg")
+        clean_jsonl = os.path.join(work, "train_clean.jsonl")
+        _run(cli + train_common + [
+            "--train-steps", str(args.train_steps),
+            "--observe.metrics-jsonl", clean_jsonl,
+            "--resilience.nonfinite", "skip_batch",
+        ], env, args.timeout, "train clean leg")
+        detail, n_inj = _recall(_records(fire_jsonl), TRAIN_EXPECT,
+                                args.within)
+        clean_anoms = [r for r in _records(clean_jsonl)
+                       if r.get("event") == "anomaly"]
+        flagged = sum(1 for d in detail.values() if d["flagged"])
+        lines.append({"metric": "detect_train_recall",
+                      "flagged": flagged, "of": n_inj,
+                      "plan": train_plan, "detail": detail})
+        lines.append({"metric": "detect_train_precision",
+                      "anomalies": len(clean_anoms),
+                      "detectors": sorted({str(r.get("detector"))
+                                           for r in clean_anoms})})
+        checks["train_recall_ok"] = bool(n_inj == len(TRAIN_EXPECT)
+                                         and flagged == n_inj)
+        checks["train_precision_ok"] = not clean_anoms
+
+    if "serve" in phases:
+        fire_jsonl = os.path.join(work, "serve_fire.jsonl")
+        _run(cli + serve_common + [
+            "--observe.metrics-jsonl", fire_jsonl,
+            "--resilience.fault-plan", serve_plan,
+        ], env, args.timeout, "serve fire leg")
+        clean_jsonl = os.path.join(work, "serve_clean.jsonl")
+        _run(cli + serve_common + [
+            "--observe.metrics-jsonl", clean_jsonl,
+        ], env, args.timeout, "serve clean leg")
+        detail, n_inj = _recall(_records(fire_jsonl), SERVE_EXPECT,
+                                args.within)
+        clean_anoms = [r for r in _records(clean_jsonl)
+                       if r.get("event") == "anomaly"]
+        flagged = sum(1 for d in detail.values() if d["flagged"])
+        lines.append({"metric": "detect_serve_recall",
+                      "flagged": flagged, "of": n_inj,
+                      "plan": serve_plan, "detail": detail})
+        lines.append({"metric": "detect_serve_precision",
+                      "anomalies": len(clean_anoms),
+                      "detectors": sorted({str(r.get("detector"))
+                                           for r in clean_anoms})})
+        checks["serve_recall_ok"] = bool(n_inj == len(SERVE_EXPECT)
+                                         and flagged == n_inj)
+        checks["serve_precision_ok"] = not clean_anoms
+
+    if "bundle" in phases:
+        from tensorflow_distributed_tpu.observe.flightrec import (
+            load_bundle)
+        from tensorflow_distributed_tpu.observe import postmortem
+        flight = os.path.join(work, "flight")
+        ckpt = os.path.join(work, "ckpt")
+        jsonl = os.path.join(work, "bundle.jsonl")
+        steps = max(12, args.train_steps // 2)
+        b_nan = max(3, steps // 3)
+        b_kill = max(b_nan + 3, (2 * steps) // 3)
+        # Die WITHOUT notice mid-run; the supervisor resumes from the
+        # cadence checkpoint (bind() consumes the plan, so leg 2
+        # completes clean) and must name leg 1's bundle.
+        _run([sys.executable, "-m",
+              "tensorflow_distributed_tpu.resilience.supervisor",
+              "--max-restarts", "2", "--backoff-base-s", "0.2", "--",
+              *train_common, "--train-steps", str(steps),
+              "--checkpoint-dir", ckpt, "--checkpoint-every", "4",
+              "--observe.metrics-jsonl", jsonl,
+              "--observe.flightrec", flight,
+              "--resilience.nonfinite", "skip_batch",
+              "--resilience.fault-plan",
+              f"nan_grad@{b_nan},sigkill@{b_kill}",
+              ], env, args.timeout, "supervised sigkill leg")
+        restart = [r for r in _records(jsonl)
+                   if r.get("event") == "recovery"
+                   and r.get("kind") == "restart"]
+        bundle_path = restart[0].get("bundle") if restart else None
+        parsed = last_anom = None
+        cli_ok = False
+        if bundle_path and os.path.exists(bundle_path):
+            parsed = load_bundle(bundle_path)
+            anoms = parsed["last"].get("anomaly", [])
+            last_anom = anoms[-1] if anoms else None
+            buf = __import__("io").StringIO()
+            import contextlib as _ctx
+            with _ctx.redirect_stdout(buf):
+                cli_ok = postmortem.main([bundle_path]) == 0
+            cli_ok = cli_ok and "Likely cause" in buf.getvalue()
+        lines.append({
+            "metric": "detect_bundle",
+            "bundle": bundle_path,
+            "bundle_kind": (parsed or {}).get("meta", {}).get("bundle"),
+            "records": len((parsed or {}).get("records", [])),
+            "named_in_restart": bool(bundle_path),
+            "last_anomaly_detector": (last_anom or {}).get("detector"),
+            "last_anomaly_step": (last_anom or {}).get("step"),
+            "postmortem_cli_ok": cli_ok,
+        })
+        checks["bundle_ok"] = bool(
+            bundle_path and parsed and parsed["records"]
+            and last_anom
+            and last_anom.get("detector") == "loss_nonfinite"
+            and last_anom.get("step") == b_nan and cli_ok)
+
+    if "overhead" in phases:
+        def leg(tag, armed, i):
+            path = os.path.join(work, f"ovh_{tag}{i}.jsonl")
+            extra = (["--observe.anomaly", "true",
+                      "--observe.flightrec",
+                      os.path.join(work, f"ovh_flight{i}")]
+                     if armed else [])
+            base = [a for a in train_common
+                    if a not in ("--observe.anomaly", "true")]
+            _run(cli + base + [
+                "--train-steps", str(args.overhead_steps),
+                "--observe.metrics-jsonl", path, *extra,
+            ], env, args.timeout, f"overhead {tag} leg {i}")
+            sums = [r for r in _records(path)
+                    if r.get("event") == "summary"]
+            return float(sums[-1]["steps_per_sec"])
+
+        # INTERLEAVED A/B (ctl, arm, ctl, arm — monotonic machine
+        # drift lands on both arms), best-of-2 per arm (min wall =
+        # max steps/s): fresh interpreters, warm persistent compile
+        # cache.
+        control, armed = [], []
+        for i in range(2):
+            control.append(leg("ctl", False, i))
+            armed.append(leg("arm", True, i))
+        ratio = max(armed) / max(control)
+        lines.append({"metric": "detect_overhead",
+                      "ratio": round(ratio, 4),
+                      "armed_steps_per_sec": round(max(armed), 3),
+                      "control_steps_per_sec": round(max(control), 3),
+                      "legs_per_arm": 2})
+        checks["overhead_ok"] = bool(ratio >= 1.0 - args.overhead_tol)
+        checks["overhead_tol"] = args.overhead_tol
+
+    checks["within_steps"] = args.within
+    recall_keys = [k for k in ("train_recall_ok", "serve_recall_ok")
+                   if k in checks]
+    precision_keys = [k for k in ("train_precision_ok",
+                                  "serve_precision_ok") if k in checks]
+    checks["recall_ok"] = all(checks[k] for k in recall_keys) \
+        if recall_keys else None
+    checks["precision_ok"] = all(checks[k] for k in precision_keys) \
+        if precision_keys else None
+    lines.append(checks)
+    common_tags = {"seed": args.seed, "phases": ",".join(phases)}
+    lines = [dict(ln, **common_tags) for ln in lines]
+    print("\n".join(json.dumps(ln) for ln in lines))
+    if args.out:
+        from tensorflow_distributed_tpu.observe.registry import (
+            write_jsonl)
+        write_jsonl(args.out, lines)
+    gates = [v for k, v in checks.items()
+             if k.endswith("_ok") and v is not None]
+    ok = bool(gates) and all(gates)
+    if not args.no_check and not ok:
+        print(f"detectbench: checks FAILED: {checks}", file=sys.stderr)
+        return 1
+    if not args.workdir:
+        shutil.rmtree(work, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

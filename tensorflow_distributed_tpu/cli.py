@@ -105,6 +105,20 @@ Examples:
         --profile-dir /tmp/prof --observe.metrics-jsonl /tmp/m.jsonl
     # did a rerun regress any committed bench gate?
     python -m tensorflow_distributed_tpu.observe.regress
+
+    # incident observatory (observe/anomaly.py + observe/flightrec.py;
+    # README "Incident observatory"): online anomaly detection over
+    # the already-fetched log-cadence values + a crash flight
+    # recorder whose bundle survives even a SIGKILL — render it as a
+    # human incident report with the postmortem CLI
+    python -m tensorflow_distributed_tpu.cli --model mnist_cnn \\
+        --dataset synthetic --train-steps 200 --log-every 1 \\
+        --observe.metrics-jsonl m.jsonl --observe.anomaly true \\
+        --observe.flightrec /tmp/flight \\
+        --resilience.nonfinite skip_batch \\
+        --resilience.fault-plan "nan_grad@60,sigkill@120"
+    python -m tensorflow_distributed_tpu.observe.postmortem \\
+        /tmp/flight/flight-<pid>.jsonl
 """
 
 from __future__ import annotations

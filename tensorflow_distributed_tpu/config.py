@@ -149,6 +149,32 @@ class ObserveConfig:
     # Atomic snapshot file (tmp+rename per dump): the single file a
     # poller reads. "" = snapshots ride the JSONL sink only.
     export_path: str = ""
+    # --- incident observatory (observe/anomaly.py + observe/
+    # flightrec.py; README "Incident observatory") -------------------
+    # Online anomaly detection: streaming detectors over the values
+    # the run already fetches on its log cadence (step-time /
+    # grad-norm spikes, throughput-slope degradation, loss spike /
+    # plateau / non-finite; serve: TTFT spike, decode-step-time
+    # spike, queue growth, slot non-finite) emitting "anomaly" JSONL
+    # records with severity + evidence window. Zero new host fetches.
+    anomaly: bool = False
+    # Rolling-window length (in the phase's step clock) for the spike
+    # detectors; also the "active" horizon the exported incident
+    # state uses.
+    anomaly_window: int = 64
+    # Crash flight recorder: a directory for the bounded in-memory
+    # ring of recent records, periodically fsync'd as an atomic
+    # snapshot bundle (flight-<pid>.jsonl — what a SIGKILL leaves
+    # behind) and dumped in full (postmortem-<pid>.jsonl, with thread
+    # stacks) on SIGTERM / fatal exceptions; faulthandler tracebacks
+    # land beside them. Render with
+    # ``python -m ...observe.postmortem <bundle>``. "" = off.
+    flightrec: str = ""
+    # Ring capacity (records) of the flight recorder.
+    flightrec_ring: int = 256
+    # Snapshot cadence in records (anomaly/recovery records always
+    # snapshot immediately).
+    flightrec_snapshot_every: int = 50
 
     def validate(self) -> None:
         if self.health_every < 0:
@@ -202,6 +228,29 @@ class ObserveConfig:
             raise ValueError(
                 f"observe.export_every must be >= 0, "
                 f"got {self.export_every}")
+        if self.anomaly_window < 8:
+            raise ValueError(
+                f"observe.anomaly_window must be >= 8, "
+                f"got {self.anomaly_window}")
+        if self.anomaly_window != 64 and not self.anomaly:
+            raise ValueError(
+                "observe.anomaly_window has no effect without "
+                "observe.anomaly; add --observe.anomaly true")
+        if self.flightrec_ring < 8:
+            raise ValueError(
+                f"observe.flightrec_ring must be >= 8, "
+                f"got {self.flightrec_ring}")
+        if self.flightrec_snapshot_every < 1:
+            raise ValueError(
+                f"observe.flightrec_snapshot_every must be >= 1, "
+                f"got {self.flightrec_snapshot_every}")
+        if not self.flightrec and (
+                self.flightrec_ring != 256
+                or self.flightrec_snapshot_every != 50):
+            raise ValueError(
+                "observe.flightrec_ring/flightrec_snapshot_every have "
+                "no effect without observe.flightrec; set a bundle "
+                "directory (--observe.flightrec DIR)")
 
 
 @dataclasses.dataclass

@@ -26,6 +26,15 @@ package turns every run into structured, comparable data:
 - :mod:`observe.slo` — live SLO burn-rate monitor: declared
   percentile targets, fast/slow windows on the decode-step clock,
   ``slo_alert``/``slo_ok`` events with error-budget accounting;
+- :mod:`observe.anomaly` — online anomaly detection: streaming
+  MAD/median/slope detectors over the already-fetched log-cadence
+  values (train) and the decode-step clock (serve), ``anomaly``
+  records + the live incident state snapshots export;
+- :mod:`observe.flightrec` — crash flight recorder: bounded record
+  ring, fsync'd snapshots (SIGKILL-durable), postmortem bundles on
+  trappable deaths;
+- :mod:`observe.postmortem` — ``python -m ...observe.postmortem
+  <bundle>``: timeline + likely-cause incident report from a bundle;
 - :mod:`observe.hub` — the :class:`Observatory` the train loop drives
   and the :class:`ServeObservatory` bundle serve/run.py drives;
 - :mod:`observe.xprof` — device-time attribution: parse the

@@ -1,0 +1,342 @@
+"""Crash flight recorder: forensic state that survives the process.
+
+A SIGKILL'd leg used to lose everything buffered since the last sink
+flush; a SIGSEGV lost even its Python stacks. This module keeps a
+bounded in-memory ring of the most recent full-fidelity observe
+records (it rides the registry as just another sink) plus per-kind
+tails of the records worth keeping longer than the ring (last
+``compile`` / ``device_time`` / ``health`` / ``recovery`` /
+``anomaly`` lines), and makes them durable two ways:
+
+- **periodic snapshots**: every ``snapshot_every`` records — and
+  IMMEDIATELY on every ``anomaly``/``recovery``/``postmortem`` record
+  (the lines most likely to matter are never older than one event) —
+  the whole ring is written atomically (tmp + fsync + rename) to
+  ``flight-<pid>.jsonl``. A SIGKILL, which no handler can see,
+  leaves this file as the leg's bundle.
+- **postmortem dump**: on a trappable death — SIGTERM (the handler
+  CHAINS to whatever was installed before, so the preemption guard's
+  graceful drain still wins while the loop owns the signal), or a
+  fatal exception (the Observatory dumps from ``close()`` when one is
+  in flight: non-finite halt, recovery-budget exhaustion, stall) —
+  a full bundle with the Python stacks of every live thread is
+  written to ``postmortem-<pid>.jsonl``.
+
+``faulthandler`` is enabled into ``faulthandler-<pid>.txt`` in the
+same directory, so a hard fatal signal (SIGSEGV/SIGABRT — this
+container's known XLA:CPU heap aborts included) at least leaves
+native-crash stacks beside the last snapshot.
+
+Bundle format: JSONL — a ``meta`` line (reason, signal, pid, git sha,
+calibration id, config), one ``record`` line per ring entry, a
+``tail`` line with the per-kind last records, and (dump only) a
+``traceback`` line. Line-oriented on purpose: a write cut mid-line by
+the death being recorded still yields every complete line
+(:func:`load_bundle` counts-and-skips the torn tail). The postmortem
+CLI (``python -m ...observe.postmortem <bundle>``) renders either
+flavor into a human incident report.
+
+Pure stdlib, import-light — the resilience supervisor imports
+:func:`newest_bundle` to name a dead leg's bundle in its restart
+events without touching any jax machinery.
+"""
+
+from __future__ import annotations
+
+import collections
+import faulthandler
+import json
+import os
+import signal
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Dict, Iterator, Mapping, Optional
+
+#: Record kinds kept in per-kind tails beyond the ring (the "last
+#: known good" lines a postmortem wants even when the ring has churned
+#: past them).
+TAIL_KINDS = ("compile", "device_time", "health", "recovery",
+              "anomaly", "slo_alert", "postmortem")
+
+SNAPSHOT_PREFIX = "flight-"
+BUNDLE_PREFIX = "postmortem-"
+#: Record kinds that force an immediate snapshot (a kill right after
+#: one of these must not lose it).
+FLUSH_EVENTS = ("anomaly", "recovery", "postmortem", "slo_alert")
+
+
+class FlightRecorder:
+    """The per-process recorder. Build one, :meth:`install` the signal
+    hooks, and feed it records — directly or via
+    :class:`FlightRecorderSink` on the run's registry."""
+
+    def __init__(self, directory: str, ring: int = 256,
+                 snapshot_every: int = 50,
+                 meta: Optional[Mapping[str, Any]] = None,
+                 tail_per_kind: int = 16):
+        if ring < 8:
+            raise ValueError(f"flightrec ring must be >= 8, got {ring}")
+        if snapshot_every < 1:
+            raise ValueError(
+                f"flightrec snapshot_every must be >= 1, "
+                f"got {snapshot_every}")
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+        self.ring: collections.deque = collections.deque(maxlen=ring)
+        self.snapshot_every = int(snapshot_every)
+        self.meta = dict(meta or {})
+        self._tails: Dict[str, collections.deque] = {
+            k: collections.deque(maxlen=tail_per_kind)
+            for k in TAIL_KINDS}
+        self._n = 0
+        # Reentrant ON PURPOSE: the SIGTERM hook runs dump() on the
+        # main thread, possibly interrupting a record()/snapshot()
+        # that already holds the lock — a plain Lock would deadlock
+        # the handler against the frame it interrupted.
+        self._lock = threading.RLock()
+        pid = os.getpid()
+        self.snapshot_path = os.path.join(
+            directory, f"{SNAPSHOT_PREFIX}{pid}.jsonl")
+        self.bundle_path = os.path.join(
+            directory, f"{BUNDLE_PREFIX}{pid}.jsonl")
+        self.faulthandler_path = os.path.join(
+            directory, f"faulthandler-{pid}.txt")
+        self.dumped: Optional[str] = None
+        self._fh_file = None
+        self._fh_enabled = False
+        self._prev_sigterm: Any = None
+        self._installed_sigterm = False
+
+    # -- lifecycle --------------------------------------------------------
+
+    def install(self) -> None:
+        """Arm the death hooks: faulthandler into the bundle dir for
+        hard fatal signals, and a CHAINING SIGTERM hook (dump first,
+        then the previous disposition — so a later-installed
+        preemption guard that saves-and-restores handlers composes:
+        while the guard owns the signal a SIGTERM is a graceful drain,
+        not an incident; before and after, it dumps)."""
+        try:
+            self._fh_file = open(self.faulthandler_path, "w")
+            faulthandler.enable(self._fh_file)
+            self._fh_enabled = True
+        except (OSError, ValueError):
+            self._fh_file = None
+        try:
+            self._prev_sigterm = signal.signal(signal.SIGTERM,
+                                               self._on_sigterm)
+            self._installed_sigterm = True
+        except ValueError:
+            pass  # not the main thread — snapshots still cover us
+
+    def _on_sigterm(self, signum, frame) -> None:
+        self.dump(reason="sigterm", signum=int(signum))
+        prev = self._prev_sigterm
+        if callable(prev):
+            prev(signum, frame)
+        elif prev != signal.SIG_IGN:
+            # SIG_DFL, or None (a disposition installed outside
+            # Python, which we cannot invoke): preserve die-by-signal
+            # semantics (the supervisor reads the -SIGTERM rc) —
+            # restore the default and re-deliver rather than silently
+            # absorbing the termination request. An explicit SIG_IGN
+            # is respected.
+            signal.signal(signum, signal.SIG_DFL)
+            os.kill(os.getpid(), signum)
+
+    def close(self, final_snapshot: bool = True) -> None:
+        """Disarm hooks (restoring the previous SIGTERM disposition
+        when ours is still installed) and leave one final snapshot on
+        disk. Idempotent."""
+        if self._installed_sigterm:
+            try:
+                if signal.getsignal(signal.SIGTERM) == self._on_sigterm:
+                    signal.signal(signal.SIGTERM, self._prev_sigterm)
+            except (ValueError, TypeError):
+                pass
+            self._installed_sigterm = False
+        if self._fh_enabled:
+            try:
+                faulthandler.disable()
+            except Exception:
+                pass
+            self._fh_enabled = False
+        if self._fh_file is not None:
+            try:
+                self._fh_file.close()
+            except OSError:
+                pass
+            self._fh_file = None
+        if final_snapshot:
+            self.snapshot()
+
+    # -- record flow ------------------------------------------------------
+
+    def record(self, rec: Mapping[str, Any]) -> None:
+        """One observe record into the ring (and its kind tail). Rings
+        on every process; snapshots on the cadence and immediately on
+        incident-class events."""
+        rec = dict(rec)
+        flush = rec.get("event") in FLUSH_EVENTS
+        with self._lock:
+            self.ring.append(rec)
+            kind = rec.get("event")
+            if kind in self._tails:
+                self._tails[kind].append(rec)
+            self._n += 1
+            due = self._n % self.snapshot_every == 0
+        if flush or due:
+            self.snapshot()
+
+    def _bundle_lines(self, kind: str, reason: Optional[str] = None,
+                      signum: Optional[int] = None,
+                      tracebacks: bool = False
+                      ) -> Iterator[Dict[str, Any]]:
+        with self._lock:
+            ring = list(self.ring)
+            tails = {k: list(v) for k, v in self._tails.items() if v}
+        yield {
+            "kind": "meta", "bundle": kind, "pid": os.getpid(),
+            "written_t": round(time.time(), 3), "reason": reason,
+            "signal": signum, "records": len(ring),
+            "faulthandler": self.faulthandler_path, **self.meta,
+        }
+        for rec in ring:
+            yield {"kind": "record", "data": rec}
+        yield {"kind": "tail", "last": tails}
+        if tracebacks:
+            stacks = []
+            frames = sys._current_frames()
+            for thread in threading.enumerate():
+                frame = frames.get(thread.ident)
+                if frame is None:
+                    continue
+                stacks.append({
+                    "thread": thread.name,
+                    "stack": traceback.format_stack(frame)})
+            yield {"kind": "traceback", "stacks": stacks}
+
+    def snapshot(self) -> str:
+        """Atomic ring snapshot (tmp + fsync + rename): the file a
+        poller or a post-SIGKILL supervisor reads is always a complete
+        bundle, never a torn write."""
+        tmp = self.snapshot_path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                for line in self._bundle_lines("snapshot"):
+                    f.write(json.dumps(line, default=str) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.snapshot_path)
+        except OSError:
+            # Telemetry must never take down the run it observes.
+            pass
+        return self.snapshot_path
+
+    def dump(self, reason: str, signum: Optional[int] = None
+             ) -> Optional[str]:
+        """The trappable-death bundle: full ring + tails + every live
+        thread's Python stack, written straight through (per-line
+        durability over atomicity — a death mid-dump still leaves
+        every complete line, and :func:`load_bundle` tolerates the
+        torn tail). First dump wins; later calls return its path."""
+        if self.dumped is not None:
+            return self.dumped
+        try:
+            with open(self.bundle_path, "w") as f:
+                for line in self._bundle_lines(
+                        "postmortem", reason=reason, signum=signum,
+                        tracebacks=True):
+                    f.write(json.dumps(line, default=str) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+        except OSError:
+            return None
+        self.dumped = self.bundle_path
+        return self.bundle_path
+
+
+class FlightRecorderSink:
+    """Registry-sink adapter: every emitted record flows into the
+    recorder's ring; closing the sink leaves a final snapshot."""
+
+    def __init__(self, recorder: FlightRecorder):
+        self.recorder = recorder
+
+    def emit(self, record: Mapping[str, Any]) -> None:
+        self.recorder.record(record)
+
+    def close(self) -> None:
+        self.recorder.close()
+
+
+# --- read side (postmortem CLI, supervisor, tests) ----------------------
+
+def load_bundle(path: str) -> Dict[str, Any]:
+    """Parse a bundle (snapshot or postmortem), tolerating a torn
+    tail: a line cut mid-write by the death being recorded is counted
+    in ``torn``, every complete line still loads. Returns
+    ``{meta, records, last, tracebacks, torn, path}``."""
+    out: Dict[str, Any] = {"meta": {}, "records": [], "last": {},
+                           "tracebacks": [], "torn": 0, "path": path}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                out["torn"] += 1
+                continue
+            kind = obj.get("kind")
+            if kind == "meta":
+                out["meta"] = {k: v for k, v in obj.items()
+                               if k != "kind"}
+            elif kind == "record":
+                out["records"].append(obj.get("data", {}))
+            elif kind == "tail":
+                out["last"] = obj.get("last", {})
+            elif kind == "traceback":
+                out["tracebacks"] = obj.get("stacks", [])
+    return out
+
+
+def newest_bundle(directory: str, since: float = 0.0
+                  ) -> Optional[str]:
+    """The dead leg's bundle: the newest ``postmortem-*.jsonl`` in
+    ``directory`` modified at/after ``since``, falling back to the
+    newest ``flight-*.jsonl`` snapshot (a SIGKILL writes no
+    postmortem — the last snapshot IS the bundle). None when nothing
+    qualifies; never raises (the supervisor calls this on its restart
+    path)."""
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return None
+    best: Dict[str, tuple] = {}
+    for name in names:
+        if not name.endswith(".jsonl"):
+            continue
+        if name.startswith(BUNDLE_PREFIX):
+            group = "postmortem"
+        elif name.startswith(SNAPSHOT_PREFIX):
+            group = "snapshot"
+        else:
+            continue
+        path = os.path.join(directory, name)
+        try:
+            mtime = os.path.getmtime(path)
+        except OSError:
+            continue
+        if mtime < since:
+            continue
+        if group not in best or mtime > best[group][0]:
+            best[group] = (mtime, path)
+    if "postmortem" in best:
+        return best["postmortem"][1]
+    if "snapshot" in best:
+        return best["snapshot"][1]
+    return None

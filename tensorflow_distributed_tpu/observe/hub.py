@@ -15,18 +15,65 @@ unconditionally.
 from __future__ import annotations
 
 import contextlib
+import sys
 import time
 from typing import Any, Dict, Iterator, Optional
 
 from tensorflow_distributed_tpu.observe import device as device_mod
 from tensorflow_distributed_tpu.observe import goodput as goodput_mod
 from tensorflow_distributed_tpu.observe import mfu as mfu_mod
+from tensorflow_distributed_tpu.observe.anomaly import AnomalyHub
+from tensorflow_distributed_tpu.observe.flightrec import (
+    FlightRecorder, FlightRecorderSink)
 from tensorflow_distributed_tpu.observe.goodput import GoodputCounter
 from tensorflow_distributed_tpu.observe import registry as registry_mod
 from tensorflow_distributed_tpu.observe.registry import (
     CsvSink, JsonlSink, MetricsRegistry, host_tags)
 from tensorflow_distributed_tpu.observe.steptime import StepTimeBreakdown
 from tensorflow_distributed_tpu.observe.trace import ChromeTracer
+
+
+def _build_flightrec(ocfg, tags: Optional[Dict[str, Any]],
+                     run_config: Any = None) -> FlightRecorder:
+    """The crash flight recorder both observatories arm the same way:
+    bundle-dir ring + snapshot cadence from the config, provenance
+    (git sha, calibration id, host tags, the run config) in the
+    bundle meta, signal hooks installed."""
+    meta: Dict[str, Any] = {
+        **registry_mod.artifact_stamp(
+            registry_mod.default_calibration_path()),
+        **(tags or {}),
+    }
+    if run_config is not None:
+        import dataclasses
+
+        meta["config"] = (dataclasses.asdict(run_config)
+                          if dataclasses.is_dataclass(run_config)
+                          and not isinstance(run_config, type)
+                          else run_config)
+    rec = FlightRecorder(ocfg.flightrec, ring=ocfg.flightrec_ring,
+                         snapshot_every=ocfg.flightrec_snapshot_every,
+                         meta=meta)
+    rec.install()
+    return rec
+
+
+def _crash_dump(flightrec: Optional[FlightRecorder],
+                registry: MetricsRegistry) -> None:
+    """Called from the observatories' close(): when an exception is in
+    flight (non-finite halt, recovery-budget exhaustion, stall — every
+    fatal path funnels through the run's ``finally: obs.close()``),
+    dump the postmortem bundle and leave one ``postmortem`` record in
+    the JSONL (flushed per record, so it survives)."""
+    if flightrec is None or flightrec.dumped is not None:
+        return
+    exc = sys.exc_info()[1]
+    if exc is None:
+        return
+    reason = f"{type(exc).__name__}: {exc}"
+    path = flightrec.dump(reason=reason)
+    if path:
+        registry.emit("postmortem", bundle=path, reason=reason)
 
 
 def _emit_device_time(registry: MetricsRegistry, profile_dir: str,
@@ -89,7 +136,8 @@ class ServeObservatory:
 
     def __init__(self, ocfg, *, chief: bool = True,
                  tags: Optional[Dict[str, Any]] = None,
-                 process_index: int = 0, resumed: bool = False):
+                 process_index: int = 0, resumed: bool = False,
+                 run_config: Any = None):
         from tensorflow_distributed_tpu.observe.serve_trace import (
             ServeTracer)
         from tensorflow_distributed_tpu.observe.slo import (
@@ -101,9 +149,26 @@ class ServeObservatory:
             # are part of the same serving story (the train-side
             # --resume convention).
             sinks.append(JsonlSink(ocfg.metrics_jsonl, append=resumed))
+        self.flightrec = None
+        if ocfg.flightrec:
+            # Crash flight recorder (observe/flightrec.py): the ring
+            # rides the registry as a sink; a SIGKILL'd leg leaves its
+            # last fsync'd snapshot as the postmortem bundle.
+            self.flightrec = _build_flightrec(ocfg, tags, run_config)
+            sinks.append(FlightRecorderSink(self.flightrec))
         self.registry = MetricsRegistry(
             sinks, enabled=chief, tags=tags or {},
             max_records=ocfg.max_records)
+        # Online anomaly detection on the decode-step clock
+        # (observe/anomaly.py): the scheduler feeds TTFT / decode-wall
+        # / queue-depth samples it already has on host; "anomaly"
+        # records flow to the same sinks and the live incident state
+        # rides metrics_snapshot() for the export-path pollers.
+        self.anomalies = None
+        if ocfg.anomaly:
+            self.anomalies = AnomalyHub(emit=self.registry.emit,
+                                        window=ocfg.anomaly_window,
+                                        phase="serve")
         self.tracer = None
         if ocfg.trace:
             self.tracer = ServeTracer(ocfg.trace, enabled=chief,
@@ -139,6 +204,7 @@ class ServeObservatory:
         return {
             "registry": self.registry, "tracer": self.tracer,
             "slo_monitor": self.slo_monitor,
+            "anomaly_hub": self.anomalies,
             "export_every": self.export_every,
             "export_path": self.export_path,
             "status_every": self.status_every,
@@ -152,6 +218,10 @@ class ServeObservatory:
                                  calibration)
 
     def close(self) -> None:
+        # A fatal exception funneling through serve_run's finally
+        # (SlotRetryExhausted, StallError, ...) dumps the postmortem
+        # bundle before the sinks close.
+        _crash_dump(self.flightrec, self.registry)
         if self.programs_armed:
             device_mod.set_enabled(False)
         if registry_mod.get_active() is self.registry:
@@ -170,9 +240,11 @@ class Observatory:
                  items_per_step: float = 0.0,
                  process_index: int = 0,
                  append: bool = False,
-                 clock=time.perf_counter):
+                 clock=time.perf_counter,
+                 run_config: Any = None):
         sinks = []
         window, max_records, trace_path = 200, 100_000, ""
+        self.flightrec = None
         if ocfg is not None:
             if ocfg.metrics_jsonl:
                 sinks.append(JsonlSink(ocfg.metrics_jsonl,
@@ -180,11 +252,26 @@ class Observatory:
             if ocfg.metrics_csv:
                 sinks.append(CsvSink(ocfg.metrics_csv,
                                      max_rows=ocfg.max_records))
+            if getattr(ocfg, "flightrec", ""):
+                # Crash flight recorder (observe/flightrec.py): rides
+                # the registry as a sink; periodic fsync'd snapshots +
+                # a postmortem dump on trappable deaths (see close()).
+                self.flightrec = _build_flightrec(ocfg, tags,
+                                                  run_config)
+                sinks.append(FlightRecorderSink(self.flightrec))
             window, max_records = ocfg.window, ocfg.max_records
             trace_path = ocfg.trace
         self.registry = MetricsRegistry(sinks, enabled=chief,
                                         tags=tags or {},
                                         max_records=max_records)
+        # Online anomaly detection (observe/anomaly.py): fed from
+        # log_step / health records below — values the loop already
+        # fetched; zero new host transfers.
+        self.anomalies = None
+        if ocfg is not None and getattr(ocfg, "anomaly", False):
+            self.anomalies = AnomalyHub(emit=self.registry.emit,
+                                        window=ocfg.anomaly_window,
+                                        phase="train")
         self.tracer = ChromeTracer(trace_path, pid=process_index,
                                    enabled=chief,
                                    process_name="tfd-train-host",
@@ -248,7 +335,8 @@ class Observatory:
         obs = cls(cfg.observe, chief=chief,
                   tags=host_tags(mesh, cfg), accountant=accountant,
                   items_per_step=float(cfg.batch_size) * (seq or 1),
-                  process_index=jax.process_index(), append=append)
+                  process_index=jax.process_index(), append=append,
+                  run_config=cfg)
         obs.seq_len = seq
         return obs
 
@@ -362,8 +450,17 @@ class Observatory:
 
     # -- emission ---------------------------------------------------------
     def emit(self, event: str, **fields: Any) -> None:
-        if self.active:
-            self.registry.emit(event, **fields)
+        if not self.active:
+            return
+        if self.anomalies is not None and event == "health":
+            # Per-module vitals tee into the anomaly hub (grad-norm
+            # explosion / update-ratio collapse) — the values were
+            # already fetched on the health cadence; anomaly records
+            # flow out through the hub's own registry emit.
+            self.anomalies.observe_health(
+                int(fields.get("step", 0)),
+                str(fields.get("module", "")), fields)
+        self.registry.emit(event, **fields)
 
     def log_step(self, step: int, metrics: Dict[str, float]) -> None:
         """Per-cadence record: task metrics + rolling step-time
@@ -372,12 +469,13 @@ class Observatory:
         if not self.active:
             return
         now = self._clock()
+        prev_log = self._last_log
         fields: Dict[str, Any] = {"step": step}
         fields.update({k: float(v) for k, v in metrics.items()})
         fields.update(self.steptime.summary())
         fields.update(self._comm_fields(fields.get("step_ms_p50")))
-        if self._last_log is not None:
-            last_step, last_t = self._last_log
+        if prev_log is not None:
+            last_step, last_t = prev_log
             rates = self.accountant.rates(
                 (step - last_step) * self.items_per_step, now - last_t)
             fields.update(rates)
@@ -388,6 +486,16 @@ class Observatory:
                 self.tracer.counter("throughput", **{key: rates[key]})
         self._last_log = (step, now)
         self.registry.emit("step", **fields)
+        if self.anomalies is not None:
+            # Detectors consume exactly what this cadence already
+            # fetched: the task metrics (loss, grad_norm), the window
+            # throughput, and the cadence-derived per-step wall.
+            wall_ms = None
+            if prev_log is not None and step > prev_log[0]:
+                wall_ms = 1e3 * (now - prev_log[1]) / (step
+                                                       - prev_log[0])
+            self.anomalies.observe_train_step(step, fields,
+                                              step_wall_ms=wall_ms)
 
     def summarize(self, total_seconds: Optional[float] = None,
                   **fields: Any) -> None:
@@ -428,6 +536,11 @@ class Observatory:
             self.tracer.flush()
 
     def close(self) -> None:
+        # Fatal exceptions (non-finite halt, recovery-budget
+        # exhaustion, stall) all funnel through the loop's
+        # ``finally: obs.close()`` — dump the postmortem bundle while
+        # the exception is still in flight, before the sinks close.
+        _crash_dump(self.flightrec, self.registry)
         if self._programs:
             device_mod.set_enabled(False)
         if goodput_mod.get_active() is self.goodput:

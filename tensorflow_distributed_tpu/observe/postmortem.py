@@ -1,0 +1,223 @@
+"""Postmortem forensics: a human incident report from a flight-recorder
+bundle.
+
+::
+
+    python -m tensorflow_distributed_tpu.observe.postmortem \\
+        /path/to/postmortem-<pid>.jsonl [--timeline N] [--json]
+
+Accepts either bundle flavor (``postmortem-*.jsonl`` — a trapped
+death's full dump — or ``flight-*.jsonl`` — the last periodic snapshot
+a SIGKILL left behind; observe/flightrec.py) and renders:
+
+- the death: reason / signal / pid / written-at, with provenance
+  (git sha, calibration id, config hash);
+- the anomalies that preceded it (observe/anomaly.py records from the
+  bundle's tail), newest last;
+- a **likely-cause heuristic** — one sentence connecting the last
+  anomaly to the death ("grad-norm explosion at step 38 preceded
+  nonfinite halt at step 40");
+- the timeline: the last N ring records around the death;
+- the per-kind tails (last compile / device_time / health / recovery
+  lines) and captured thread stacks.
+
+Pure stdlib, read-only — safe to run on a live run's snapshot.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+from tensorflow_distributed_tpu.observe.flightrec import load_bundle
+
+#: detector id -> human phrase for the likely-cause sentence.
+DETECTOR_PHRASES = {
+    "loss_nonfinite": "non-finite loss",
+    "loss_spike": "loss spike",
+    "loss_plateau": "loss plateau",
+    "step_time_spike": "step-time spike",
+    "throughput_slope": "throughput degradation",
+    "grad_norm_spike": "grad-norm explosion",
+    "update_ratio_collapse": "update-ratio collapse",
+    "ttft_spike": "TTFT spike",
+    "decode_time_spike": "decode-step-time spike",
+    "queue_growth": "queue growth",
+    "slot_nonfinite": "slot non-finite logits",
+}
+
+
+def _phrase(detector: str) -> str:
+    base = detector.split("/", 1)[0]
+    phrase = DETECTOR_PHRASES.get(base, base.replace("_", " "))
+    if "/" in detector:
+        phrase += f" in {detector.split('/', 1)[1]}"
+    return phrase
+
+
+def _anomalies(bundle: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Anomaly records, tail-preferred (the tail outlives the ring),
+    deduped against ring copies, oldest first."""
+    seen = set()
+    out: List[Dict[str, Any]] = []
+    for rec in (bundle.get("last", {}).get("anomaly", [])
+                + [r for r in bundle.get("records", [])
+                   if r.get("event") == "anomaly"]):
+        key = (rec.get("detector"), rec.get("step"), rec.get("t"))
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(rec)
+    out.sort(key=lambda r: (r.get("step", 0), r.get("t", 0.0)))
+    return out
+
+
+def _death_step(bundle: Dict[str, Any]) -> Optional[int]:
+    steps = [r.get("step") for r in bundle.get("records", [])
+             if isinstance(r.get("step"), int)]
+    return max(steps) if steps else None
+
+
+def likely_cause(bundle: Dict[str, Any]) -> str:
+    """The one-sentence heuristic: connect the last pre-death anomaly
+    (when one exists) to how the process died."""
+    meta = bundle.get("meta", {})
+    reason = str(meta.get("reason") or "")
+    anoms = _anomalies(bundle)
+    last = anoms[-1] if anoms else None
+    death = _death_step(bundle)
+    at = f" at step {death}" if death is not None else ""
+    injected = sorted({str(r.get("fault")) for r in
+                       bundle.get("last", {}).get("recovery", [])
+                       if r.get("kind") == "fault_injected"
+                       and r.get("fault")})
+    suffix = (f" (injected faults on record: {', '.join(injected)})"
+              if injected else "")
+
+    def _preceded(what: str) -> str:
+        if last is None:
+            return (f"no anomalies preceded the {what}{at}"
+                    f"{suffix}")
+        return (f"{_phrase(str(last.get('detector')))} at step "
+                f"{last.get('step')} preceded the {what}{at}{suffix}")
+
+    low = reason.lower()
+    if ("floatingpointerror" in low or "non-finite" in low
+            or "recoverybudgetexceeded" in low):
+        return _preceded("nonfinite halt")
+    if "stallerror" in low or "stalled" in low:
+        return _preceded("stall halt")
+    if "sigterm" in low or meta.get("signal"):
+        return _preceded("termination")
+    if meta.get("bundle") == "snapshot":
+        # No trapped death wrote this — the process was killed
+        # outright (SIGKILL / OOM) and the last snapshot is what
+        # survived.
+        return _preceded("untrapped process death")
+    return _preceded("process death")
+
+
+def _fmt_record(rec: Dict[str, Any]) -> str:
+    event = rec.get("event", "?")
+    bits = [f"t={rec['t']:.3f}" if isinstance(rec.get("t"), (int, float))
+            else "t=?"]
+    if "step" in rec:
+        bits.append(f"step={rec['step']}")
+    bits.append(f"event={event}")
+    for key in ("detector", "severity", "kind", "fault", "module",
+                "loss", "value", "baseline", "rid", "slot"):
+        if key in rec:
+            val = rec[key]
+            bits.append(f"{key}={val:.6g}"
+                        if isinstance(val, float) else f"{key}={val}")
+    return " ".join(bits)
+
+
+def report(bundle: Dict[str, Any], timeline: int = 12) -> str:
+    meta = bundle.get("meta", {})
+    lines = [f"== postmortem: {bundle.get('path', '?')}"]
+    head = [f"bundle={meta.get('bundle', '?')}",
+            f"pid={meta.get('pid', '?')}"]
+    if meta.get("reason"):
+        head.append(f"reason={meta['reason']}")
+    if meta.get("signal"):
+        head.append(f"signal={meta['signal']}")
+    lines.append("  " + " ".join(head))
+    prov = [f"{k}={meta[k]}" for k in
+            ("git_sha", "calibration_id", "config_hash", "mesh")
+            if meta.get(k) is not None]
+    if prov:
+        lines.append("  " + " ".join(prov))
+    if bundle.get("torn"):
+        lines.append(f"  torn_lines={bundle['torn']} (tolerated — the "
+                     f"death cut the final write)")
+    anoms = _anomalies(bundle)
+    lines.append(f"Anomalies preceding death ({len(anoms)})")
+    for rec in anoms[-8:]:
+        lines.append(
+            f"  [step {rec.get('step', '?')}] "
+            f"{rec.get('detector', '?')} "
+            f"severity={rec.get('severity', '?')}"
+            + (f" value={rec['value']}" if "value" in rec else "")
+            + (f" baseline={rec['baseline']}"
+               if "baseline" in rec else ""))
+    lines.append("Likely cause")
+    lines.append(f"  {likely_cause(bundle)}")
+    records = bundle.get("records", [])
+    lines.append(f"Timeline (last {min(timeline, len(records))} of "
+                 f"{len(records)} ring records)")
+    for rec in records[-timeline:]:
+        lines.append("  " + _fmt_record(rec))
+    tails = bundle.get("last", {})
+    if tails:
+        lines.append("Last by kind")
+        lines.append("  " + " ".join(
+            f"{kind}={len(recs)}" for kind, recs
+            in sorted(tails.items()) if recs))
+    if bundle.get("tracebacks"):
+        lines.append(f"Tracebacks ({len(bundle['tracebacks'])} "
+                     f"threads captured)")
+        for tb in bundle["tracebacks"]:
+            stack = tb.get("stack") or []
+            tail = stack[-1].strip().splitlines()[0] if stack else "?"
+            lines.append(f"  {tb.get('thread', '?')}: {tail}")
+    if meta.get("faulthandler"):
+        lines.append(f"faulthandler: {meta['faulthandler']}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tensorflow_distributed_tpu.observe.postmortem",
+        description="render a flight-recorder bundle as a human "
+                    "incident report")
+    parser.add_argument("bundle", help="postmortem-*.jsonl or "
+                        "flight-*.jsonl bundle path")
+    parser.add_argument("--timeline", type=int, default=12,
+                        help="ring records to show around the death")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable: the parsed bundle + "
+                        "likely_cause")
+    args = parser.parse_args(argv)
+    try:
+        bundle = load_bundle(args.bundle)
+    except OSError as e:
+        print(f"postmortem: {e}", file=sys.stderr)
+        return 1
+    if not bundle["meta"] and not bundle["records"]:
+        print(f"postmortem: {args.bundle}: not a flight-recorder "
+              f"bundle (no meta/record lines)", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps({**bundle,
+                          "likely_cause": likely_cause(bundle)},
+                         default=str))
+    else:
+        print(report(bundle, timeline=args.timeline))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

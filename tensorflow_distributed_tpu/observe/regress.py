@@ -144,6 +144,22 @@ MANIFEST: Dict[str, Tuple[str, List[Check]]] = {
         ("calib_checks.regress_clean_on_committed", "truthy"),
         ("calib_fit.calibrated_median_rel_err", "lower", 0.0, 0.25),
     )),
+    "DETECTBENCH.json": ("jsonl", _jsonl_checks(
+        # Recall/precision/bundle gates are exact (deterministic fault
+        # plans are ground truth); the overhead ratio carries a
+        # generous CPU band.
+        ("detect_checks.recall_ok", "truthy"),
+        ("detect_checks.precision_ok", "truthy"),
+        ("detect_checks.bundle_ok", "truthy"),
+        ("detect_checks.overhead_ok", "truthy"),
+        ("detect_train_recall.flagged", "equal"),
+        ("detect_serve_recall.flagged", "equal"),
+        ("detect_train_precision.anomalies", "lower", 0.0, 0.0),
+        ("detect_serve_precision.anomalies", "lower", 0.0, 0.0),
+        ("detect_bundle.named_in_restart", "truthy"),
+        ("detect_bundle.postmortem_cli_ok", "truthy"),
+        ("detect_overhead.ratio", "higher", 0.0, 0.1),
+    )),
     "GENBENCH.json": ("jsonl", _jsonl_checks(
         ("gen_prefill_tokens_per_sec.value", "higher", 0.3),
         ("gen_decode_tokens_per_sec.value", "higher", 0.3),

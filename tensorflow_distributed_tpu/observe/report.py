@@ -237,6 +237,26 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                 and isinstance(r.get("seconds"), (int, float)))
             if reshard_s:
                 out["reshard_seconds_total"] = round(reshard_s, 4)
+    # Incident observatory (observe/anomaly.py "anomaly" records +
+    # observe/flightrec.py "postmortem" records): per-detector counts,
+    # the last anomaly, and any postmortem bundle the run dumped.
+    anoms = [r for r in records if r.get("event") == "anomaly"]
+    if anoms:
+        by_det: Dict[str, int] = {}
+        for r in anoms:
+            det = str(r.get("detector", "?"))
+            by_det[det] = by_det.get(det, 0) + 1
+        last = anoms[-1]
+        out["anomalies"] = {
+            "count": len(anoms),
+            "by_detector": dict(sorted(by_det.items())),
+            "last": {k: last[k] for k in
+                     ("detector", "severity", "step") if k in last},
+        }
+    posts = [r for r in records if r.get("event") == "postmortem"]
+    if posts:
+        out["postmortem_bundles"] = [
+            r.get("bundle") for r in posts if r.get("bundle")]
     # Auto-layout planner (--plan auto, analysis/planner): the chosen
     # mesh/strategy and its predicted step time, reported beside the
     # MEASURED step time when the run got far enough to have one —
@@ -404,6 +424,7 @@ def render(summary: Dict[str, Any]) -> str:
                 "recovery_counts", "swap_seconds_total",
                 "mesh_changes", "mesh_change_path",
                 "reshard_seconds_total", "slo", "snapshot_last",
+                "anomalies", "postmortem_bundles",
                 "device_time", "device_time_null_records", "hosts",
                 # rendered inside the Device time section, not the
                 # generic stats list (one print per number).
@@ -532,6 +553,23 @@ def render(summary: Dict[str, Any]) -> str:
         entry = summary["snapshot_last"]
         for key in sorted(entry):
             lines.append(f"  {key:<28} {entry[key]}")
+    if "anomalies" in summary:
+        lines.append("Anomalies")
+        entry = summary["anomalies"]
+        for det, n in entry.get("by_detector", {}).items():
+            lines.append(f"  {det:<28} {n}")
+        last = entry.get("last", {})
+        if last:
+            lines.append(
+                f"  {'last':<28} {last.get('detector')} "
+                f"severity={last.get('severity')} "
+                f"step={last.get('step')}")
+    if "postmortem_bundles" in summary:
+        lines.append("Postmortem bundles")
+        for path in summary["postmortem_bundles"]:
+            lines.append(f"  {path} (render: python -m "
+                         f"tensorflow_distributed_tpu.observe"
+                         f".postmortem {path})")
     if "health" in summary:
         lines.append("Health")
         for module, entry in summary["health"].items():

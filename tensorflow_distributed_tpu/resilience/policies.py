@@ -28,10 +28,9 @@ other policy it is emitted as a recovery event only.
 
 from __future__ import annotations
 
-import collections
-import statistics
 from typing import Optional
 
+from tensorflow_distributed_tpu.observe.anomaly import RollingMedianSpike
 from tensorflow_distributed_tpu.observe.registry import emit_event
 
 
@@ -112,30 +111,17 @@ class NonFinitePolicy:
             f"checkpoint: {last_checkpoint}")
 
 
-class LossSpikeDetector:
-    """Rolling-window divergence detector for FINITE losses.
+class LossSpikeDetector(RollingMedianSpike):
+    """Rolling-window divergence detector for FINITE losses — the
+    loop-facing name for :class:`observe.anomaly.RollingMedianSpike`
+    (ONE median-spike implementation in the repo; the anomaly hub's
+    advisory loss-spike detector is the same class, so the acting
+    policy and the incident telemetry cannot drift apart).
 
     ``observe(loss)`` returns the window median when ``loss >
     factor * median`` over a full window, else None. The spiking value
     is NOT added to the window (one outlier must not drag the baseline
     toward itself), but training-regime shifts still track because
-    every non-spike value is."""
-
-    def __init__(self, window: int, factor: float):
-        self.factor = factor
-        self._window: collections.deque = collections.deque(
-            maxlen=window)
-
-    def observe(self, loss: float) -> Optional[float]:
-        full = len(self._window) == self._window.maxlen
-        if full:
-            med = statistics.median(self._window)
-            if loss > self.factor * max(med, 1e-12):
-                return med
-        self._window.append(loss)
-        return None
-
-    def reset(self) -> None:
-        """After a rewind the replayed steps re-approach the spike
-        region legitimately; a stale window would re-flag them."""
-        self._window.clear()
+    every non-spike value is; ``reset()`` clears the window after a
+    rewind (the replayed steps re-approach the spike region
+    legitimately — a stale window would re-flag them)."""

@@ -245,6 +245,24 @@ def build_leg_args(child_args: Sequence[str], restarts: int
     return args
 
 
+def _leg_bundle(flight_dir: Optional[str], since: float
+                ) -> Optional[str]:
+    """The dead leg's flight-recorder bundle (observe/flightrec.py):
+    newest postmortem (trapped death) or snapshot (SIGKILL — the last
+    fsync'd ring survives where no handler could run) written since
+    the leg launched. None without ``--observe.flightrec`` in the
+    child args or when nothing qualifies; never raises — this runs on
+    the restart path."""
+    if not flight_dir:
+        return None
+    try:
+        from tensorflow_distributed_tpu.observe.flightrec import (
+            newest_bundle)
+        return newest_bundle(flight_dir, since=since)
+    except Exception:
+        return None
+
+
 def _append_event(path: Optional[str], record: dict) -> None:
     if not path:
         return
@@ -303,6 +321,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     ckpt_dir = _child_flag_value(child_args, "--checkpoint-dir")
     jsonl = _child_flag_value(child_args, "--observe.metrics-jsonl")
+    flight_dir = _child_flag_value(child_args, "--observe.flightrec")
     serve = _child_flag_value(child_args, "--mode") == "serve"
     if serve and not _child_flag_value(child_args, "--serve.journal"):
         print("[supervisor] WARNING: serve child has no "
@@ -319,6 +338,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     mask_file = (device_mask_path(ckpt_dir) if ckpt_dir
                  else os.environ.get("TFD_DEVICE_MASK_FILE"))
     prev_mesh: Optional[Dict[str, int]] = None
+    prev_exit_t = 0.0   # previous leg's exit time: bundles older than
+    #                     it belong to THAT leg, never this one
 
     restarts = 0
     rc = 1
@@ -373,6 +394,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                *args]
         print(f"[supervisor] leg {restarts}: {' '.join(cmd)}",
               flush=True)
+        leg_t0 = time.time()
         proc = subprocess.Popen(cmd, env=env)
 
         def forward(signum, frame, _p=proc):
@@ -392,6 +414,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             print(f"[supervisor] clean exit after {restarts} "
                   f"restart(s)", flush=True)
             return 0
+        # The dead leg's postmortem bundle (flight recorder): name it
+        # in whichever recovery event this exit produces, so the
+        # incident's forensic state is one `observe.postmortem`
+        # invocation away from the restart history. The 1s slack
+        # absorbs coarse filesystem mtimes, but never reaches past
+        # the PREVIOUS leg's exit — a leg that died before writing
+        # anything must not be credited with its predecessor's bundle.
+        bundle = _leg_bundle(flight_dir,
+                             max(leg_t0 - 1.0, prev_exit_t))
+        bundle_extra = {"bundle": bundle} if bundle else {}
+        prev_exit_t = time.time()
         if rc == 2 and not opts.restart_on_diverge:
             # EXIT_DIVERGED (see cli.py): the run halted on policy —
             # restarting replays the same divergence.
@@ -400,14 +433,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                   flush=True)
             _append_event(jsonl, {
                 "event": "recovery", "kind": "diverged_no_restart",
-                "restarts": restarts, "rc": rc})
+                "restarts": restarts, "rc": rc, **bundle_extra})
             return rc
         if restarts >= opts.max_restarts:
             print(f"[supervisor] restart budget exhausted "
                   f"({opts.max_restarts}); last rc={rc}", flush=True)
             _append_event(jsonl, {
                 "event": "recovery", "kind": "restart_budget_exhausted",
-                "restarts": restarts, "rc": rc})
+                "restarts": restarts, "rc": rc, **bundle_extra})
             return 128 - rc if rc < 0 else rc
         restarts += 1
         delay = min(opts.backoff_base_s * 2 ** (restarts - 1),
@@ -417,7 +450,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                   "backoff_s": round(delay, 3),
                   "resume": bool(_child_flag_value(
                       child_args, "--serve.journal")) if serve
-                  else bool(ckpt_dir)}
+                  else bool(ckpt_dir),
+                  **bundle_extra}
         print(f"[supervisor] {json.dumps(record)}", flush=True)
         _append_event(jsonl, record)
         time.sleep(delay)

@@ -315,7 +315,7 @@ def serve_run(cfg: TrainConfig) -> Dict:
     obs = ServeObservatory(cfg.observe, chief=is_chief(), tags=tags,
                            process_index=int(tags.get("process_index",
                                                       0)),
-                           resumed=resumed_journal)
+                           resumed=resumed_journal, run_config=cfg)
     registry = obs.registry
     on_token = None
     if cfg.serve.stream and is_chief():

@@ -222,6 +222,7 @@ class Scheduler:
                  summary_extra=None, policy: str = "fifo",
                  tenant_quota: int = 0, preempt: bool = True,
                  speculator=None, tracer=None, slo_monitor=None,
+                 anomaly_hub=None,
                  export_every: float = 0.0, export_path: str = "",
                  status_fn=None, status_every: int = 0):
         if decode_priority < 1:
@@ -254,6 +255,10 @@ class Scheduler:
         # an unobserved run pays nothing.
         self.tracer = tracer
         self.slo_monitor = slo_monitor
+        # Incident detection (observe/anomaly.py): fed the TTFT /
+        # decode-dispatch-wall / queue-depth values this loop already
+        # holds on host, on the deterministic decode-step clock.
+        self.anomaly_hub = anomaly_hub
         if export_every < 0:
             raise ValueError(
                 f"export_every must be >= 0, got {export_every}")
@@ -420,6 +425,9 @@ class Scheduler:
             if slo is not None:
                 slo.observe(comp.slo, 1e3 * comp.ttft_s, comp.tok_ms,
                             tally["steps"])
+            if self.anomaly_hub is not None:
+                self.anomaly_hub.observe_completion(
+                    tally["steps"], 1e3 * comp.ttft_s)
             if tracer is not None:
                 tracer.request_done(comp.rid, why, len(comp.tokens),
                                     1e3 * comp.ttft_s)
@@ -532,6 +540,13 @@ class Scheduler:
             total_retries += 1
             t = now()
             recovery_ts.append(t)
+            if self.anomaly_hub is not None:
+                # The engine's per-slot finiteness flag IS the
+                # detection (already fetched with the step's tokens);
+                # surface it as a critical anomaly beside the
+                # containment's recovery record.
+                self.anomaly_hub.note_slot_nonfinite(
+                    tally["steps"], slot=lv.slot, rid=rid)
             self._emit("recovery", kind="slot_quarantine", rid=rid,
                        slot=lv.slot, retry=n, t_s=round(t, 4))
             if tracer is not None:
@@ -641,6 +656,12 @@ class Scheduler:
             # verify program — engine.verify_fallback_slots; fake
             # engines that only implement can_verify() keep the old
             # all-or-nothing semantics).
+            # Dispatch wall for the decode-stall detector: just the
+            # engine dispatch + its watched token fetch (admission /
+            # prefill time excluded — a re-prefill is routine, not an
+            # incident).
+            t_disp = self.clock() if self.anomaly_hub is not None \
+                else 0.0
             fb = None
             if spec is not None:
                 fb_fn = getattr(eng, "verify_fallback_slots", None)
@@ -687,6 +708,10 @@ class Scheduler:
                     spec.sync_from(eng)
             tally["occ_sum"] += eng.occupancy()
             tally["steps"] += 1
+            if self.anomaly_hub is not None:
+                self.anomaly_hub.observe_decode_step(
+                    tally["steps"], queue_depth=len(queue),
+                    step_wall_ms=1e3 * (self.clock() - t_disp))
             if queue and eng.free_slots():
                 # The starvation clock: a decode step taken WHILE a
                 # queued request waited with a free slot available.
@@ -794,6 +819,8 @@ class Scheduler:
                     / max(1, spec_stats["proposed"]), 4))
         if slo is not None:
             summary.update(slo.summary())
+        if self.anomaly_hub is not None:
+            summary["anomalies"] = self.anomaly_hub.count
         self._emit("serve_summary", **summary)
         self.summary = summary
         # One FINAL snapshot covering every completion, so the export
@@ -868,6 +895,12 @@ class Scheduler:
             snap[f"ttft_ms_p95_{cls}"] = round(percentile(vals, 95), 3)
         if self.slo_monitor is not None:
             snap["slo"] = self.slo_monitor.snapshot()
+        if self.anomaly_hub is not None:
+            # Live incident state (observe/anomaly.py): active
+            # detectors, counts, last anomaly — so the export-path
+            # pollers (ROADMAP item-1 router, item-5 Fleetbench) see
+            # incident health, not just throughput.
+            snap["anomaly"] = self.anomaly_hub.snapshot()
         return snap
 
     def _maybe_export(self, force: bool = False) -> None:
