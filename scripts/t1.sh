@@ -281,6 +281,39 @@ if [ "$detect_rc" -ne 0 ]; then
        "$DETECTLOG" >&2
 fi
 
+# Pagebench smoke (paged KV + radix prefix reuse: dense-vs-paged
+# token identity on a shared-prefix + session trace, prefill tokens
+# saved, slots-at-budget, warm-TTFT — benchmarks/pagebench.py). Tiny
+# scale with relaxed FLOPs/TTFT floors (fewer requests = fewer warm
+# hits; subprocess timing at smoke scale is noisy) — the committed
+# PAGEBENCH.json run carries the real >= 0.6 saved / 1.5x slots /
+# 0.9 TTFT gates. Identity and lost=0 stay exact. Same abort-guard
+# shape as the smokes above: a run that dies to the known container
+# XLA:CPU abort prints no page_checks line and is retried once; a
+# genuine gate failure prints one and is NOT retried.
+PAGELOG="${PAGELOG:-/tmp/_t1_page.log}"
+run_pagebench() {
+  rm -f "$PAGELOG"
+  timeout -k 10 300 env JAX_PLATFORMS=cpu python -m \
+    tensorflow_distributed_tpu.benchmarks.pagebench \
+    --requests 6 --prefix-len 32 --new-tokens 6 --turn2-gap 0.05 \
+    --min-flops-saved 0.35 --min-slots-ratio 1.2 \
+    --max-warm-ttft-ratio 1.5 --out "" 2>&1 | tee "$PAGELOG"
+  return "${PIPESTATUS[0]}"
+}
+run_pagebench
+page_rc=$?
+if ! grep -qa '"metric": "page_checks"' "$PAGELOG"; then
+  echo "[t1] no page_checks line in $PAGELOG (known container" \
+       "XLA:CPU abort) — rerunning pagebench once" >&2
+  run_pagebench
+  page_rc=$?
+fi
+if [ "$page_rc" -ne 0 ]; then
+  echo "[t1] pagebench smoke FAILED (page_rc=$page_rc) — see" \
+       "$PAGELOG" >&2
+fi
+
 # Regress smoke (cross-run regression ledger — observe/regress.py):
 # every committed artifact in the manifest compared against its own
 # HEAD baseline; an untouched tree must pass CLEAN, and any slide in
@@ -330,6 +363,9 @@ if [ "$rc" -eq 0 ] && [ "$slo_rc" -ne 0 ]; then
 fi
 if [ "$rc" -eq 0 ] && [ "$detect_rc" -ne 0 ]; then
   exit "$detect_rc"
+fi
+if [ "$rc" -eq 0 ] && [ "$page_rc" -ne 0 ]; then
+  exit "$page_rc"
 fi
 if [ "$rc" -eq 0 ] && [ "$regress_rc" -ne 0 ]; then
   exit "$regress_rc"

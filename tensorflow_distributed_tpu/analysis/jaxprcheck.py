@@ -34,6 +34,7 @@ shows exactly what moved.
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import os
 import sys
@@ -321,6 +322,103 @@ def _serve_verify_jaxpr():
         jnp.zeros((num_slots,), jnp.int32))
 
 
+#: The paged-census page size (tiny max_len 128 -> 8 pages per slot).
+_PAGE_SIZE = 16
+
+
+def _serve_paged_model():
+    """The tiny bf16 causal LM over a PAGED slot cache (serve/paging):
+    [num_pages, page_size, ...] pool leaves + per-slot page tables."""
+    from tensorflow_distributed_tpu.models.transformer import (
+        CausalLM, tiny_config)
+
+    num_slots = 4
+    cfg = tiny_config(causal=True, compute_dtype=jnp.bfloat16)
+    maxp = cfg.max_len // _PAGE_SIZE
+    cfg = dataclasses.replace(cfg, kv_page_size=_PAGE_SIZE,
+                              kv_num_pages=1 + num_slots * maxp)
+    model = CausalLM(cfg)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    tables = jnp.zeros((num_slots, maxp), jnp.int32)
+    tok = jnp.zeros((num_slots, 1), jnp.int32)
+    pos = jnp.zeros((num_slots, 1), jnp.int32)
+    shapes = jax.eval_shape(
+        lambda p, t, q, g: model.apply({"params": p}, t, decode=True,
+                                       positions=q, page_table=g,
+                                       mutable=["cache"])[1]["cache"],
+        params, tok, pos, tables)
+    cache = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+    return model, params, cache, tables, num_slots
+
+
+def _serve_decode_paged_jaxpr():
+    """THE paged decode program (serve/paging/engine.py::
+    _compiled_step_paged): the dense decode plus the page-table gather
+    — the golden pins that paging adds ZERO collectives (the gather is
+    a local addressing change, not communication)."""
+    model, params, cache, tables, num_slots = _serve_paged_model()
+
+    def run(params, cache, tok, pos, tables):
+        logits, state = model.apply(
+            {"params": params, "cache": cache}, tok[:, None],
+            decode=True, positions=pos[:, None], page_table=tables,
+            mutable=["cache"])
+        last = logits[:, -1, :]
+        ok = jnp.isfinite(last).all(axis=-1)
+        return (state["cache"],
+                jnp.argmax(last, axis=-1).astype(jnp.int32), ok)
+
+    return jax.make_jaxpr(run)(params, cache,
+                               jnp.zeros((num_slots,), jnp.int32),
+                               jnp.zeros((num_slots,), jnp.int32),
+                               tables)
+
+
+def _serve_verify_paged_jaxpr():
+    """THE paged speculative verify (serve/paging/engine.py::
+    _compiled_verify_paged) — zero collectives, like the dense one."""
+    model, params, cache, tables, num_slots = _serve_paged_model()
+    k = _VERIFY_K
+
+    def run(params, cache, toks, pos, tables):
+        positions = pos[:, None] + jnp.arange(k + 1)[None, :]
+        logits, state = model.apply(
+            {"params": params, "cache": cache}, toks, decode=True,
+            positions=positions, page_table=tables, mutable=["cache"])
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        ok = jnp.isfinite(logits).all(axis=(-1, -2))
+        return state["cache"], nxt, ok
+
+    return jax.make_jaxpr(run)(
+        params, cache, jnp.zeros((num_slots, k + 1), jnp.int32),
+        jnp.zeros((num_slots,), jnp.int32), tables)
+
+
+def _serve_prefill_paged_jaxpr():
+    """THE paged tail-prefill program (serve/paging/engine.py::
+    _compiled_prefill_paged, bucket 16): writes the uncached tail
+    through the slot's page table at an offset, attends the cached
+    prefix pages, emits the greedy first token — zero collectives."""
+    model, params, cache, tables, _num_slots = _serve_paged_model()
+    bucket = 16
+
+    def run(params, cache, prompt, positions, table, true_len):
+        logits, state = model.apply(
+            {"params": params, "cache": cache}, prompt, decode=True,
+            positions=positions, page_table=table, mutable=["cache"])
+        last = jax.lax.dynamic_index_in_dim(
+            logits, true_len - 1, axis=1, keepdims=False)
+        return (state["cache"],
+                jnp.argmax(last, axis=-1).astype(jnp.int32))
+
+    return jax.make_jaxpr(run)(
+        params, cache, jnp.zeros((1, bucket), jnp.int32),
+        jnp.zeros((1, bucket), jnp.int32), tables[:1],
+        jnp.asarray(1, jnp.int32))
+
+
 PROGRAMS = {
     "gpt_train": lambda: _train_jaxpr("gpt_lm"),
     "moe_train": lambda: _train_jaxpr("moe_lm"),
@@ -344,6 +442,12 @@ PROGRAMS = {
     # int8 entry bounds the quantize/dequantize convert count.
     "serve_verify": _serve_verify_jaxpr,
     "serve_decode_int8": lambda: _serve_decode_jaxpr("int8"),
+    # Paged KV serving (serve/paging): the paged decode/verify/prefill
+    # executables pin ZERO collectives — page-table addressing is a
+    # local gather/scatter, never communication.
+    "serve_decode_paged": _serve_decode_paged_jaxpr,
+    "serve_verify_paged": _serve_verify_paged_jaxpr,
+    "serve_prefill_paged": _serve_prefill_paged_jaxpr,
 }
 
 

@@ -1,0 +1,301 @@
+"""Paged-KV + radix-prefix-reuse benchmark -> PAGEBENCH.json.
+
+The serving claim the paging subsystem (serve/paging) exists for:
+shared-prompt traffic served WITHOUT recomputing common prefixes and
+WITHOUT reserving dense ``[max_len]`` KV rows per slot. One seeded
+shared-prefix trace (a few distinct "system prompts" + per-request
+tails, then a second MULTI-TURN round whose prompts extend round one's
+conversations) is served twice — the dense engine vs the paged engine,
+same model, same buckets, same scheduler — and four things are gated:
+
+- **token identity** (100%): every paged stream equals the dense
+  stream, and the dense streams equal one-shot greedy ``generate()``
+  (the pre-paging engine contract — ``--serve.paged off`` output is
+  the same engine class untouched);
+- **prefill FLOPs saved >= --min-flops-saved** (0.6): padded prefill
+  tokens the device actually computes, paged vs dense (the paged
+  engine prefills only uncached tails; FLOPs scale with the same
+  2 * params * tokens both sides, so the token ratio IS the FLOPs
+  ratio at leading order);
+- **slots at HBM budget >= --min-slots-ratio x dense** (1.5): the
+  dense run RESERVES num_slots * bytes_per_slot; the paged run's pool
+  PEAKS at pages_peak * page_bytes serving the same trace — the ratio
+  is how many more slots the same budget holds (composes with int8
+  KV's 1.88x: both shrink bytes, independently);
+- **warm-prefix p50 TTFT** <= --max-warm-ttft-ratio x dense: the
+  second round's turns (session re-attach, tail-only prefill) against
+  the dense engine's full re-prefill, spaced arrivals so TTFT
+  measures prefill, not queueing.
+
+Run from the repo root (CPU ok):
+    python -m tensorflow_distributed_tpu.benchmarks.pagebench
+``--out PAGEBENCH.json`` is committed; scripts/t1.sh runs a smoke
+subset with relaxed FLOPs floors (fewer requests = fewer warm hits).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _serve(engine, requests, decode_priority: int = 4):
+    """One scheduler run -> ({rid: Completion}, summary)."""
+    from tensorflow_distributed_tpu.serve.scheduler import Scheduler
+
+    sched = Scheduler(engine, decode_priority=decode_priority)
+    done = sched.run(requests)
+    return {c.rid: c for c in done}, sched.summary
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--requests", type=int, default=16,
+                        help="round-1 requests (round 2 adds one "
+                        "follow-up turn per round-1 request)")
+    parser.add_argument("--prefixes", type=int, default=3,
+                        help="distinct shared system prompts")
+    parser.add_argument("--prefix-len", type=int, default=96)
+    parser.add_argument("--tail-min", type=int, default=4)
+    parser.add_argument("--tail-max", type=int, default=12)
+    parser.add_argument("--new-tokens", type=int, default=8)
+    parser.add_argument("--num-slots", type=int, default=4)
+    parser.add_argument("--page-size", type=int, default=16)
+    parser.add_argument("--turn2-gap", type=float, default=0.25,
+                        help="round-2 arrival spacing (s): TTFT "
+                        "measures prefill, not queueing")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--min-flops-saved", type=float, default=0.6)
+    parser.add_argument("--min-slots-ratio", type=float, default=1.5)
+    parser.add_argument("--max-warm-ttft-ratio", type=float,
+                        default=0.9)
+    parser.add_argument("--no-check", action="store_true",
+                        help="report without gating")
+    parser.add_argument("--out", default="PAGEBENCH.json")
+    args = parser.parse_args(argv)
+    if args.requests < args.prefixes:
+        parser.error("--requests must be >= --prefixes")
+    if not 1 <= args.tail_min <= args.tail_max:
+        parser.error("need 1 <= --tail-min <= --tail-max")
+
+    import jax
+    import numpy as np
+    import optax
+
+    from tensorflow_distributed_tpu.models.generate import generate
+    from tensorflow_distributed_tpu.models.transformer import gpt_lm
+    from tensorflow_distributed_tpu.parallel.mesh import (
+        single_device_mesh)
+    from tensorflow_distributed_tpu.serve.buckets import (
+        default_buckets, pick_bucket)
+    from tensorflow_distributed_tpu.serve.engine import SlotDecodeEngine
+    from tensorflow_distributed_tpu.serve.paging.engine import (
+        PagedSlotEngine)
+    from tensorflow_distributed_tpu.serve.scheduler import Request
+    from tensorflow_distributed_tpu.train.state import (
+        create_train_state, param_count)
+    from tensorflow_distributed_tpu.utils.compilecache import (
+        enable_persistent_cache)
+
+    enable_persistent_cache()
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    rng = np.random.default_rng(args.seed)
+
+    # Cache length: the longest round-2 trajectory, page-aligned.
+    worst = (args.prefix_len + 2 * args.tail_max
+             + 2 * args.new_tokens)
+    max_len = -(-worst // args.page_size) * args.page_size + \
+        args.page_size
+    # A model big enough that prefill COMPUTE (not dispatch overhead)
+    # is what the warm-TTFT gate measures on CPU.
+    mesh = single_device_mesh(dev)
+    model = gpt_lm(mesh, size="tiny", d_model=128, n_layers=4,
+                   n_heads=4, d_ff=512, max_len=max_len,
+                   dropout_rate=0.0)
+    state = create_train_state(model, optax.identity(),
+                               np.zeros((2, 16), np.int32), mesh,
+                               seed=0)
+    params = state.params
+    V = model.cfg.vocab_size
+
+    # Round 1: shared system prompts + per-request tails. Sessions
+    # carry the conversation into round 2.
+    prefixes = [rng.integers(0, V, size=args.prefix_len).astype(
+        np.int32) for _ in range(args.prefixes)]
+    round1 = []
+    for i in range(args.requests):
+        tail = rng.integers(0, V, size=int(rng.integers(
+            args.tail_min, args.tail_max + 1))).astype(np.int32)
+        round1.append(Request(
+            rid=i, prompt=np.concatenate([prefixes[i % args.prefixes],
+                                          tail]),
+            max_new_tokens=args.new_tokens, session=f"conv{i}"))
+    cover = max(len(r.prompt) for r in round1) + args.tail_max + \
+        args.new_tokens + 1
+    buckets = default_buckets(min(cover, max_len), cap=max_len)
+
+    def round2_from(done):
+        """Follow-up turns: each round-1 conversation (prompt + its
+        served reply) extended by fresh user tokens — spaced arrivals
+        so TTFT isolates prefill."""
+        rng2 = np.random.default_rng(args.seed + 1)
+        out = []
+        for i in range(args.requests):
+            conv = np.concatenate(
+                [round1[i].prompt,
+                 np.asarray(done[i].tokens, np.int32)])
+            ext = rng2.integers(0, V, size=int(rng2.integers(
+                args.tail_min, args.tail_max + 1))).astype(np.int32)
+            out.append(Request(
+                rid=1000 + i, prompt=np.concatenate([conv, ext]),
+                max_new_tokens=args.new_tokens,
+                arrival_s=i * args.turn2_gap, session=f"conv{i}"))
+        return out
+
+    # --- dense: the pre-paging engine -------------------------------
+    dense = SlotDecodeEngine(model, params, args.num_slots,
+                             buckets=buckets)
+    dense.warmup()
+    t0 = time.perf_counter()
+    d1, _ = _serve(dense, round1)
+    dense_r2 = round2_from(d1)
+    d2, _ = _serve(dense, dense_r2)
+    dense_wall = time.perf_counter() - t0
+    dense_computed = sum(
+        pick_bucket(len(r.prompt), buckets)
+        for r in round1 + dense_r2)
+
+    # Pre-paging contract: the dense streams equal one-shot greedy
+    # generate() per request (--serve.paged off IS this engine).
+    ident_dense = 0
+    for r in round1 + dense_r2:
+        ref = np.asarray(generate(
+            model, params, jnp.asarray(r.prompt[None, :]),
+            args.new_tokens))[0]
+        got = d1[r.rid].tokens if r.rid < 1000 else d2[r.rid].tokens
+        ident_dense += bool(np.array_equal(ref, np.asarray(got)))
+
+    # --- paged: pool + radix + sessions -----------------------------
+    paged = PagedSlotEngine(model, params, args.num_slots,
+                            page_size=args.page_size,
+                            buckets=buckets)
+    paged.warmup()
+    t0 = time.perf_counter()
+    p1, _ = _serve(paged, [
+        Request(rid=r.rid, prompt=r.prompt,
+                max_new_tokens=r.max_new_tokens, session=r.session)
+        for r in round1])
+    paged_r2 = round2_from(p1)
+    p2, sum2 = _serve(paged, paged_r2)
+    paged_wall = time.perf_counter() - t0
+    pstats = paged.paging_stats()
+
+    # --- gates ------------------------------------------------------
+    n_total = 2 * args.requests
+    ident = sum(bool(np.array_equal(np.asarray(d1[i].tokens),
+                                    np.asarray(p1[i].tokens)))
+                for i in range(args.requests))
+    ident += sum(bool(np.array_equal(np.asarray(d2[1000 + i].tokens),
+                                     np.asarray(p2[1000 + i].tokens)))
+                 for i in range(args.requests))
+    saved = 1.0 - pstats["prefill_tokens_computed"] / max(
+        1, dense_computed)
+    # FLOPs view: prefill forward ~ 2 * params * tokens both sides.
+    mflops = 2e-6 * param_count(params)
+    dense_reserved = args.num_slots * dense.cache_bytes_per_slot()
+    # The serving WORKING SET: distinct pages live slots held at peak
+    # (shared prefix pages once). Cached pages sit outside it — they
+    # are evictable the moment an admission needs the room, so a
+    # budget sized to the working set still serves this trace.
+    paged_peak = pstats["slot_pages_peak"] * pstats["page_bytes"]
+    slots_ratio = dense_reserved / max(1, paged_peak)
+    warm_d = 1e3 * float(np.percentile(
+        [d2[1000 + i].ttft_s for i in range(args.requests)], 50))
+    warm_p = 1e3 * float(np.percentile(
+        [p2[1000 + i].ttft_s for i in range(args.requests)], 50))
+    ttft_ratio = warm_p / max(warm_d, 1e-9)
+
+    checks = {
+        "metric": "page_checks",
+        "token_identical": ident, "of": n_total,
+        "dense_identical": ident_dense, "dense_of": n_total,
+        "flops_ok": bool(saved >= args.min_flops_saved),
+        "min_flops_saved": args.min_flops_saved,
+        "slots_ok": bool(slots_ratio >= args.min_slots_ratio),
+        "min_slots_ratio": args.min_slots_ratio,
+        "ttft_ok": bool(ttft_ratio <= args.max_warm_ttft_ratio),
+        "max_warm_ttft_ratio": args.max_warm_ttft_ratio,
+        "lost": n_total - len(p1) - len(p2),
+        "evictions": pstats["page_evictions"],
+        "cow_copies": pstats["cow_copies"],
+    }
+    lines = [
+        {"metric": "page_prefill_flops",
+         "dense_tokens": dense_computed,
+         "paged_tokens": pstats["prefill_tokens_computed"],
+         "dense_mflops": round(mflops * dense_computed, 1),
+         "paged_mflops": round(
+             mflops * pstats["prefill_tokens_computed"], 1),
+         "saved_frac": round(saved, 4),
+         "model_params": param_count(params),
+         "requests": n_total, "prefixes": args.prefixes,
+         "prefix_len": args.prefix_len,
+         "buckets": ",".join(str(b) for b in buckets)},
+        {"metric": "page_hit",
+         "rate": pstats["prefix_hit_rate"],
+         "hits": pstats["prefix_hits"],
+         "hit_tokens": pstats["prefix_hit_tokens"],
+         "prompt_tokens": pstats["prompt_tokens"],
+         "sessions": pstats.get("sessions", 0),
+         "cached_pages": pstats.get("cached_pages", 0)},
+        {"metric": "page_hbm",
+         "page_size": args.page_size,
+         "page_bytes": pstats["page_bytes"],
+         "pages_per_max_len": pstats["pages_per_max_len"],
+         "dense_bytes_per_slot": dense.cache_bytes_per_slot(),
+         "dense_reserved_bytes": dense_reserved,
+         "paged_working_set_bytes": paged_peak,
+         "slot_pages_peak": pstats["slot_pages_peak"],
+         "pool_pages_peak": pstats["pages_peak"],
+         "slots_ratio": round(slots_ratio, 3),
+         "slots_at_budget_dense": args.num_slots,
+         "slots_at_budget_paged": int(
+             dense_reserved // max(1, paged_peak // args.num_slots)),
+         "unit": "x"},
+        {"metric": "page_warm_ttft",
+         "dense_p50_ms": round(warm_d, 2),
+         "paged_p50_ms": round(warm_p, 2),
+         "ratio": round(ttft_ratio, 3),
+         "turn2_gap_s": args.turn2_gap, "unit": "ms"},
+        {"metric": "page_walls",
+         "dense_wall_s": round(dense_wall, 3),
+         "paged_wall_s": round(paged_wall, 3),
+         "paged_pool_occupancy": pstats["pool_occupancy"]},
+        checks,
+    ]
+    common = {"device": dev.device_kind, "seed": args.seed}
+    lines = [dict(ln, **common) for ln in lines]
+    print("\n".join(json.dumps(ln) for ln in lines))
+    if args.out:
+        from tensorflow_distributed_tpu.observe.registry import (
+            write_jsonl)
+        write_jsonl(args.out, lines)
+    ok = (ident == n_total and ident_dense == n_total
+          and checks["lost"] == 0
+          and checks["flops_ok"] and checks["slots_ok"]
+          and checks["ttft_ok"])
+    if not args.no_check and not ok:
+        print("pagebench: GATE FAILED "
+              f"(identity {ident}/{n_total}, dense {ident_dense}/"
+              f"{n_total}, saved {saved:.3f}, slots {slots_ratio:.2f}"
+              f"x, ttft {ttft_ratio:.3f}x)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,6 +31,16 @@ Examples:
         --serve.policy slo --serve.slo-mix "high:0.25,batch:0.25" \
         --serve.tenants 4 --serve.tenant-quota 512
 
+    # paged KV + radix prefix reuse (serve/paging; README "Paged KV
+    # + prefix reuse"): shared system prompts / few-shot headers /
+    # multi-turn sessions attach cached pages instead of
+    # re-prefilling, and slots hold pages for their actual
+    # trajectory instead of reserving max_len rows
+    python -m tensorflow_distributed_tpu.cli --mode serve \
+        --model gpt_lm --serve.num-slots 8 --serve.num-requests 32 \
+        --serve.paged true --serve.page-size 16 \
+        --serve.session-turns 2
+
     # serve under fire (README "Serving under faults"): bursty
     # arrivals, slot-NaN containment + live weight swap drills, a
     # crash-durable request journal, decode watchdog; run under

@@ -337,6 +337,35 @@ class ServeConfig:
     # dims, so num_slots can grow at a fixed budget; greedy output may
     # diverge within the pinned servebench tolerance.
     kv_dtype: str = "bf16"  # bf16 | int8
+    # --- paged KV cache + radix prefix reuse (serve/paging) --------
+    # Replace the dense per-slot [max_len] KV rows with a refcounted
+    # page pool + host page tables, and arm the radix prefix cache:
+    # shared system prompts / few-shot headers / multi-turn sessions
+    # attach cached pages instead of re-prefilling, and a slot holds
+    # pages for its ACTUAL trajectory instead of reserving max_len.
+    # Default OFF — the dense engine path is byte-identical to the
+    # pre-paging tree (PAGEBENCH gates both the identity and the
+    # >= 60% prefill-FLOPs saving on a shared-prefix trace).
+    paged: bool = False
+    # Tokens per page (must divide the cache length; serve/run.py
+    # rounds an auto-sized --seq-len up to a multiple).
+    page_size: int = 16
+    # Physical pages in the pool (0 = auto: twice the dense worst
+    # case — half serving, half prefix cache). Sizing it below
+    # num_slots * max_len/page_size is how you trade cache headroom
+    # for slots under a fixed HBM budget; admission defers under
+    # pressure after LRU-evicting cached pages.
+    num_pages: int = 0
+    # Radix prefix cache + sessions (paged only). Off = pure paged
+    # allocation with no reuse — an A/B diagnostic.
+    radix: bool = True
+    # Synthetic-workload multi-turn sessions: group consecutive
+    # requests into conversations of this many turns — each turn's
+    # prompt EXTENDS the previous turn's prompt (the client re-sends
+    # the conversation so far), tagged with a shared session id.
+    # Request files carry their own per-request "session" field.
+    # Works on the dense engine too (turns just recompute).
+    session_turns: int = 1
     # --- SLO-aware scheduling --------------------------------------
     # "fifo": arrival-order admission (the original policy). "slo":
     # class-priority admission (high > standard > batch), per-tenant
@@ -428,6 +457,37 @@ class ServeConfig:
             raise ValueError(
                 f"unknown serve.kv_dtype {self.kv_dtype!r}; have "
                 f"('bf16', 'int8')")
+        if self.page_size < 1:
+            raise ValueError(
+                f"serve.page_size must be >= 1, got {self.page_size}")
+        if self.num_pages < 0:
+            raise ValueError(
+                f"serve.num_pages must be >= 0, got {self.num_pages}")
+        if not self.paged:
+            # The paged knobs silently doing nothing would be a trap —
+            # reject them without their parent (the repo-wide
+            # no-effect-without-parent rule).
+            if self.page_size != 16:
+                raise ValueError(
+                    "serve.page_size shapes the paged KV cache; add "
+                    "--serve.paged")
+            if self.num_pages:
+                raise ValueError(
+                    "serve.num_pages sizes the paged KV pool; add "
+                    "--serve.paged")
+            if not self.radix:
+                raise ValueError(
+                    "serve.radix toggles the paged engine's prefix "
+                    "cache; add --serve.paged")
+        if self.session_turns < 1:
+            raise ValueError(
+                f"serve.session_turns must be >= 1, "
+                f"got {self.session_turns}")
+        if self.session_turns > 1 and self.requests:
+            raise ValueError(
+                "serve.session_turns shapes the SYNTHETIC workload; a "
+                "request file carries its own per-request session "
+                "field — drop one of the flags")
         if self.policy not in ("fifo", "slo"):
             raise ValueError(
                 f"unknown serve.policy {self.policy!r}; have "

@@ -87,6 +87,21 @@ class TransformerConfig:
     # bf16). Composes with GQA: n_kv_heads narrows the cache,
     # int8 thins it.
     kv_cache_quant: str = "none"  # none | int8
+    # Paged KV cache for decode (serve/paging): > 0 replaces the
+    # per-row [B, max_len, ...] cache with a shared page pool
+    # [kv_num_pages, kv_page_size, ...] addressed through a per-row
+    # ``page_table`` ([B, max_pages] int32, max_pages * page_size ==
+    # max_len). Writes scatter each token's K/V into
+    # (table[pos // page_size], pos % page_size); reads gather the
+    # row's pages back into the SAME [B, max_len, ...] logical layout
+    # the dense path attends — the attend itself (masking, scale
+    # handling, numerics) is shared, so paged and dense decode produce
+    # identical math over identical cache bytes. 0 = dense (default;
+    # generate()/beam and the plain serve engine never pay paging).
+    kv_page_size: int = 0
+    # Physical pages in the pool (required > 0 when kv_page_size > 0;
+    # page 0 is the serve engine's write-off page for freed rows).
+    kv_num_pages: int = 0
     # Mixture-of-Experts: 0 = dense MLP; > 0 replaces every block's MLP
     # with an expert-parallel MoeMlp (models/moe.py).
     moe_experts: int = 0
@@ -241,7 +256,8 @@ class SelfAttention(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array, *, train: bool = False,
                  decode: bool = False,
-                 positions: Optional[jax.Array] = None) -> jax.Array:
+                 positions: Optional[jax.Array] = None,
+                 page_table: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.cfg
         h, dh = cfg.n_heads, cfg.d_model // cfg.n_heads
         # None AND 0 both mean MHA (TrainConfig uses 0 as its sentinel).
@@ -304,18 +320,46 @@ class SelfAttention(nn.Module):
                 full_attention)
             quant = cfg.kv_cache_quant == "int8"
             cache_dt = jnp.int8 if quant else k.dtype
+            paged = cfg.kv_page_size > 0
+            if paged:
+                # Paged layout (serve/paging): the cache is a POOL of
+                # fixed-size pages shared by every row; ``page_table``
+                # maps each row's logical pages to physical ones. The
+                # write/read addressing below is the only paged code —
+                # masking and the attend are the dense path's.
+                if page_table is None:
+                    raise ValueError(
+                        "kv_page_size > 0 needs a page_table "
+                        "([B, max_pages] int32)")
+                npages, psz = cfg.kv_num_pages, cfg.kv_page_size
+                if npages < 2:
+                    raise ValueError(
+                        f"kv_num_pages must be >= 2 (page 0 is the "
+                        f"write-off page), got {npages}")
+                if page_table.shape != (B, cfg.max_len // psz) or \
+                        cfg.max_len % psz:
+                    raise ValueError(
+                        f"page_table {page_table.shape} must be "
+                        f"[B={B}, max_len/page_size="
+                        f"{cfg.max_len}/{psz}] (max_len must divide "
+                        f"evenly into pages)")
+                kv_shape = (npages, psz, nk, dh)
+                sc_shape = (npages, psz, nk)
+            else:
+                kv_shape = (B, cfg.max_len, nk, dh)
+                sc_shape = (B, cfg.max_len, nk)
             ck = self.variable("cache", "key", jnp.zeros,
-                               (B, cfg.max_len, nk, dh), cache_dt)
+                               kv_shape, cache_dt)
             cv = self.variable("cache", "value", jnp.zeros,
-                               (B, cfg.max_len, nk, dh), cache_dt)
+                               kv_shape, cache_dt)
             if quant:
                 # Per-(token, head) absmax scales — the standard
                 # inference quantization grain: one f32 per cached
                 # row, 2*dh fewer bytes than the row it scales.
                 cks = self.variable("cache", "key_scale", jnp.zeros,
-                                    (B, cfg.max_len, nk), jnp.float32)
+                                    sc_shape, jnp.float32)
                 cvs = self.variable("cache", "value_scale", jnp.zeros,
-                                    (B, cfg.max_len, nk), jnp.float32)
+                                    sc_shape, jnp.float32)
             ci = self.variable("cache", "index",
                                lambda: jnp.zeros((), jnp.int32))
             pos = positions.astype(jnp.int32)       # [1 | B, L]
@@ -327,7 +371,27 @@ class SelfAttention(nn.Module):
                 return jax.lax.dynamic_update_slice(
                     buf, new, (s,) + (0,) * (new.ndim - 1))
 
-            put = jax.vmap(_row_put)
+            if paged:
+                # Scatter each token's K/V into its physical page:
+                # pid = table[pos // page_size], off = pos % page_size.
+                # Positions stay the single authority on depth — the
+                # table only relocates where a position's bytes live.
+                # Bucket-padding positions PAST the cache end (a tail
+                # prefill at offset m may pad to m + bucket > max_len;
+                # a dense row had max_len of slack for that garbage)
+                # park in the write-off page 0, which no table ever
+                # exposes to an unmasked column.
+                posb = jnp.broadcast_to(pos, (B, L))
+                lp = jnp.minimum(posb // psz, page_table.shape[1] - 1)
+                pid = jnp.take_along_axis(
+                    page_table.astype(jnp.int32), lp, axis=1)
+                pid = jnp.where(posb < cfg.max_len, pid, 0)
+                off = posb % psz                              # [B, L]
+
+                def put(buf, new, _start):
+                    return buf.at[pid, off].set(new)
+            else:
+                put = jax.vmap(_row_put)
 
             def q8(x):
                 scale = jnp.maximum(
@@ -389,13 +453,28 @@ class SelfAttention(nn.Module):
                                vc.astype(jnp.float32))
                 return o.reshape(B, L, h, dh).astype(q.dtype)
 
-            if quant:
-                out = grouped_attend(ck.value, cv.value, cks.value,
-                                     cvs.value)
-            elif nk == h:
-                out = full_attention(q, ck.value, cv.value, bias)
+            if paged:
+                # Gather the row's pages back into the SAME
+                # [B, max_len, ...] logical layout the dense attend
+                # reads — identical bytes in identical order, so the
+                # shared attend below is numerically the dense one.
+                def gathered(buf):
+                    g = buf[page_table.astype(jnp.int32)]
+                    return g.reshape((B, cfg.max_len) + buf.shape[2:])
+
+                kc_v, vc_v = gathered(ck.value), gathered(cv.value)
+                ks_v = gathered(cks.value) if quant else None
+                vs_v = gathered(cvs.value) if quant else None
             else:
-                out = grouped_attend(ck.value, cv.value)
+                kc_v, vc_v = ck.value, cv.value
+                ks_v = cks.value if quant else None
+                vs_v = cvs.value if quant else None
+            if quant:
+                out = grouped_attend(kc_v, vc_v, ks_v, vs_v)
+            elif nk == h:
+                out = full_attention(q, kc_v, vc_v, bias)
+            else:
+                out = grouped_attend(kc_v, vc_v)
         elif self.mesh is not None and self.mesh.shape[AXIS_SEQ] > 1:
             if cfg.attn_window:
                 raise ValueError(
@@ -461,13 +540,14 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False,
                  decode: bool = False,
-                 positions: Optional[jax.Array] = None) -> jax.Array:
+                 positions: Optional[jax.Array] = None,
+                 page_table: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.cfg
         # Pre-LN (trains without warmup games, unlike BERT's post-LN).
         y = _norm(cfg, "ln1")(x)
         y = SelfAttention(cfg, self.mesh, name="attn")(
             y.astype(cfg.compute_dtype), train=train, decode=decode,
-            positions=positions)
+            positions=positions, page_table=page_table)
         y = nn.Dropout(cfg.dropout_rate, deterministic=not train)(y)
         x = x + y
         y = _norm(cfg, "ln2")(x)
@@ -542,6 +622,7 @@ class TransformerLM(nn.Module):
     def __call__(self, tokens: jax.Array, *, train: bool = False,
                  decode: bool = False,
                  positions: Optional[jax.Array] = None,
+                 page_table: Optional[jax.Array] = None,
                  features_only: bool = False):
         cfg = self.cfg
         if cfg.pos_emb not in ("learned", "rope"):
@@ -599,7 +680,8 @@ class TransformerLM(nn.Module):
                              policy=resolve_remat_policy(cfg.remat_policy))
         for i in range(cfg.n_layers):
             x = block(cfg, self.mesh, name=f"layer_{i}")(x, train, decode,
-                                                         positions)
+                                                         positions,
+                                                         page_table)
         x = _norm(cfg, "ln_f")(x)
         if features_only:
             # Hand the loss the pieces of the head instead of its

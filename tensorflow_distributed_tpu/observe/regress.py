@@ -160,6 +160,22 @@ MANIFEST: Dict[str, Tuple[str, List[Check]]] = {
         ("detect_bundle.postmortem_cli_ok", "truthy"),
         ("detect_overhead.ratio", "higher", 0.0, 0.1),
     )),
+    "PAGEBENCH.json": ("jsonl", _jsonl_checks(
+        # Hit-rate / identity / lost are exact (seeded trace, greedy
+        # determinism); the warm-TTFT ratio carries a generous CPU
+        # band; FLOPs-saved and slots-at-budget are arithmetic over
+        # engine counters — tight noise bands.
+        ("page_checks.token_identical", "equal"),
+        ("page_checks.dense_identical", "equal"),
+        ("page_checks.lost", "lower", 0.0, 0.0),
+        ("page_checks.flops_ok", "truthy"),
+        ("page_checks.slots_ok", "truthy"),
+        ("page_checks.ttft_ok", "truthy"),
+        ("page_prefill_flops.saved_frac", "higher", 0.0, 0.05),
+        ("page_hit.rate", "higher", 0.0, 0.1),
+        ("page_hbm.slots_ratio", "higher", 0.0, 0.1),
+        ("page_warm_ttft.ratio", "lower", 0.5),
+    )),
     "GENBENCH.json": ("jsonl", _jsonl_checks(
         ("gen_prefill_tokens_per_sec.value", "higher", 0.3),
         ("gen_decode_tokens_per_sec.value", "higher", 0.3),

@@ -120,7 +120,15 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                     "policy", "preemptions", "spec_tokens",
                     "verify_steps", "accept_rate",
                     "spec_fallback_slots", "slo_alerts",
-                    "slo_budget_remaining_min", "slo_targets"):
+                    "slo_budget_remaining_min", "slo_targets",
+                    # Paged KV + prefix reuse (serve/paging): pool
+                    # occupancy, hit rate, evictions — present only
+                    # when the run served paged (plain reports stay
+                    # shape-stable).
+                    "prefix_hit_rate", "prefix_hits",
+                    "pool_occupancy", "pages_peak",
+                    "slot_pages_peak", "page_evictions",
+                    "cow_copies", "sessions"):
             if key in final:
                 out[f"serve_{key}"] = final[key]
     # Live SLO monitor events (observe/slo.py): alert/clear
@@ -167,6 +175,18 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     preempts = [r for r in records if r.get("event") == "preempt"]
     if preempts:
         out["serve_preempt_events"] = len(preempts)
+    # Paged-KV events (serve/paging): per-admission prefix hits and
+    # pressure evictions (RECORDS.md: prefix_hit / page_evict).
+    hits = [r for r in records if r.get("event") == "prefix_hit"]
+    if hits:
+        out["serve_prefix_hit_events"] = len(hits)
+        out["serve_prefix_hit_tokens"] = sum(
+            int(r.get("hit_tokens", 0)) for r in hits)
+    evicts = [r for r in records if r.get("event") == "page_evict"]
+    if evicts:
+        out["serve_page_evict_events"] = len(evicts)
+        out["serve_pages_evicted"] = sum(
+            int(r.get("evicted", 0)) for r in evicts)
     if steps:
         out["last_step"] = max(int(r.get("step", 0)) for r in steps)
         # The freshest rolling-window stats (each step record carries

@@ -232,10 +232,7 @@ class SlotDecodeEngine:
         self._tracer = tracer
         self._last_ok: Optional[np.ndarray] = None
         self._last_verify_fallback: list = []
-        self._step_fn = lookup_program(_compiled_step, self.model)
-        self._verify_fn = (lookup_program(_compiled_verify, self.model,
-                                          spec_tokens)
-                           if spec_tokens else None)
+        self._build_programs()
         self.verify_steps = 0
         # --check (graftcheck's runtime layer): the decode step runs
         # under jax.transfer_guard("disallow"), and the cache layout
@@ -247,6 +244,27 @@ class SlotDecodeEngine:
 
     def _zero_cache(self):
         return zero_cache(self.model, self.params, self.num_slots)
+
+    def _build_programs(self) -> None:
+        """Bind the decode/verify executables. The paged subclass
+        (serve/paging/engine.py) overrides this to bind the paged
+        variants — same names, same one-program discipline, plus the
+        page-table input."""
+        self._step_fn = lookup_program(_compiled_step, self.model)
+        self._verify_fn = (lookup_program(_compiled_verify, self.model,
+                                          self.spec_tokens)
+                           if self.spec_tokens else None)
+
+    def _dispatch_step(self, tok, pos):
+        """One decode-program dispatch (the paged subclass appends the
+        page tables); returns (cache, next tokens, per-slot ok)."""
+        with graftcheck.transfer_guard(self._check):
+            return self._step_fn(self.params, self.cache, tok, pos)
+
+    def _dispatch_verify(self, tok, pos):
+        """One verify-program dispatch (paged subclass: + tables)."""
+        with graftcheck.transfer_guard(self._check):
+            return self._verify_fn(self.params, self.cache, tok, pos)
 
     def _span(self, name: str, **args):
         if self._tracer is None:
@@ -436,9 +454,7 @@ class SlotDecodeEngine:
             start[s] = self.pos[s] - k
             fallback.append(s)
         tok, pos = jnp.asarray(toks_in), jnp.asarray(start)
-        with graftcheck.transfer_guard(self._check):
-            self.cache, nxt, ok = self._verify_fn(
-                self.params, self.cache, tok, pos)
+        self.cache, nxt, ok = self._dispatch_verify(tok, pos)
         step_no = self.decode_steps + 1
 
         def fetch():
@@ -543,9 +559,7 @@ class SlotDecodeEngine:
         # transfer guard: these two tiny explicit uploads are the
         # engine's designed input path.
         tok, pos = jnp.asarray(self.tok), jnp.asarray(self.pos)
-        with graftcheck.transfer_guard(self._check):
-            self.cache, nxt, ok = self._step_fn(
-                self.params, self.cache, tok, pos)
+        self.cache, nxt, ok = self._dispatch_step(tok, pos)
         if self._check and self.decode_steps == 0:
             # First decode step: the cache must come back in the
             # layout it was created with — sharding drift here

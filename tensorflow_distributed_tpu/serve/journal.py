@@ -47,12 +47,15 @@ class RequestJournal:
 
     def admit(self, rid: int, prompt, max_new_tokens: int,
               eos_id: int, slo: str = "standard",
-              tenant: str = "") -> None:
-        """``slo``/``tenant`` make the journal self-describing for the
-        SLO scheduler (policy="slo"): replay re-derives requests from
-        the run seed, so they are informational for the resume path —
-        but a journal read standalone (firebench workload re-derivation,
-        debugging) keeps the class/tenant story."""
+              tenant: str = "", session: str = "") -> None:
+        """``slo``/``tenant``/``session`` make the journal
+        self-describing: replay re-derives requests from the run seed,
+        so they are informational for the resume path — but a journal
+        read standalone (firebench workload re-derivation, debugging)
+        keeps the class/tenant/conversation story, and the session tag
+        is how a resumed leg's multi-turn linkage survives a SIGKILL
+        (the re-derived workload carries the same ids; pinned in
+        tests/test_paging.py)."""
         rec = {"e": "admit", "rid": int(rid),
                "prompt": [int(t) for t in np.asarray(prompt)],
                "max_new": int(max_new_tokens),
@@ -61,6 +64,8 @@ class RequestJournal:
             rec["slo"] = slo
         if tenant:
             rec["tenant"] = tenant
+        if session:
+            rec["sess"] = session
         self._line(rec)
 
     def token(self, rid: int, tok: int, t_s: float) -> None:

@@ -144,7 +144,8 @@ def _workload(cfg: TrainConfig, vocab_size: int,
                                                serve.max_new_tokens)),
                     eos_id=int(obj.get("eos_id", serve.eos_id)),
                     arrival_s=float(obj.get("arrival_s", 0.0)),
-                    slo=slo, tenant=str(obj.get("tenant", ""))))
+                    slo=slo, tenant=str(obj.get("tenant", "")),
+                    session=str(obj.get("session", ""))))
         if not reqs:
             raise ValueError(f"{serve.requests} names no requests")
         return reqs
@@ -160,6 +161,21 @@ def _workload(cfg: TrainConfig, vocab_size: int,
                                 serve.prompt_len_max + 1))
         prompts.append(
             rng.integers(0, vocab_size, size=plen).astype(np.int32))
+    sessions = [""] * serve.num_requests
+    if serve.session_turns > 1:
+        # Multi-turn conversations: consecutive requests group into
+        # sessions; each turn's prompt EXTENDS the previous turn's (a
+        # client re-sending the conversation so far plus new text).
+        # Drawn AFTER the base prompts so the first turns' content is
+        # identical to the session-less workload at the same seed.
+        k = serve.session_turns
+        for g in range(0, serve.num_requests, k):
+            sid = f"s{g // k}"
+            for j in range(g, min(g + k, serve.num_requests)):
+                sessions[j] = sid
+                if j > g:
+                    prompts[j] = np.concatenate(
+                        [prompts[j - 1], prompts[j]])
     arrivals = _arrivals(serve, serve.num_requests, rng)
     slos = ["standard"] * serve.num_requests
     if serve.slo_mix:
@@ -177,7 +193,8 @@ def _workload(cfg: TrainConfig, vocab_size: int,
                     eos_id=serve.eos_id, arrival_s=float(a),
                     slo=slos[i],
                     tenant=(f"t{i % serve.tenants}"
-                            if serve.tenants > 1 else ""))
+                            if serve.tenants > 1 else ""),
+                    session=sessions[i])
             for i, (p, a) in enumerate(zip(prompts, arrivals))]
 
 
@@ -267,8 +284,15 @@ def serve_run(cfg: TrainConfig) -> Dict:
         # spec_tokens of verify write headroom past the last useful
         # position (a user-pinned tight seq_len instead falls back to
         # plain decode near each request's end — engine.can_verify).
-        cfg = dataclasses.replace(
-            cfg, seq_len=max(need + cfg.serve.spec_tokens, 32))
+        auto_len = max(need + cfg.serve.spec_tokens, 32)
+        if cfg.serve.paged:
+            # The paged cache is page-granular: round the auto-sized
+            # length up to a whole page (an EXPLICIT --seq-len that
+            # page_size does not divide is rejected by the engine —
+            # a trained model's max_len is not ours to round).
+            ps = cfg.serve.page_size
+            auto_len = -(-auto_len // ps) * ps
+        cfg = dataclasses.replace(cfg, seq_len=auto_len)
     # With a fault plan armed (or a resumed journal, or the SLO
     # scheduler's preemption), slot-retry / replay / preemption
     # continuations can carry prompts up to prompt+new-1 tokens —
@@ -328,12 +352,25 @@ def serve_run(cfg: TrainConfig) -> Dict:
         from tensorflow_distributed_tpu.resilience.watchdog import (
             Watchdog)
         watchdog = Watchdog(sync_timeout_s=cfg.resilience.sync_timeout_s)
-    engine = SlotDecodeEngine(model, params, cfg.serve.num_slots,
-                              buckets=buckets, check=cfg.check,
-                              fault_plan=plan if plan else None,
-                              watchdog=watchdog,
-                              spec_tokens=cfg.serve.spec_tokens,
-                              tracer=obs.tracer)
+    if cfg.serve.paged:
+        from tensorflow_distributed_tpu.serve.paging.engine import (
+            PagedSlotEngine)
+        engine = PagedSlotEngine(model, params, cfg.serve.num_slots,
+                                 page_size=cfg.serve.page_size,
+                                 num_pages=cfg.serve.num_pages,
+                                 radix=cfg.serve.radix,
+                                 buckets=buckets, check=cfg.check,
+                                 fault_plan=plan if plan else None,
+                                 watchdog=watchdog,
+                                 spec_tokens=cfg.serve.spec_tokens,
+                                 tracer=obs.tracer)
+    else:
+        engine = SlotDecodeEngine(model, params, cfg.serve.num_slots,
+                                  buckets=buckets, check=cfg.check,
+                                  fault_plan=plan if plan else None,
+                                  watchdog=watchdog,
+                                  spec_tokens=cfg.serve.spec_tokens,
+                                  tracer=obs.tracer)
     # Speculative decoding: the proposer (k-gram self-draft, or a
     # draft model mirroring the slot cache — serve/speculate.py).
     from tensorflow_distributed_tpu.serve.speculate import (
@@ -438,6 +475,15 @@ def serve_run(cfg: TrainConfig) -> Dict:
                   f"accept_rate={summary.get('accept_rate')} "
                   f"verify_steps={summary.get('verify_steps')}",
                   flush=True)
+        if cfg.serve.paged:
+            print(f"[serve] paged: prefix_hit_rate="
+                  f"{summary.get('prefix_hit_rate')} pool_occupancy="
+                  f"{summary.get('pool_occupancy')} pages_peak="
+                  f"{summary.get('pages_peak')}/"
+                  f"{summary.get('num_pages')} evictions="
+                  f"{summary.get('page_evictions')} cow="
+                  f"{summary.get('cow_copies')} sessions="
+                  f"{summary.get('sessions')}", flush=True)
         if cfg.serve.policy == "slo":
             cls_bits = " ".join(
                 f"{k.rsplit('_', 1)[-1]}={summary[k]}ms"

@@ -163,6 +163,14 @@ class Request:
     arrival_s: float = 0.0
     slo: str = "standard"     # high | standard | batch
     tenant: str = ""          # quota bucket (policy="slo")
+    # Multi-turn conversation id (serve/paging): a finished request
+    # tagged with a session retains its KV pages under this key, and
+    # a follow-up turn whose prompt extends the conversation
+    # re-attaches them instead of re-prefilling. Journaled with the
+    # admit record, so a resumed leg keeps the linkage. Ignored by
+    # the dense engine (turns still serve correctly — they just
+    # recompute).
+    session: str = ""
 
 
 @dataclasses.dataclass
@@ -288,20 +296,51 @@ class Scheduler:
                 and tenant_tokens.get(tenant, 0) >= self.tenant_quota)
 
     def _pick_index(self, queue: List[Request],
-                    tenant_tokens: Dict[str, int]) -> int:
+                    tenant_tokens: Dict[str, int],
+                    skip: frozenset = frozenset()) -> int:
         """Which queued request admits next. FIFO: the head. SLO:
         under-quota before over-quota (deferral, never starvation —
         over-quota requests win when nothing else waits), then class
-        rank, then arrival order."""
+        rank, then arrival order. ``skip``: rids NOT admissible this
+        iteration (session turns waiting on an earlier turn); -1 when
+        nothing qualifies."""
         if self.policy != "slo" or len(queue) <= 1:
-            return 0
-        best, best_key = 0, None
+            if not skip:
+                return 0
+            for i, req in enumerate(queue):
+                if req.rid not in skip:
+                    return i
+            return -1
+        best, best_key = -1, None
         for i, req in enumerate(queue):
+            if req.rid in skip:
+                continue
             key = (1 if self._over_quota(req.tenant, tenant_tokens)
                    else 0, _RANK.get(req.slo, 1), i)
             if best_key is None or key < best_key:
                 best, best_key = i, key
         return best
+
+    @staticmethod
+    def _session_blocked(pending, queue, live) -> frozenset:
+        """Queued rids whose session has an EARLIER unfinished turn —
+        a client cannot send turn j+1 before it has turn j's reply, so
+        those arrivals wait for their predecessor (which also makes
+        the paged engine's session re-attach deterministic instead of
+        an interleaving-dependent cache miss). Requests without a
+        session are never blocked."""
+        earliest: Dict[str, int] = {}
+        for r in list(pending) + list(queue) + [
+                lv.req for lv in live.values()]:
+            s = getattr(r, "session", "")
+            if s and (s not in earliest or r.rid < earliest[s]):
+                earliest[s] = r.rid
+        out = set()
+        for r in queue:
+            s = getattr(r, "session", "")
+            if s and earliest.get(s) != r.rid:
+                out.add(r.rid)
+        return frozenset(out)
 
     def _pick_victim(self, live: Dict[int, _Live], cand: Request,
                      tenant_tokens: Dict[str, int]) -> Optional[_Live]:
@@ -371,6 +410,11 @@ class Scheduler:
         #                                recovery-window TTFT flag
         tracer = self.tracer
         slo = self.slo_monitor
+        # Session turn-ordering applies only when some request carries
+        # a session id — a plain workload must not pay a per-iteration
+        # scan of pending+queue+live for a constraint that cannot bind.
+        has_sessions = any(getattr(r, "session", "")
+                           for r in requests)
         # THIS run's decode-step tallies (the engine counters span its
         # whole lifetime — reuse would skew the occupancy mean) plus
         # the decoded-token count, shared with metrics_snapshot().
@@ -389,9 +433,29 @@ class Scheduler:
         def now() -> float:
             return self.clock() - t0
 
+        def free_slot(lv: _Live, retain: bool) -> None:
+            """Release the slot — through the paged engine's
+            retention path when it has one (``retain``: the request's
+            full sequence feeds the prefix cache / its session;
+            quarantine passes False — poisoned pages must never be
+            cached), else the plain free every engine (and the test
+            fakes) implements."""
+            rel = getattr(eng, "release", None)
+            if rel is None:
+                eng.free(lv.slot)
+            elif retain:
+                # graftcheck: disable=host-sync-in-loop -- builds the
+                # retention token list from HOST arrays (no device
+                # value); once per request lifetime event
+                rel(lv.slot,
+                    tokens=[int(t) for t in lv.req.prompt] + lv.tokens,
+                    session=getattr(lv.req, "session", ""))
+            else:
+                rel(lv.slot)
+
         def finish(lv: _Live, why: str) -> None:
             t = now()
-            eng.free(lv.slot)
+            free_slot(lv, retain=True)
             del live[lv.slot]
             if spec is not None:
                 spec.observe_free(lv.slot)
@@ -452,15 +516,24 @@ class Scheduler:
                 tenant_tokens[req.tenant] = (
                     tenant_tokens.get(req.tenant, 0) + 1)
 
-        def admit() -> None:
-            req = queue.pop(self._pick_index(queue, tenant_tokens))
+        def admit(pick: int) -> None:
+            req = queue.pop(pick)
             slot = eng.free_slots()[0]
             ctx = (tracer.prefill(req.rid,
                                   pick_bucket(len(req.prompt),
                                               eng.buckets), slot)
                    if tracer is not None else contextlib.nullcontext())
             with ctx:
-                first = eng.prefill(req.prompt, slot)
+                if getattr(eng, "paged", False):
+                    # Admission context the paged engine needs: the
+                    # budget sizes its page reservation, the session
+                    # keys conversation re-attach.
+                    first = eng.prefill(
+                        req.prompt, slot,
+                        max_new_tokens=req.max_new_tokens,
+                        session=getattr(req, "session", ""))
+                else:
+                    first = eng.prefill(req.prompt, slot)
             tally["decoded"] += 1
             if spec is not None:
                 spec.observe_admit(slot, req.prompt, first)
@@ -475,7 +548,9 @@ class Scheduler:
                     # continuation was journaled by the previous leg).
                     self.journal.admit(req.rid, req.prompt,
                                        req.max_new_tokens, req.eos_id,
-                                       slo=req.slo, tenant=req.tenant)
+                                       slo=req.slo, tenant=req.tenant,
+                                       session=getattr(req, "session",
+                                                       ""))
                 first_seen[req.rid] = lv.t_first
             if self.journal is not None:
                 self.journal.token(req.rid, first, now())
@@ -524,7 +599,7 @@ class Scheduler:
             request as a continuation at the head (prompt + good
             tokens, remaining budget)."""
             nonlocal total_retries, steps_since_admit
-            eng.free(lv.slot)
+            free_slot(lv, retain=False)
             del live[lv.slot]
             if spec is not None:
                 spec.observe_free(lv.slot)
@@ -568,7 +643,11 @@ class Scheduler:
             compatible, token-identical), but no retry charge, no
             recovery event — this is policy, not failure."""
             nonlocal total_preempts
-            eng.free(lv.slot)
+            # Retain: the victim's KV is valid, and its continuation
+            # re-admits with this exact sequence as its prompt — on a
+            # paged engine the preemption's re-prefill becomes a
+            # prefix-cache hit instead of a full recompute.
+            free_slot(lv, retain=True)
             del live[lv.slot]
             if spec is not None:
                 spec.observe_free(lv.slot)
@@ -606,20 +685,49 @@ class Scheduler:
             if queue and eng.free_slots() and (
                     not live or steps_since_admit
                     >= self.decode_priority):
-                admit()
-                steps_since_admit = 0
-                if self.journal is not None:
-                    self.journal.flush()
-                continue
+                # Page-pool pressure (paged engine only): the pick's
+                # worst-case reservation must fit the pool after LRU
+                # eviction of every reclaimable cached page. While
+                # live slots hold the shortfall, keep decoding — they
+                # free pages as they finish; an IDLE engine that still
+                # cannot admit will never be able to, so fail loudly
+                # instead of spinning.
+                pick = self._pick_index(
+                    queue, tenant_tokens,
+                    skip=(self._session_blocked(pending, queue, live)
+                          if has_sessions else frozenset()))
+                if pick >= 0:
+                    head = queue[pick]
+                    can = getattr(eng, "can_admit", None)
+                    if can is None or can(len(head.prompt),
+                                          head.max_new_tokens):
+                        admit(pick)
+                        steps_since_admit = 0
+                        if self.journal is not None:
+                            self.journal.flush()
+                        continue
+                    if not live:
+                        raise RuntimeError(
+                            f"request {head.rid}: page pool cannot "
+                            f"hold its reservation even with the "
+                            f"engine idle and the prefix cache fully "
+                            f"evicted — raise --serve.num-pages (or "
+                            f"lower the request budget)")
             if (self.policy == "slo" and self.preempt and queue
                     and live and not eng.free_slots()
                     and steps_since_admit >= self.decode_priority):
-                cand = queue[self._pick_index(queue, tenant_tokens)]
-                victim = self._pick_victim(live, cand, tenant_tokens)
-                if victim is not None:
-                    preempt_one(victim)
-                    continue   # slot freed — the admission branch
-                    #            admits cand next iteration
+                pick = self._pick_index(
+                    queue, tenant_tokens,
+                    skip=(self._session_blocked(pending, queue, live)
+                          if has_sessions else frozenset()))
+                if pick >= 0:
+                    cand = queue[pick]
+                    victim = self._pick_victim(live, cand,
+                                               tenant_tokens)
+                    if victim is not None:
+                        preempt_one(victim)
+                        continue   # slot freed — the admission branch
+                        #            admits cand next iteration
             if not live:
                 if pending:
                     # Nothing to decode, nothing admittable: sleep to
@@ -821,6 +929,12 @@ class Scheduler:
             summary.update(slo.summary())
         if self.anomaly_hub is not None:
             summary["anomalies"] = self.anomaly_hub.count
+        pstats = getattr(eng, "paging_stats", None)
+        if pstats is not None:
+            # Page-pool occupancy + prefix hit rate + evictions: the
+            # capacity feed the item-1 router / item-5 Fleetbench
+            # poll, and PAGEBENCH's FLOPs-saved arithmetic.
+            summary.update(pstats())
         self._emit("serve_summary", **summary)
         self.summary = summary
         # One FINAL snapshot covering every completion, so the export
@@ -893,6 +1007,9 @@ class Scheduler:
             vals.sort()
             snap[f"ttft_ms_p50_{cls}"] = round(percentile(vals, 50), 3)
             snap[f"ttft_ms_p95_{cls}"] = round(percentile(vals, 95), 3)
+        pstats = getattr(self.engine, "paging_stats", None)
+        if pstats is not None:
+            snap.update(pstats())
         if self.slo_monitor is not None:
             snap["slo"] = self.slo_monitor.snapshot()
         if self.anomaly_hub is not None:

@@ -603,7 +603,9 @@ def test_census_structure_sane():
                            "moe_train_health",
                            "pipelined_train_health",
                            "gpt_train_overlap", "moe_train_overlap",
-                           "serve_verify", "serve_decode_int8"}
+                           "serve_verify", "serve_decode_int8",
+                           "serve_decode_paged", "serve_verify_paged",
+                           "serve_prefill_paged"}
     assert golden["pipelined_train"]["collectives"].get("ppermute", 0) > 0
     assert golden["gpt_train"]["collectives"] == {}
     assert golden["serve_decode"]["collectives"] == {}
@@ -620,6 +622,16 @@ def test_census_structure_sane():
     # <= 8 extra converts per layer (tiny = 2): the q8 absmax/scale
     # math + the two scale-adjusted dots — NOT a chain-wide f32 drift.
     assert plain_up < int8_up <= plain_up + 16
+    # Paged-KV serving invariants (serve/paging): page-table
+    # addressing is local gather/scatter — zero collectives in all
+    # three paged executables, and the paged decode's upcast count
+    # EQUALS the dense decode's (same attend math over the same
+    # logical layout; paging relocates bytes, it does not widen them).
+    for name in ("serve_decode_paged", "serve_verify_paged",
+                 "serve_prefill_paged"):
+        assert golden[name]["collectives"] == {}, name
+    assert (golden["serve_decode_paged"]["upcasts"]
+            == golden["serve_decode"]["upcasts"])
     # The overlap grad-sync invariant: an explicit reduce-scatter AND
     # an explicit all-gather per scatter bucket (counts equal — a
     # bucket that scatters but never gathers back would train on
