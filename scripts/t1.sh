@@ -314,6 +314,39 @@ if [ "$page_rc" -ne 0 ]; then
        "$PAGELOG" >&2
 fi
 
+# Fleetbench smoke (fleet serving: health-aware router + failover
+# re-dispatch over 2 REAL replicas — benchmarks/fleetbench.py,
+# identity phase only): one replica SIGKILLED mid-stream, gates are
+# pure CORRECTNESS — zero lost requests, every assembled stream
+# token-identical to the single-replica reference, death/restart/
+# redispatch drills proven fired. The train->serve loop phase
+# (goodput, rolling swaps, staleness) lives in the committed
+# FLEETBENCH.json run, not here. Same abort-guard shape: a run that
+# dies to the known container XLA:CPU abort prints no fleet_checks
+# line and is retried once; a genuine gate failure prints one and is
+# NOT retried.
+FLEETLOG="${FLEETLOG:-/tmp/_t1_fleet.log}"
+run_fleetbench() {
+  rm -f "$FLEETLOG"
+  timeout -k 10 420 env JAX_PLATFORMS=cpu python -m \
+    tensorflow_distributed_tpu.benchmarks.fleetbench \
+    --phases identity --identity-requests 10 --new-tokens 16 \
+    --seq-len 48 --out "" 2>&1 | tee "$FLEETLOG"
+  return "${PIPESTATUS[0]}"
+}
+run_fleetbench
+fleet_rc=$?
+if ! grep -qa '"metric": "fleet_checks"' "$FLEETLOG"; then
+  echo "[t1] no fleet_checks line in $FLEETLOG (known container" \
+       "XLA:CPU abort) — rerunning fleetbench once" >&2
+  run_fleetbench
+  fleet_rc=$?
+fi
+if [ "$fleet_rc" -ne 0 ]; then
+  echo "[t1] fleetbench smoke FAILED (fleet_rc=$fleet_rc) — see" \
+       "$FLEETLOG" >&2
+fi
+
 # Regress smoke (cross-run regression ledger — observe/regress.py):
 # every committed artifact in the manifest compared against its own
 # HEAD baseline; an untouched tree must pass CLEAN, and any slide in
@@ -366,6 +399,9 @@ if [ "$rc" -eq 0 ] && [ "$detect_rc" -ne 0 ]; then
 fi
 if [ "$rc" -eq 0 ] && [ "$page_rc" -ne 0 ]; then
   exit "$page_rc"
+fi
+if [ "$rc" -eq 0 ] && [ "$fleet_rc" -ne 0 ]; then
+  exit "$fleet_rc"
 fi
 if [ "$rc" -eq 0 ] && [ "$regress_rc" -ne 0 ]; then
   exit "$regress_rc"

@@ -1,0 +1,461 @@
+"""Fleetbench: the continuous train→serve loop under diurnal traffic,
+gated by availability SLOs (ROADMAP item 5; README "Fleet serving").
+
+The claim this pins: a fleet of engine replicas behind the
+health-aware router stays within SLO while individual replicas die,
+restart, go stale, fire anomalies, and hot-swap checkpoints a
+concurrently-running trainer emits — goodput holds, NO request is
+lost, recovery-window p99 TTFT is bounded, model staleness is bounded
+with rolling swaps actually observed, and the control run is quiet
+(nothing shed, no replica ever quarantined).
+
+Phases (``--phases``; all replicas are real CLI subprocesses):
+
+1. **identity** — the same seeded workload served by (a) ONE plain
+   ``--mode serve`` reference process and (b) a 2-replica fleet whose
+   second replica is SIGKILLED mid-stream. The router re-dispatches
+   the dead replica's in-flight requests as journal continuations;
+   greedy determinism + shared checkpoint weights make every
+   assembled stream token-IDENTICAL to the reference (gated), with
+   zero lost requests and the death/restart/redispatch drills proven
+   fired.
+2. **loop** — a 3-replica fleet under a diurnal open-loop trace with
+   the full train→serve loop: a trainer leg extends the checkpoint
+   mid-run (twice), the controller rolls each new step across the
+   fleet one replica at a time (capacity never below N-1), and the
+   CONTROL run must shed nothing and quarantine nobody. The FAULT run
+   replays the same trace with the standard fleet fault plan — one
+   replica SIGKILLED mid-burst, one slot-NaN'd (its anomaly
+   quarantines it from admissions until it clears and REJOINS), one
+   forced stale-snapshot window — and must hold goodput >=
+   ``--min-goodput`` of control, lose nothing, shed nothing, keep
+   recovery-window p99 TTFT under ``--max-recovery-p99-ms``, and keep
+   staleness <= ``--max-staleness`` steps with >= 2 rolling swaps.
+
+Emits one JSON line per metric plus a checks line; ``--out`` writes
+FLEETBENCH.json; exit 1 on any failed gate (``--no-check`` to report
+without gating).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+
+
+def _run(cmd, env, timeout, what):
+    proc = subprocess.run(cmd, env=env, capture_output=True,
+                          text=True, timeout=timeout)
+    if proc.returncode != 0:
+        print(f"fleetbench: {what} failed rc={proc.returncode}\n"
+              f"{proc.stdout[-3000:]}\n{proc.stderr[-2000:]}",
+              file=sys.stderr)
+        raise SystemExit(1)
+    return proc
+
+
+def _write_workload(path: str, n: int, seed: int, new_tokens: int,
+                    plen_lo: int, plen_hi: int, vocab: int,
+                    rate: float, diurnal: bool) -> None:
+    """Seeded mixed-length prompts with an open-loop arrival trace
+    (diurnal: serve/run.py's sinusoidal day; else uniform) and a
+    high/standard/batch class mix — one file both the single-replica
+    reference and the fleet consume (rid = line order)."""
+    rng = np.random.default_rng(seed)
+    classes = ("high", "standard", "batch")
+    t = 0.0
+    with open(path, "w") as f:
+        for i in range(n):
+            plen = int(rng.integers(plen_lo, plen_hi + 1))
+            prompt = rng.integers(0, vocab, size=plen)
+            if diurnal:
+                lam = rate * (1.0 + 0.75 * np.sin(
+                    2 * np.pi * i / max(n, 1)))
+                arrival, t = t, t + 1.0 / lam
+            else:
+                arrival = i / rate
+            f.write(json.dumps({
+                "prompt": [int(x) for x in prompt],
+                "max_new_tokens": new_tokens,
+                "arrival_s": round(float(arrival), 4),
+                "slo": classes[i % 3]}) + "\n")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--size", default="tiny")
+    parser.add_argument("--seq-len", type=int, default=64)
+    parser.add_argument("--new-tokens", type=int, default=32)
+    parser.add_argument("--prompt-len-min", type=int, default=4)
+    parser.add_argument("--prompt-len-max", type=int, default=16)
+    parser.add_argument("--num-slots", type=int, default=2)
+    parser.add_argument("--identity-requests", type=int, default=24)
+    parser.add_argument("--loop-requests", type=int, default=36)
+    parser.add_argument("--loop-replicas", type=int, default=3)
+    parser.add_argument("--arrival-rate", type=float, default=3.0)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--min-goodput", type=float, default=0.85)
+    parser.add_argument("--max-recovery-p99-ms", type=float,
+                        default=20000.0)
+    parser.add_argument("--max-staleness", type=int, default=4,
+                        help="model-staleness bound in train steps "
+                        "(= 2 checkpoint intervals here)")
+    parser.add_argument("--phases", default="identity,loop",
+                        help="comma list from {identity, loop}")
+    parser.add_argument("--timeout", type=float, default=600.0,
+                        help="per-phase timeout (s)")
+    parser.add_argument("--workdir", default="",
+                        help="scratch dir (default: fresh tempdir, "
+                        "removed on success)")
+    parser.add_argument("--no-check", action="store_true")
+    parser.add_argument("--out", default="FLEETBENCH.json")
+    args = parser.parse_args(argv)
+    phases = [p.strip() for p in args.phases.split(",") if p.strip()]
+    bad = set(phases) - {"identity", "loop"}
+    if bad:
+        parser.error(f"unknown phases {sorted(bad)}")
+
+    from tensorflow_distributed_tpu.fleet.controller import (
+        ControllerConfig)
+    from tensorflow_distributed_tpu.fleet.router import RouterConfig
+    from tensorflow_distributed_tpu.fleet.run import (
+        load_workload, run_fleet)
+    from tensorflow_distributed_tpu.serve import journal as journal_mod
+
+    work = args.workdir or tempfile.mkdtemp(prefix="fleetbench-")
+    os.makedirs(work, exist_ok=True)
+    ckpt = os.path.join(work, "ckpt")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYTHONUNBUFFERED"] = "1"
+
+    common = [
+        "--model", "gpt_lm", "--model-size", args.size,
+        "--seq-len", str(args.seq_len), "--seed", str(args.seed),
+        "--compute-dtype", "float32",
+    ]
+
+    def train_args(ckpt_dir: str) -> list:
+        return [*common, "--dataset", "synthetic",
+                "--batch-size", "8", "--eval-every", "0",
+                "--log-every", "0", "--checkpoint-dir", ckpt_dir,
+                "--checkpoint-every", "2"]
+
+    def serve_args(ckpt_dir: str) -> list:
+        return [
+            "--mode", "serve", *common,
+            "--checkpoint-dir", ckpt_dir,
+            "--serve.num-slots", str(args.num_slots),
+            # ONE prefill bucket at the cache length: continuation
+            # re-prefills (failover, cancel-retry) share the original
+            # admissions' compiled program (firebench's rationale).
+            "--serve.buckets", str(args.seq_len),
+            "--observe.anomaly", "true",
+        ]
+
+    def trainer_leg(ckpt_dir: str, total_steps: int) -> list:
+        return [sys.executable, "-m", "tensorflow_distributed_tpu.cli",
+                *train_args(ckpt_dir), "--train-steps",
+                str(total_steps), "--resume", "true"]
+
+    # 0. Seed checkpoint (2 steps) + warmup so the persistent compile
+    # cache is hot before anything is timed.
+    _run([sys.executable, "-m", "tensorflow_distributed_tpu.cli",
+          *train_args(ckpt), "--train-steps", "2"],
+         env, args.timeout, "checkpoint prep")
+    _run([sys.executable, "-m", "tensorflow_distributed_tpu.cli",
+          *serve_args(ckpt), "--serve.num-requests", "4",
+          "--serve.max-new-tokens", "8",
+          "--serve.prompt-len-min", str(args.prompt_len_min),
+          "--serve.prompt-len-max", str(args.prompt_len_max)],
+         env, args.timeout, "warmup serve")
+
+    def arm_kill(name: str, deadline_s: float = 60.0):
+        """An action that SIGKILLs ``name`` the moment its JOURNAL
+        shows a request mid-decode with real budget left (falling
+        back to an unconditional kill at the deadline) — a fixed-time
+        kill can land in an idle gap, and a snapshot-armed one can
+        race a request's completion (the snapshot is up to an export
+        interval stale); the journal is fresh to within one decode
+        step, so the killed replica reliably leaves in-flight work
+        for the router to re-dispatch."""
+        def act(ctl, router):
+            import threading
+            import time as time_mod
+
+            def mid_decode() -> bool:
+                # Stateless full replay (named epoch): the hunt runs
+                # on its own thread and must not touch the handle's
+                # incremental tail cache the router is advancing.
+                h = ctl.members[name].handle
+                jr = h.read_journal(epoch=h.epoch)
+                return any(
+                    not e.get("done") and not e.get("reject")
+                    and 1 <= len(e.get("tokens", ()))
+                    <= args.new_tokens // 2
+                    for e in jr.values())
+
+            def hunt():
+                t_end = time_mod.monotonic() + deadline_s
+                while time_mod.monotonic() < t_end:
+                    if mid_decode():
+                        break
+                    time_mod.sleep(0.01)
+                ctl.kill(name)
+            threading.Thread(target=hunt, daemon=True).start()
+        return act
+
+    lines = []
+    checks = {"metric": "fleet_checks"}
+    common_tags = {
+        "model": f"gpt_lm/{args.size}", "num_slots": args.num_slots,
+        "new_tokens": args.new_tokens, "seed": args.seed,
+    }
+
+    # ---- phase 1: identity (failover re-dispatch == reference) -----
+    if "identity" in phases:
+        wl = os.path.join(work, "identity.jsonl")
+        _write_workload(wl, args.identity_requests, args.seed,
+                        args.new_tokens, args.prompt_len_min,
+                        args.prompt_len_max, 64, 8.0, diurnal=False)
+        ref_journal = os.path.join(work, "ref.journal")
+        _run([sys.executable, "-m", "tensorflow_distributed_tpu.cli",
+              *serve_args(ckpt), "--serve.requests", wl,
+              "--serve.journal", ref_journal],
+             env, args.timeout, "identity reference serve")
+        ref = journal_mod.replay(ref_journal)
+
+        kill_t = (args.identity_requests / 8.0) * 0.3
+        summary = run_fleet(
+            fleet_dir=os.path.join(work, "identity-fleet"),
+            replicas=2, base_args=serve_args(ckpt),
+            workload=load_workload(wl), ckpt_dir=ckpt, env=env,
+            actions=[(kill_t, arm_kill("r1"))],
+            router_cfg=RouterConfig(stale_s=2.0,
+                                    dispatch_timeout_s=60.0),
+            controller_cfg=ControllerConfig(backoff_base_s=0.25),
+            timeout_s=args.timeout,
+            jsonl=os.path.join(work, "identity-fleet.jsonl"))
+        toks = summary.pop("tokens")
+        mismatched = [
+            rid for rid in range(args.identity_requests)
+            if toks.get(str(rid)) != ref.get(rid, {}).get("tokens")]
+        lines.append({
+            "metric": "fleet_identity",
+            "requests": args.identity_requests,
+            "done": summary["requests_done"],
+            "lost": summary["requests_lost"],
+            "shed": summary["requests_shed"],
+            "token_identical":
+                args.identity_requests - len(mismatched),
+            "redispatches": summary["redispatches"],
+            "deaths": summary["deaths"],
+            "restarts": summary["restarts"],
+            "dispatch_retry_hist": summary["dispatch_retry_hist"],
+            "unit": "requests"})
+        checks.update(
+            identity_lost=summary["requests_lost"],
+            identity_token_identical=(
+                args.identity_requests - len(mismatched)),
+            identity_of=args.identity_requests,
+            identity_drills_ok=bool(
+                summary["deaths"] >= 1 and summary["restarts"] >= 1
+                and summary["redispatches"] >= 1))
+
+    # ---- phase 2: the train->serve loop, control vs fault ----------
+    if "loop" in phases:
+        wl = os.path.join(work, "loop.jsonl")
+        _write_workload(wl, args.loop_requests, args.seed + 1,
+                        args.new_tokens, args.prompt_len_min,
+                        args.prompt_len_max, 64, args.arrival_rate,
+                        diurnal=True)
+        span = args.loop_requests / args.arrival_rate
+
+        def loop_run(tag: str, actions_extra, extra_args=None):
+            import threading
+            import time as time_mod
+
+            # Per-run checkpoint dir seeded from the prep checkpoint:
+            # the trainer legs in each run start from step 2 (the
+            # control run must not pre-train the fault run's weights).
+            run_ckpt = os.path.join(work, f"ckpt-{tag}")
+            shutil.copytree(ckpt, run_ckpt)
+            state = {"done": False, "fail": ""}
+
+            def train_thread():
+                # Two SEQUENTIAL trainer legs (-> steps 4 and 6):
+                # each lands a new checkpoint mid-serving, each
+                # triggers one rolling swap. A thread (not a router
+                # action) so the sequencing wait never stalls the
+                # front-end loop.
+                try:
+                    time_mod.sleep(span * 0.15)
+                    for total in (4, 6):
+                        p = subprocess.run(
+                            trainer_leg(run_ckpt, total), env=env,
+                            capture_output=True, text=True,
+                            timeout=args.timeout)
+                        if p.returncode != 0:
+                            state["fail"] = (
+                                f"trainer leg {total}: rc="
+                                f"{p.returncode} "
+                                f"{p.stderr[-500:]}")
+                            return
+                finally:
+                    state["done"] = True
+
+            def linger(ctl, router):
+                # Outlive the trainer and its rollouts: the fleet
+                # stays up until step 6 has rolled everywhere (or the
+                # trainer failed — then stop and let the gates red).
+                if not state["done"]:
+                    return True
+                return (not state["fail"]
+                        and (ctl.rolled_step or 0) < 6)
+
+            th = threading.Thread(target=train_thread, daemon=True)
+            th.start()
+            try:
+                summary = run_fleet(
+                    fleet_dir=os.path.join(work, f"{tag}-fleet"),
+                    replicas=args.loop_replicas,
+                    base_args=serve_args(run_ckpt),
+                    workload=load_workload(wl), ckpt_dir=run_ckpt,
+                    env=env, actions=list(actions_extra),
+                    linger=linger, extra_args=extra_args,
+                    router_cfg=RouterConfig(
+                        stale_s=1.5, dispatch_timeout_s=60.0,
+                        shed_wait_s=30.0, anomaly_cooldown_s=4.0),
+                    controller_cfg=ControllerConfig(
+                        backoff_base_s=0.25, swap_timeout_s=60.0),
+                    timeout_s=args.timeout,
+                    jsonl=os.path.join(work, f"{tag}.jsonl"))
+            finally:
+                th.join(timeout=args.timeout)
+            if state["fail"]:
+                print(f"fleetbench: {tag}: {state['fail']}",
+                      file=sys.stderr)
+            summary.pop("tokens", None)
+            return summary
+
+        # CONTROL: faults off. Must be boring: nothing shed, nobody
+        # quarantined or dead, swaps still rolling.
+        ctl_sum = loop_run("control", [])
+        # FAULT: the standard fleet plan — r1 SIGKILL mid-burst, r2
+        # slot-NaN early (anomaly -> quarantine -> rejoin), r0 a
+        # forced stale-snapshot window.
+        fault_sum = loop_run(
+            "fault",
+            [(span * 0.35, arm_kill("r1")),
+             (span * 0.25, lambda ctl, router:
+              ctl.members["r0"].handle.send(
+                  {"cmd": "hold_export", "secs": 4.0}))],
+            extra_args={"r2": ["--resilience.fault-plan",
+                               "slot_nan@12:0"]})
+
+        goodput = (fault_sum.get("tokens_per_sec", 0.0)
+                   / max(ctl_sum.get("tokens_per_sec", 0.0), 1e-9))
+        lines += [
+            {"metric": "fleet_control_tokens_per_sec",
+             "value": ctl_sum.get("tokens_per_sec"),
+             "unit": "tokens/sec",
+             "wall_s": ctl_sum.get("wall_s")},
+            {"metric": "fleet_fault_tokens_per_sec",
+             "value": fault_sum.get("tokens_per_sec"),
+             "unit": "tokens/sec",
+             "wall_s": fault_sum.get("wall_s")},
+            {"metric": "fleet_goodput", "value": round(goodput, 4),
+             "unit": "fraction of control"},
+            {"metric": "fleet_control_quiet",
+             "shed": ctl_sum["requests_shed"],
+             "quarantines": ctl_sum["quarantines"],
+             "deaths": ctl_sum["deaths"],
+             "lost": ctl_sum["requests_lost"],
+             "rolling_swaps": ctl_sum["rolling_swaps"],
+             "staleness_max_steps": ctl_sum["staleness_max_steps"],
+             "unit": ""},
+            {"metric": "fleet_fault_recovery",
+             "ttft_ms_p99_recovery":
+                 fault_sum.get("ttft_ms_p99_recovery"),
+             "recovery_requests": fault_sum.get("recovery_requests"),
+             "quarantines": fault_sum["quarantines"],
+             "rejoins": fault_sum["rejoins"],
+             "deaths": fault_sum["deaths"],
+             "restarts": fault_sum["restarts"],
+             "redispatches": fault_sum["redispatches"],
+             "dispatch_retry_hist": fault_sum["dispatch_retry_hist"],
+             "unit": "ms"},
+            {"metric": "fleet_fault_staleness",
+             "value": fault_sum["staleness_max_steps"],
+             "rolling_swaps": fault_sum["rolling_swaps"],
+             "replica_swaps": fault_sum["replica_swaps"],
+             "unit": "train steps"},
+        ]
+        rec_p99 = fault_sum.get("ttft_ms_p99_recovery", 0.0) or 0.0
+        checks.update(
+            goodput=round(goodput, 4),
+            goodput_ok=bool(goodput >= args.min_goodput),
+            min_goodput=args.min_goodput,
+            loop_lost=(ctl_sum["requests_lost"]
+                       + fault_sum["requests_lost"]),
+            loop_shed=(ctl_sum["requests_shed"]
+                       + fault_sum["requests_shed"]),
+            control_quiet_ok=bool(
+                ctl_sum["requests_shed"] == 0
+                and ctl_sum["quarantines"] == 0
+                and ctl_sum["deaths"] == 0),
+            recovery_p99_ok=bool(
+                fault_sum.get("recovery_requests", 0) >= 1
+                and rec_p99 <= args.max_recovery_p99_ms),
+            max_recovery_p99_ms=args.max_recovery_p99_ms,
+            staleness_ok=bool(
+                max(ctl_sum["staleness_max_steps"],
+                    fault_sum["staleness_max_steps"])
+                <= args.max_staleness),
+            max_staleness=args.max_staleness,
+            swaps_ok=bool(ctl_sum["rolling_swaps"] >= 2
+                          and fault_sum["rolling_swaps"] >= 2),
+            fault_drills_ok=bool(
+                fault_sum["deaths"] >= 1
+                and fault_sum["restarts"] >= 1
+                and fault_sum["quarantines"] >= 2
+                and fault_sum["rejoins"] >= 1))
+
+    lines.append(checks)
+    lines = [dict(ln, **common_tags) for ln in lines]
+    print("\n".join(json.dumps(ln) for ln in lines))
+    if args.out:
+        from tensorflow_distributed_tpu.observe.registry import (
+            write_jsonl)
+        write_jsonl(args.out, lines)
+
+    ok = True
+    if "identity" in phases:
+        ok &= (checks["identity_lost"] == 0
+               and checks["identity_token_identical"]
+               == checks["identity_of"]
+               and checks["identity_drills_ok"])
+    if "loop" in phases:
+        ok &= (checks["goodput_ok"] and checks["loop_lost"] == 0
+               and checks["loop_shed"] == 0
+               and checks["control_quiet_ok"]
+               and checks["recovery_p99_ok"]
+               and checks["staleness_ok"] and checks["swaps_ok"]
+               and checks["fault_drills_ok"])
+    if not args.no_check and not ok:
+        print(f"fleetbench: checks FAILED: {checks}", file=sys.stderr)
+        return 1
+    if not args.workdir:
+        shutil.rmtree(work, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

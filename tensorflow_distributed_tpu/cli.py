@@ -65,6 +65,20 @@ Examples:
         --observe.slo "high:ttft_p95=100ms,tok_p50=30ms" \
         --observe.export-every 1 --observe.export-path serve.snap.json
 
+    # fleet serving (fleet/; README "Fleet serving"): a health-aware
+    # router + lifecycle controller over N replica processes — each
+    # an ordinary --mode serve command with a per-epoch inbox/journal/
+    # snapshot workspace; replicas die, restart, hot-swap trainer
+    # checkpoints (rolling, one at a time) while the fleet keeps
+    # answering with zero lost requests
+    python -m tensorflow_distributed_tpu.fleet.run \
+        --replicas 3 --fleet-dir /tmp/fleet \
+        --requests workload.jsonl --checkpoint-dir /tmp/ckpt \
+        --kill r1@12.5 --hold-export r0@20:3 \
+        -- --mode serve --model gpt_lm --seq-len 96 \
+           --checkpoint-dir /tmp/ckpt --serve.num-slots 4 \
+           --observe.anomaly true
+
     # graftcheck runtime checks (analysis/runtime.py; README "Static
     # analysis"): transfer guard + sharding-contract assertion
     python -m tensorflow_distributed_tpu.cli --train-steps 100 --check true

@@ -390,6 +390,19 @@ class ServeConfig:
     # Synthetic-workload tenant count (requests assigned round-robin);
     # request files carry their own "tenant" field.
     tenants: int = 1
+    # --- fleet serving (fleet/; README "Fleet serving") ------------
+    # Inbox file this replica TAILS for requests and control commands
+    # (fleet/replica.py line protocol): with an inbox the scheduler
+    # serves an OPEN-ENDED stream — no synthetic workload, requests
+    # appended by the fleet router, swap/drain/cancel commands from
+    # the controller — until a drain lands and the engine runs dry.
+    # Requires an explicit --seq-len (no workload to auto-size from)
+    # and --serve.journal (the journal is the router's data plane).
+    inbox: str = ""
+    # HBM budget (GiB) the paged auto-sizing caps --serve.num-pages
+    # against (0 = uncapped): pages = (budget - params - programs) /
+    # page_bytes. Only meaningful with --serve.paged and num_pages=0.
+    hbm_budget_gb: float = 0.0
 
     def validate(self) -> None:
         if self.num_slots < 1:
@@ -521,6 +534,39 @@ class ServeConfig:
             from tensorflow_distributed_tpu.serve.scheduler import (
                 parse_slo_mix)
             parse_slo_mix(self.slo_mix)  # syntax at config time
+        if self.hbm_budget_gb < 0:
+            raise ValueError(
+                f"serve.hbm_budget_gb must be >= 0, "
+                f"got {self.hbm_budget_gb}")
+        if self.hbm_budget_gb and not self.paged:
+            raise ValueError(
+                "serve.hbm_budget_gb caps the paged KV pool's "
+                "auto-sizing; add --serve.paged")
+        if self.hbm_budget_gb and self.num_pages:
+            raise ValueError(
+                "serve.hbm_budget_gb sizes num_pages automatically; "
+                "an explicit --serve.num-pages already pins the pool "
+                "— drop one of the flags")
+        if self.inbox:
+            # Inbox mode replaces the workload entirely — knobs that
+            # shape a synthetic/file workload would silently do
+            # nothing (the repo-wide no-effect rule).
+            if self.requests:
+                raise ValueError(
+                    "serve.inbox streams requests from the fleet "
+                    "router; a request file is a fixed workload — "
+                    "drop one of the flags")
+            if self.trace or self.slo_mix or self.session_turns > 1:
+                raise ValueError(
+                    "serve.trace/slo_mix/session_turns shape the "
+                    "SYNTHETIC workload; with serve.inbox the router "
+                    "owns arrivals, classes, and sessions — drop "
+                    "them")
+            if not self.journal:
+                raise ValueError(
+                    "serve.inbox needs --serve.journal: the journal "
+                    "is how the fleet router reads tokens back and "
+                    "re-dispatches after a replica death")
         if self.tenants < 1:
             raise ValueError(
                 f"serve.tenants must be >= 1, got {self.tenants}")
@@ -1364,6 +1410,16 @@ class TrainConfig:
             raise ValueError(
                 "serve.journal is written by the mode=serve "
                 "scheduler; drop the flag")
+        if self.serve.inbox:
+            if self.mode != "serve":
+                raise ValueError(
+                    "serve.inbox is the mode=serve fleet-replica "
+                    "intake; drop the flag or add --mode serve")
+            if not self.seq_len:
+                raise ValueError(
+                    "serve.inbox has no workload to auto-size the "
+                    "cache from — set an explicit --seq-len (the "
+                    "fleet's per-request bound)")
         if self.mode != "serve":
             if self.observe.slo:
                 raise ValueError(

@@ -176,6 +176,25 @@ MANIFEST: Dict[str, Tuple[str, List[Check]]] = {
         ("page_hbm.slots_ratio", "higher", 0.0, 0.1),
         ("page_warm_ttft.ratio", "lower", 0.5),
     )),
+    "FLEETBENCH.json": ("jsonl", _jsonl_checks(
+        # Correctness gates are exact (token identity, zero lost/
+        # shed, control quiet, drills fired); goodput carries a
+        # generous CPU band and the recovery p99 is bounded by its
+        # own gate bool rather than a noisy ms compare.
+        ("fleet_checks.identity_token_identical", "equal"),
+        ("fleet_checks.identity_lost", "lower", 0.0, 0.0),
+        ("fleet_checks.identity_drills_ok", "truthy"),
+        ("fleet_checks.goodput_ok", "truthy"),
+        ("fleet_checks.loop_lost", "lower", 0.0, 0.0),
+        ("fleet_checks.loop_shed", "lower", 0.0, 0.0),
+        ("fleet_checks.control_quiet_ok", "truthy"),
+        ("fleet_checks.recovery_p99_ok", "truthy"),
+        ("fleet_checks.staleness_ok", "truthy"),
+        ("fleet_checks.swaps_ok", "truthy"),
+        ("fleet_checks.fault_drills_ok", "truthy"),
+        ("fleet_goodput.value", "higher", 0.15),
+        ("fleet_fault_staleness.rolling_swaps", "equal"),
+    )),
     "GENBENCH.json": ("jsonl", _jsonl_checks(
         ("gen_prefill_tokens_per_sec.value", "higher", 0.3),
         ("gen_decode_tokens_per_sec.value", "higher", 0.3),

@@ -257,6 +257,65 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                 and isinstance(r.get("seconds"), (int, float)))
             if reshard_s:
                 out["reshard_seconds_total"] = round(reshard_s, 4)
+    # Fleet serving (fleet/router.py + fleet/controller.py records):
+    # the front-end's fleet_summary headline (goodput inputs,
+    # staleness, shed counts, the dispatch-retry histogram) plus a
+    # per-replica breakdown assembled from the dispatch / lifecycle /
+    # swap event streams — rendered beside the Recovery section.
+    fl_sums = [r for r in records if r.get("event") == "fleet_summary"]
+    fl_disp = [r for r in records
+               if r.get("event") == "fleet_dispatch"]
+    fl_shed = [r for r in records if r.get("event") == "fleet_shed"]
+    fl_rep = [r for r in records if r.get("event") == "fleet_replica"]
+    fl_swap = [r for r in records if r.get("event") == "fleet_swap"]
+    if fl_sums or fl_disp or fl_rep:
+        entry: Dict[str, Any] = {}
+        if fl_sums:
+            final = fl_sums[-1]
+            for key in ("requests", "requests_done", "requests_shed",
+                        "requests_lost", "dispatches", "redispatches",
+                        "dispatch_retry_hist", "quarantines",
+                        "rejoins", "deaths", "restarts",
+                        "rolling_swaps", "staleness_max_steps",
+                        "tokens_per_sec", "wall_s", "ttft_ms_p50",
+                        "ttft_ms_p95", "ttft_ms_p99",
+                        "recovery_requests", "ttft_ms_p99_recovery",
+                        "shed_by_class", "shed_reasons"):
+                if key in final:
+                    entry[key] = final[key]
+        if "dispatch_retry_hist" not in entry and fl_disp:
+            # No summary landed (crashed front-end): re-derive the
+            # histogram from the dispatch records' retry tags.
+            worst: Dict[Any, int] = {}
+            for r in fl_disp:
+                rid = r.get("rid")
+                worst[rid] = max(worst.get(rid, 0),
+                                 int(r.get("retry", 0)))
+            hist: Dict[str, int] = {}
+            for n in worst.values():
+                hist[str(n)] = hist.get(str(n), 0) + 1
+            entry["dispatch_retry_hist"] = dict(
+                sorted(hist.items(), key=lambda kv: int(kv[0])))
+        if fl_shed:
+            entry["shed_events"] = len(fl_shed)
+        replicas: Dict[str, Dict[str, Any]] = {}
+
+        def _rep_entry(name: Any) -> Dict[str, Any]:
+            return replicas.setdefault(str(name), {})
+
+        for r in fl_disp:
+            e = _rep_entry(r.get("replica", "?"))
+            e["dispatches"] = e.get("dispatches", 0) + 1
+        for r in fl_rep:
+            e = _rep_entry(r.get("replica", "?"))
+            state = str(r.get("state", "?"))
+            e[state] = e.get(state, 0) + 1
+        for r in fl_swap:
+            e = _rep_entry(r.get("replica", "?"))
+            e["swaps"] = e.get("swaps", 0) + 1
+        if replicas:
+            entry["replicas"] = dict(sorted(replicas.items()))
+        out["fleet"] = entry
     # Incident observatory (observe/anomaly.py "anomaly" records +
     # observe/flightrec.py "postmortem" records): per-detector counts,
     # the last anomaly, and any postmortem bundle the run dumped.
@@ -444,7 +503,7 @@ def render(summary: Dict[str, Any]) -> str:
                 "recovery_counts", "swap_seconds_total",
                 "mesh_changes", "mesh_change_path",
                 "reshard_seconds_total", "slo", "snapshot_last",
-                "anomalies", "postmortem_bundles",
+                "fleet", "anomalies", "postmortem_bundles",
                 "device_time", "device_time_null_records", "hosts",
                 # rendered inside the Device time section, not the
                 # generic stats list (one print per number).
@@ -557,6 +616,50 @@ def render(summary: Dict[str, Any]) -> str:
         if "reshard_seconds_total" in summary:
             lines.append(f"  {'reshard_seconds_total':<28} "
                          f"{summary['reshard_seconds_total']}")
+    if "fleet" in summary:
+        fl = summary["fleet"]
+        lines.append("Fleet")
+        head = []
+        for key in ("requests", "requests_done", "requests_shed",
+                    "requests_lost"):
+            if key in fl:
+                head.append(f"{key.replace('requests_', '')}="
+                            f"{fl[key]}")
+        if head:
+            lines.append(f"  {'requests':<28} " + " ".join(head))
+        avail = []
+        for key in ("quarantines", "rejoins", "deaths", "restarts",
+                    "shed_events"):
+            if key in fl:
+                avail.append(f"{key}={fl[key]}")
+        if avail:
+            lines.append(f"  {'availability':<28} " + " ".join(avail))
+        loop_bits = []
+        for key in ("rolling_swaps", "staleness_max_steps",
+                    "tokens_per_sec", "wall_s"):
+            if key in fl:
+                loop_bits.append(f"{key}={fl[key]}")
+        if loop_bits:
+            lines.append(f"  {'train->serve':<28} "
+                         + " ".join(loop_bits))
+        rec_bits = []
+        for key in ("recovery_requests", "ttft_ms_p99_recovery",
+                    "ttft_ms_p50", "ttft_ms_p95", "ttft_ms_p99"):
+            if key in fl:
+                rec_bits.append(f"{key}={fl[key]}")
+        if rec_bits:
+            lines.append(f"  {'latency':<28} " + " ".join(rec_bits))
+        if "dispatch_retry_hist" in fl:
+            hist = " ".join(f"{k}x:{v}" for k, v in
+                            fl["dispatch_retry_hist"].items())
+            lines.append(f"  {'dispatch_retry_hist':<28} {hist}")
+        if "shed_by_class" in fl and fl["shed_by_class"]:
+            lines.append(f"  {'shed_by_class':<28} "
+                         f"{fl['shed_by_class']}")
+        for name, entry in (fl.get("replicas") or {}).items():
+            bits = " ".join(f"{k}={v}" for k, v in
+                            sorted(entry.items()))
+            lines.append(f"  replica {name:<20} {bits}")
     if "slo" in summary:
         lines.append("SLO")
         for target, entry in summary["slo"].items():

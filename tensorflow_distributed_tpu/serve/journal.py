@@ -79,6 +79,13 @@ class RequestJournal:
     def done(self, rid: int) -> None:
         self._line({"e": "done", "rid": int(rid)})
 
+    def reject(self, rid: int) -> None:
+        """The request cannot be served here (does not fit the cache,
+        or arrived while draining). A fleet router reading the journal
+        sheds it instead of waiting forever — the replica must never
+        crash over a bad dispatch."""
+        self._line({"e": "reject", "rid": int(rid)})
+
     def flush(self) -> None:
         if self._f is not None:
             self._f.flush()
@@ -87,6 +94,34 @@ class RequestJournal:
         if self._f is not None:
             self._f.close()
             self._f = None
+
+
+def fold_record(out: Dict[int, Dict[str, Any]],
+                rec: Dict[str, Any]) -> None:
+    """Fold ONE parsed journal record into a replay accumulator — the
+    single definition of journal semantics, shared by :func:`replay`
+    and the fleet router's incremental tail
+    (fleet.replica.ReplicaHandle.read_journal)."""
+    rid = rec.get("rid")
+    if rid is None:
+        return
+    ent = out.setdefault(int(rid), {"req": None, "tokens": [],
+                                    "done": False,
+                                    "reject": False,
+                                    "last_s": 0.0})
+    kind = rec.get("e")
+    if kind == "admit":
+        ent["req"] = {"prompt": rec.get("prompt", []),
+                      "max_new": rec.get("max_new", 0),
+                      "eos": rec.get("eos", -1)}
+    elif kind == "tok":
+        ent["tokens"].append(int(rec["t"]))
+        ent["last_s"] = max(ent["last_s"],
+                            float(rec.get("s", 0.0)))
+    elif kind == "done":
+        ent["done"] = True
+    elif kind == "reject":
+        ent["reject"] = True
 
 
 def replay(path: str) -> Dict[int, Dict[str, Any]]:
@@ -106,23 +141,7 @@ def replay(path: str) -> Dict[int, Dict[str, Any]]:
                 rec = json.loads(line)
             except json.JSONDecodeError:
                 continue  # the kill's mid-write tail
-            rid = rec.get("rid")
-            if rid is None:
-                continue
-            ent = out.setdefault(int(rid), {"req": None, "tokens": [],
-                                            "done": False,
-                                            "last_s": 0.0})
-            kind = rec.get("e")
-            if kind == "admit":
-                ent["req"] = {"prompt": rec.get("prompt", []),
-                              "max_new": rec.get("max_new", 0),
-                              "eos": rec.get("eos", -1)}
-            elif kind == "tok":
-                ent["tokens"].append(int(rec["t"]))
-                ent["last_s"] = max(ent["last_s"],
-                                    float(rec.get("s", 0.0)))
-            elif kind == "done":
-                ent["done"] = True
+            fold_record(out, rec)
     return out
 
 

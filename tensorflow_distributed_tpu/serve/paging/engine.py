@@ -615,3 +615,73 @@ class PagedSlotEngine(SlotDecodeEngine):
         warm = getattr(speculator, "warmup", None)
         if warm is not None:
             warm()
+
+
+# -- num_pages auto-sizing (serve/run.py; README "Paged KV") ---------------
+
+def page_bytes_estimate(cfg, page_size: int) -> int:
+    """Bytes one page will occupy, from the model CONFIG alone — so
+    ``--serve.num-pages`` can be sized BEFORE any cache (or compiled
+    program) exists. Mirrors the cache leaves models/transformer.py
+    creates (K + V rows in the cache dtype, plus the f32
+    per-(token, head) absmax scales under int8); parity with the
+    built engine's measured :meth:`PagedSlotEngine.page_bytes` is
+    pinned in tests/test_fleet.py."""
+    nk = cfg.n_kv_heads or cfg.n_heads
+    dh = cfg.d_model // cfg.n_heads
+    if cfg.kv_cache_quant == "int8":
+        per_tok = 2 * nk * dh + 2 * nk * 4   # int8 rows + f32 scales
+    else:
+        per_tok = 2 * nk * dh * np.dtype(cfg.compute_dtype).itemsize
+    return int(page_size) * int(cfg.n_layers) * int(per_tok)
+
+
+def auto_num_pages(*, num_slots: int, need_pages: int,
+                   page_bytes: int, budget_bytes: int = 0,
+                   reserved_bytes: int = 0, observed_peak: int = 0):
+    """The ``--serve.num-pages`` default: ``(num_pages, rationale)``.
+
+    Sizing, replacing the old blind ``1 + 2 * slots * max_pages``
+    heuristic:
+
+    - **serving reservation** ``S = num_slots * need_pages`` — what
+      reserve-at-admit can pin with every slot holding a worst-case
+      trajectory (``need_pages`` = the workload bound in pages);
+    - **prefix-cache headroom** — ``observed_peak`` (a previous run's
+      measured ``slot_pages_peak``: the distinct-page working set
+      live slots actually held) when available, else ``S``: the cache
+      gets room for about one measured working set instead of a
+      second dense worst case;
+    - **pool** = 1 write-off page + S + headroom, floored at
+      ``2 + S`` (one COW page above the reservation — below that
+      admission could never clear);
+    - an ``hbm_budget_gb`` cap bounds the pool at
+      ``(budget - reserved) / page_bytes`` (``reserved`` = the
+      non-cache resident bytes, in practice the params), never below
+      the floor — the pool must still hold the reservation.
+
+    The rationale lines are printed by serve/run.py so a sizing
+    decision is always auditable in the run log.
+    """
+    serving = int(num_slots) * int(need_pages)
+    floor = 2 + serving
+    headroom = int(observed_peak) if observed_peak else serving
+    pool = 1 + serving + headroom
+    lines = [
+        f"serving reservation: {num_slots} slots x {need_pages} "
+        f"pages = {serving} pages",
+        ("prefix-cache headroom: observed slot_pages_peak "
+         f"{observed_peak}" if observed_peak else
+         f"prefix-cache headroom: {serving} pages (no observed "
+         f"slot_pages_peak — worst case)"),
+    ]
+    if budget_bytes:
+        avail = max(0, int(budget_bytes) - int(reserved_bytes))
+        cap = avail // max(1, int(page_bytes))
+        lines.append(
+            f"hbm budget: ({budget_bytes} - {reserved_bytes} "
+            f"reserved) / {page_bytes} B/page = {cap} pages")
+        pool = min(pool, cap)
+    pool = max(pool, floor)
+    lines.append(f"num_pages = {pool} (floor {floor})")
+    return pool, lines

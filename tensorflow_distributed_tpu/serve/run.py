@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 from typing import Dict, List
 
 import numpy as np
@@ -222,7 +223,14 @@ def serve_run(cfg: TrainConfig) -> Dict:
                                       cfg.bpe_vocab_size)
     else:
         vocab = cfg.synthetic_vocab or 64
-    requests = _workload(cfg, vocab, encode)
+    # Fleet-replica intake (--serve.inbox; fleet/replica.py): no
+    # workload of our own — requests stream in from the router, and
+    # the scheduler runs until a drain command lands. The journal/
+    # snapshot paths are per-epoch (a restarted replica starts empty;
+    # the router re-dispatched the dead epoch's work from its
+    # journal), so there is no resume either.
+    inbox_mode = bool(cfg.serve.inbox)
+    requests = [] if inbox_mode else _workload(cfg, vocab, encode)
 
     # Journal resume: a non-empty journal at the configured path means
     # a previous leg died mid-traffic (the supervisor re-runs the SAME
@@ -231,7 +239,7 @@ def serve_run(cfg: TrainConfig) -> Dict:
     # the kill cost is re-decoding at most the unflushed in-flight
     # tokens.
     resumed_journal = False
-    if cfg.serve.journal:
+    if cfg.serve.journal and not inbox_mode:
         played = journal_mod.replay(cfg.serve.journal)
         if played:
             requests = journal_mod.apply_replay(requests, played)
@@ -241,7 +249,7 @@ def serve_run(cfg: TrainConfig) -> Dict:
                 print(f"[serve] journal resume: {done_n} requests "
                       f"already complete, {len(requests)} to serve "
                       f"({cfg.serve.journal})", flush=True)
-    if not requests:
+    if not requests and not inbox_mode:
         if is_chief():
             print("[serve] journal resume: every request already "
                   "complete — nothing to serve", flush=True)
@@ -267,11 +275,17 @@ def serve_run(cfg: TrainConfig) -> Dict:
             and cfg.kv_cache_quant == "none"):
         cfg = dataclasses.replace(cfg, kv_cache_quant="int8")
 
-    max_prompt = max(len(r.prompt) for r in requests)
-    # Per-request trajectory bound (what actually has to fit the
-    # cache); bucket padding is prefill-only slack and is clamped to
-    # the cache length by the ladder cap below.
-    need = max(len(r.prompt) + r.max_new_tokens for r in requests)
+    if inbox_mode:
+        # No workload to measure: the explicit --seq-len (validated
+        # present) IS the per-request bound, and continuations can
+        # re-prefill at any depth — cover the whole cache.
+        max_prompt = need = cfg.seq_len
+    else:
+        max_prompt = max(len(r.prompt) for r in requests)
+        # Per-request trajectory bound (what actually has to fit the
+        # cache); bucket padding is prefill-only slack and is clamped
+        # to the cache length by the ladder cap below.
+        need = max(len(r.prompt) + r.max_new_tokens for r in requests)
     if cfg.seq_len and need > cfg.seq_len:
         raise ValueError(
             f"--seq-len {cfg.seq_len} cannot hold the workload: the "
@@ -298,7 +312,7 @@ def serve_run(cfg: TrainConfig) -> Dict:
     # continuations can carry prompts up to prompt+new-1 tokens —
     # size the default ladder to the full trajectory so a re-prefill
     # never outgrows the largest bucket.
-    cover = (need if (plan or resumed_journal
+    cover = (need if (plan or resumed_journal or inbox_mode
                       or cfg.serve.policy == "slo") else max_prompt)
     buckets = (parse_buckets(cfg.serve.buckets) if cfg.serve.buckets
                else default_buckets(cover, cap=cfg.seq_len))
@@ -319,6 +333,7 @@ def serve_run(cfg: TrainConfig) -> Dict:
                     f"request {r.rid}: prompt ids {bad} outside the "
                     f"model vocabulary [0, {model.cfg.vocab_size})")
     restored = False
+    ckpt_step0 = None
     if cfg.checkpoint_dir:
         # Same restore semantics as mode=generate: local-SGD
         # checkpoints persist the replica stack — average it into the
@@ -328,6 +343,10 @@ def serve_run(cfg: TrainConfig) -> Dict:
         else:
             state = ckpt.restore(cfg.checkpoint_dir, state)
         restored = True
+        # Which trained step these weights came from — rides
+        # metrics_snapshot as ckpt_step (the fleet controller's
+        # model-staleness feed; _swap keeps it current).
+        ckpt_step0 = int(state.step)
     params = state.params if state.ema is None else state.ema
 
     # The serve observatory (observe/hub.py): metrics registry +
@@ -354,10 +373,43 @@ def serve_run(cfg: TrainConfig) -> Dict:
         watchdog = Watchdog(sync_timeout_s=cfg.resilience.sync_timeout_s)
     if cfg.serve.paged:
         from tensorflow_distributed_tpu.serve.paging.engine import (
-            PagedSlotEngine)
+            PagedSlotEngine, auto_num_pages, page_bytes_estimate)
+        num_pages = cfg.serve.num_pages
+        if not num_pages:
+            # Auto-size the page pool from the workload's trajectory
+            # bound, a previous run's OBSERVED slot_pages_peak (read
+            # from the still-standing --observe.export-path snapshot
+            # when one exists), and the --serve.hbm-budget-gb cap
+            # with the params' resident bytes subtracted — replacing
+            # the old blind 2x heuristic (ROADMAP item-2 follow-up).
+            ps = cfg.serve.page_size
+            observed_peak = 0
+            if cfg.observe.export_path and os.path.exists(
+                    cfg.observe.export_path):
+                try:
+                    with open(cfg.observe.export_path) as f:
+                        observed_peak = int(
+                            json.load(f).get("slot_pages_peak", 0))
+                except (OSError, ValueError):
+                    observed_peak = 0
+            import jax
+            reserved = sum(
+                int(np.prod(x.shape)) * x.dtype.itemsize
+                for x in jax.tree_util.tree_leaves(params))
+            num_pages, rationale = auto_num_pages(
+                num_slots=cfg.serve.num_slots,
+                need_pages=-(-need // ps),
+                page_bytes=page_bytes_estimate(model.cfg, ps),
+                budget_bytes=int(cfg.serve.hbm_budget_gb * 2 ** 30),
+                reserved_bytes=reserved,
+                observed_peak=observed_peak)
+            if is_chief():
+                for line in rationale:
+                    print(f"[serve] paged auto-size: {line}",
+                          flush=True)
         engine = PagedSlotEngine(model, params, cfg.serve.num_slots,
                                  page_size=cfg.serve.page_size,
-                                 num_pages=cfg.serve.num_pages,
+                                 num_pages=num_pages,
                                  radix=cfg.serve.radix,
                                  buckets=buckets, check=cfg.check,
                                  fault_plan=plan if plan else None,
@@ -400,8 +452,18 @@ def serve_run(cfg: TrainConfig) -> Dict:
     if is_chief() and obs.status_every:
         def status_fn(line: str) -> None:
             print(line, flush=True)
+    feed = None
+    if inbox_mode:
+        from tensorflow_distributed_tpu.fleet.replica import InboxFeed
+        feed = InboxFeed(cfg.serve.inbox,
+                         default_max_new=cfg.serve.max_new_tokens,
+                         default_eos=cfg.serve.eos_id)
+        if is_chief():
+            print(f"[serve] fleet replica: inbox {cfg.serve.inbox} "
+                  f"(serving until a drain command)", flush=True)
     sched = Scheduler(engine, decode_priority=cfg.serve.decode_priority,
                       on_token=on_token,
+                      feed=feed, served_ckpt_step=ckpt_step0,
                       fault_plan=plan if plan else None,
                       journal=journal, reload_fn=reload_fn,
                       slot_retries=cfg.serve.slot_retries,
@@ -440,12 +502,18 @@ def serve_run(cfg: TrainConfig) -> Dict:
             watchdog.close()
         obs.close()
     summary = dict(sched.summary)
-    ttfts = np.asarray([c.ttft_s for c in done])
-    summary["ttft_ms_p50"] = round(1e3 * float(np.percentile(ttfts, 50)), 3)
-    summary["ttft_ms_p95"] = round(1e3 * float(np.percentile(ttfts, 95)), 3)
-    summary["ttft_ms_p99"] = round(1e3 * float(np.percentile(ttfts, 99)), 3)
-    summary["tok_ms_mean"] = round(
-        float(np.mean([c.tok_ms for c in done])), 4)
+    if done:
+        # An inbox-mode replica can drain without ever serving a
+        # request — the percentile math needs at least one.
+        ttfts = np.asarray([c.ttft_s for c in done])
+        summary["ttft_ms_p50"] = round(
+            1e3 * float(np.percentile(ttfts, 50)), 3)
+        summary["ttft_ms_p95"] = round(
+            1e3 * float(np.percentile(ttfts, 95)), 3)
+        summary["ttft_ms_p99"] = round(
+            1e3 * float(np.percentile(ttfts, 99)), 3)
+        summary["tok_ms_mean"] = round(
+            float(np.mean([c.tok_ms for c in done])), 4)
     # Per-SLO-class TTFT p95: the number the SLO scheduler exists to
     # move (servebench's p95_ttft_under_load gate reads the high
     # class). Emitted per class actually present, FIFO runs included —
@@ -465,8 +533,8 @@ def serve_run(cfg: TrainConfig) -> Dict:
               f"{summary['wall_s']}s — "
               f"{summary['tokens_per_sec']} tok/s, occupancy "
               f"{summary['mean_slot_occupancy']}, ttft p50 "
-              f"{summary['ttft_ms_p50']}ms / p95 "
-              f"{summary['ttft_ms_p95']}ms, "
+              f"{summary.get('ttft_ms_p50')}ms / p95 "
+              f"{summary.get('ttft_ms_p95')}ms, "
               f"{summary['prefill_compiles']} prefill programs "
               f"(buckets {summary['buckets']}), "
               f"{summary['params']} params", flush=True)
@@ -496,7 +564,8 @@ def serve_run(cfg: TrainConfig) -> Dict:
                   f"swaps={summary['swaps']} "
                   f"swap_s={summary['swap_seconds']} "
                   f"resumed={summary['resumed']} "
-                  f"ttft p99 {summary['ttft_ms_p99']}ms", flush=True)
+                  f"ttft p99 {summary.get('ttft_ms_p99')}ms",
+                  flush=True)
         if cfg.observe.slo:
             print(f"[serve] slo monitor: "
                   f"alerts={summary.get('slo_alerts', 0)} "

@@ -232,7 +232,8 @@ class Scheduler:
                  speculator=None, tracer=None, slo_monitor=None,
                  anomaly_hub=None,
                  export_every: float = 0.0, export_path: str = "",
-                 status_fn=None, status_every: int = 0):
+                 status_fn=None, status_every: int = 0,
+                 feed=None, served_ckpt_step=None):
         if decode_priority < 1:
             raise ValueError(
                 f"decode_priority must be >= 1, got {decode_priority}")
@@ -274,6 +275,23 @@ class Scheduler:
         self.export_path = export_path
         self.status_fn = status_fn
         self.status_every = int(status_every)
+        # Streaming intake (fleet/replica.py InboxFeed, or any object
+        # with poll() -> (requests, commands)): with a feed, run()
+        # serves an OPEN-ENDED stream — it keeps polling for work and
+        # control commands ("swap"/"drain"/"cancel"/"hold_export")
+        # until a drain command lands and the engine runs dry.
+        self.feed = feed
+        # The checkpoint step the served weights came from (run.py
+        # sets it from the startup restore; _swap updates it) — the
+        # fleet controller's model-staleness feed.
+        self.served_ckpt_step = served_ckpt_step
+        self.draining = False
+        self._export_hold_until = 0.0
+        # Monotonic snapshot sequence + wall timestamp + pid: a
+        # poller can tell a FROZEN snapshot file (stale seq) from a
+        # healthy idle replica (seq keeps advancing) — the fleet
+        # router's liveness probe.
+        self._snap_seq = 0
         # Run-identity fields (seed, trace name) merged into the
         # serve_summary RECORD so the JSONL artifact is reproducible
         # standalone (FIREBENCH re-derives workloads from it).
@@ -672,7 +690,99 @@ class Scheduler:
                                slot=lv.slot, slo=lv.req.slo)
                 tracer.request_evicted(rid, "preempt")
 
-        while pending or queue or live:
+        def cancel_rid(rid: int) -> None:
+            """Fleet router moved this request elsewhere: drop it
+            wherever it is (queue, pending, or a live slot — freed
+            with retention, its KV is valid) without a completion; the
+            new owner re-derives the stream (greedy determinism)."""
+            for i, r in enumerate(queue):
+                if r.rid == rid:
+                    queue.pop(i)
+                    self._emit("serve_cancel", rid=rid, where="queue")
+                    return
+            for i, r in enumerate(pending):
+                if r.rid == rid:
+                    del pending[i]
+                    self._emit("serve_cancel", rid=rid,
+                               where="pending")
+                    return
+            for slot, lv in list(live.items()):
+                if lv.req.rid == rid:
+                    free_slot(lv, retain=True)
+                    del live[slot]
+                    if spec is not None:
+                        spec.observe_free(slot)
+                    self._emit("serve_cancel", rid=rid, where="live",
+                               slot=slot)
+                    return
+
+        def feed_cmd(cmd) -> None:
+            kind = cmd.get("cmd")
+            if kind == "drain":
+                self.draining = True
+            elif kind == "swap":
+                self._swap(now, recovery_ts)
+            elif kind == "cancel":
+                cancel_rid(int(cmd.get("rid", -1)))
+            elif kind == "hold_export":
+                self._export_hold_until = (
+                    self.clock() + float(cmd.get("secs", 0.0)))
+
+        def feed_request(r) -> None:
+            nonlocal has_sessions
+            bad = (self.draining or r.max_new_tokens < 1
+                   or not eng.fits(len(r.prompt), r.max_new_tokens))
+            if not bad:
+                # Paged pool feasibility: a reservation that can
+                # NEVER fit (even with the prefix cache fully
+                # evicted; +1 = the worst-case COW page while the
+                # radix cache is armed — can_admit's rule) must be
+                # rejected here — the idle-engine admission path
+                # raises, and a replica must never crash on a bad
+                # dispatch.
+                pf = getattr(eng, "pages_for", None)
+                if pf is not None:
+                    need = pf(len(r.prompt), r.max_new_tokens)
+                    if getattr(eng, "radix", None) is not None:
+                        need += 1
+                    bad = need > eng.pool.capacity
+            if bad:
+                self._emit("serve_reject", rid=r.rid,
+                           prompt_len=len(r.prompt),
+                           max_new=r.max_new_tokens,
+                           draining=self.draining)
+                if self.journal is not None:
+                    self.journal.reject(r.rid)
+                    self.journal.flush()
+                return
+            # A duplicate of an already-present rid SUPERSEDES it (a
+            # router double-send must not interleave two token
+            # streams into one journal entry).
+            cancel_rid(r.rid)
+            r.arrival_s = now()
+            pending.append(r)
+            if getattr(r, "session", ""):
+                has_sessions = True
+
+        def poll_feed() -> None:
+            """Streamed intake: new requests join ``pending`` due
+            immediately; control commands act between decode steps.
+            Items are processed in FILE ORDER — a stalled replica can
+            read a dispatch, its cancel, and the re-dispatched
+            continuation in ONE batch, and only line order makes that
+            sequence mean what the router intended. An unservable
+            request is REJECTED into the journal (the router sheds
+            it) instead of crashing the replica."""
+            for item in self.feed.poll():
+                if isinstance(item, dict):
+                    feed_cmd(item)
+                else:
+                    feed_request(item)
+
+        while pending or queue or live or (
+                self.feed is not None and not self.draining):
+            if self.feed is not None:
+                poll_feed()
             # Open-loop arrivals: everything whose time has come.
             while pending and pending[0].arrival_s <= now():
                 req = pending.popleft()
@@ -731,8 +841,20 @@ class Scheduler:
             if not live:
                 if pending:
                     # Nothing to decode, nothing admittable: sleep to
-                    # the next arrival instead of spinning.
-                    time.sleep(max(0.0, pending[0].arrival_s - now()))
+                    # the next arrival instead of spinning (bounded
+                    # with a feed — new work or a command can land
+                    # before the next synthetic arrival).
+                    delay = max(0.0, pending[0].arrival_s - now())
+                    if self.feed is not None:
+                        delay = min(delay, 0.02)
+                    time.sleep(delay)
+                    continue
+                if self.feed is not None and not self.draining:
+                    # Idle but open for business: keep the snapshot
+                    # export fresh (the router's liveness signal) and
+                    # poll again shortly.
+                    self._maybe_export()
+                    time.sleep(0.02)
                     continue
                 break  # queue must be empty too (free slots exist)
             if plan:
@@ -975,7 +1097,15 @@ class Scheduler:
                 "started")
         tally = st["tally"]
         now = self.clock() - st["t0"]
+        self._snap_seq += 1
         snap: Dict[str, Any] = {
+            # Liveness triplet: monotonic seq + wall-clock timestamp +
+            # pid, so a poller (fleet/router.py) can tell a frozen
+            # snapshot from a healthy idle replica — and a restarted
+            # process from the one it replaced.
+            "seq": self._snap_seq,
+            "wall_ts": round(time.time(), 3),
+            "pid": os.getpid(),
             "t_s": round(now, 4),
             "decode_steps": tally["steps"],
             "requests_done": len(st["done"]),
@@ -992,7 +1122,18 @@ class Scheduler:
             "preemptions": sum(st["preempts_map"].values()),
             "swaps": getattr(self.engine, "swaps", 0),
             "policy": self.policy,
+            # Capacity facts a router needs to pre-check dispatches
+            # (engine limits are not otherwise visible fleet-side;
+            # getattr: test fakes may not model a cache length).
+            "num_slots": getattr(self.engine, "num_slots", 0),
+            "max_len": getattr(self.engine, "max_len", 0),
         }
+        if self.served_ckpt_step is not None:
+            # The fleet controller's model-staleness feed: which
+            # trained step these weights came from.
+            snap["ckpt_step"] = int(self.served_ckpt_step)
+        if self.draining:
+            snap["draining"] = True
         rate = self._window_rate()
         if rate is not None:
             snap["tokens_per_sec_window"] = round(rate, 2)
@@ -1030,6 +1171,12 @@ class Scheduler:
             return
         now = self.clock()
         if not force and now - self._last_export < self.export_every:
+            return
+        if not force and now < self._export_hold_until:
+            # The stale-snapshot drill (fleet "hold_export" command):
+            # exports freeze, the file's seq stops advancing, and the
+            # router must quarantine on staleness — exactly what this
+            # window exists to prove.
             return
         self._last_export = now
         snap = self.metrics_snapshot()
@@ -1071,6 +1218,7 @@ class Scheduler:
         self.engine.swap_params(params)
         dt = self.clock() - t0
         self._swap_seconds += dt
+        self.served_ckpt_step = ckpt_step
         t = now()
         recovery_ts.append(t)
         self._emit("recovery", kind="weight_swap",
