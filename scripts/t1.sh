@@ -347,6 +347,38 @@ if [ "$fleet_rc" -ne 0 ]; then
        "$FLEETLOG" >&2
 fi
 
+# Fleet-observatory smoke (cross-replica trace stitching + e2e SLO
+# accounting — benchmarks/fleetobsbench.py, failover phase only): a
+# 2-replica observed fleet with one SIGKILL + a survivor decode
+# stall; gates are pure correctness — merged trace balanced with all
+# three failover legs present, fleet SLO alert on fault / quiet on
+# control, latency decomposition sums to e2e, exported snapshot ==
+# report. The overhead phase (interleaved on/off throughput ratio)
+# lives in the committed FLEETOBSBENCH.json run, not here. Same
+# abort-guard shape as the benches above.
+FLEETOBSLOG="${FLEETOBSLOG:-/tmp/_t1_fleetobs.log}"
+run_fleetobsbench() {
+  rm -f "$FLEETOBSLOG"
+  timeout -k 10 420 env JAX_PLATFORMS=cpu python -m \
+    tensorflow_distributed_tpu.benchmarks.fleetobsbench \
+    --phases failover --requests 10 --new-tokens 32 --stall-s 3 \
+    --slo "ttft_p95=30s,tok_p99=60ms" --residual-tol 0.25 \
+    --out "" 2>&1 | tee "$FLEETOBSLOG"
+  return "${PIPESTATUS[0]}"
+}
+run_fleetobsbench
+fleetobs_rc=$?
+if ! grep -qa '"metric": "fleetobs_checks"' "$FLEETOBSLOG"; then
+  echo "[t1] no fleetobs_checks line in $FLEETOBSLOG (known" \
+       "container XLA:CPU abort) — rerunning fleetobsbench once" >&2
+  run_fleetobsbench
+  fleetobs_rc=$?
+fi
+if [ "$fleetobs_rc" -ne 0 ]; then
+  echo "[t1] fleetobsbench smoke FAILED (fleetobs_rc=$fleetobs_rc)" \
+       "— see $FLEETOBSLOG" >&2
+fi
+
 # Regress smoke (cross-run regression ledger — observe/regress.py):
 # every committed artifact in the manifest compared against its own
 # HEAD baseline; an untouched tree must pass CLEAN, and any slide in
@@ -402,6 +434,9 @@ if [ "$rc" -eq 0 ] && [ "$page_rc" -ne 0 ]; then
 fi
 if [ "$rc" -eq 0 ] && [ "$fleet_rc" -ne 0 ]; then
   exit "$fleet_rc"
+fi
+if [ "$rc" -eq 0 ] && [ "$fleetobs_rc" -ne 0 ]; then
+  exit "$fleetobs_rc"
 fi
 if [ "$rc" -eq 0 ] && [ "$regress_rc" -ne 0 ]; then
   exit "$regress_rc"

@@ -1,0 +1,462 @@
+"""Fleetobsbench: the fleet observatory under a real SIGKILL failover
+(README "Fleet observatory"; observe/fleet_trace.py + fleet/run.py
+``--fleet.*`` flags).
+
+The claim this pins: with the observatory armed, the fleet is ONE
+observable system — not N disjoint per-process views. Concretely:
+
+1. **Stitched trace** — a 2-replica fleet serves a seeded workload
+   while one replica is SIGKILLED mid-decode. The merged
+   ``fleet_trace.json`` must be span-balanced AND render the moved
+   request's full story on one timeline: the router's ``request`` span
+   (leg 0), the dead replica's serve spans closed at ``process_death``
+   (leg A), and the surviving replica's continuation under a fresh
+   wire id (leg B).
+2. **End-to-end SLO accounting** — the router-level SLOMonitor scores
+   CLIENT-perceived latency (admission -> first token / inter-token,
+   retries and failovers included). The fault run (a decode stall on
+   the survivor + the SIGKILL) must fire ``fleet_slo_alert``; the
+   control run must stay quiet.
+3. **Latency decomposition** — per-request router-queue / inbox-lag /
+   replica-queue / prefill / decode components from the stitched
+   timeline must sum to the measured end-to-end latency within
+   ``--residual-tol`` (control run: no dead time to hide in).
+4. **Control-plane feed** — the final ``--fleet.export-path`` snapshot
+   parses and its per-class end-to-end p50/p95 equal observe.report's
+   fold of the same run EXACTLY (the PR-11 snapshot==report contract,
+   fleet level); the fleetview CLI renders the run.
+5. **Overhead** — min-of-interleaved tokens/sec with the full
+   observatory on vs off must stay >= ``--min-tps-ratio``.
+
+Phases (``--phases``): ``failover`` (control + fault runs, claims
+1-4) and ``overhead`` (claim 5). Emits one JSON line per metric plus
+a ``fleetobs_checks`` line; ``--out`` writes FLEETOBSBENCH.json;
+exit 1 on any failed gate (``--no-check`` to report without gating).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+
+
+def _run(cmd, env, timeout, what):
+    proc = subprocess.run(cmd, env=env, capture_output=True,
+                          text=True, timeout=timeout)
+    if proc.returncode != 0:
+        print(f"fleetobsbench: {what} failed rc={proc.returncode}\n"
+              f"{proc.stdout[-3000:]}\n{proc.stderr[-2000:]}",
+              file=sys.stderr)
+        raise SystemExit(1)
+    return proc
+
+
+def _write_workload(path: str, n: int, seed: int, new_tokens: int,
+                    plen_lo: int, plen_hi: int, vocab: int,
+                    rate: float) -> None:
+    """Seeded mixed-length prompts on a uniform open-loop arrival
+    trace with the high/standard/batch class cycle (rid = line
+    order — fleet/run.py's comparability contract)."""
+    rng = np.random.default_rng(seed)
+    classes = ("high", "standard", "batch")
+    with open(path, "w") as f:
+        for i in range(n):
+            plen = int(rng.integers(plen_lo, plen_hi + 1))
+            prompt = rng.integers(0, vocab, size=plen)
+            f.write(json.dumps({
+                "prompt": [int(x) for x in prompt],
+                "max_new_tokens": new_tokens,
+                "arrival_s": round(i / rate, 4),
+                "slo": classes[i % 3]}) + "\n")
+
+
+def _load_jsonl(path: str):
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+def _failover_legs(trace_path: str):
+    """The rids whose merged-trace story shows all three legs: the
+    router ``request`` span, serve spans for >= 2 generations on >= 2
+    distinct source processes, and a ``process_death`` closure on the
+    dead generation."""
+    from tensorflow_distributed_tpu.observe.fleet_trace import (
+        gen_to_rid)
+    from tensorflow_distributed_tpu.observe.trace import load_trace
+    events = load_trace(trace_path)
+    serve_legs = {}
+    router_rids = set()
+    death_gens = set()
+    for ev in events:
+        args = ev.get("args") or {}
+        if ev.get("ph") == "b" and ev.get("name") == "request":
+            try:
+                sid = int(ev.get("id"))
+            except (TypeError, ValueError):
+                continue
+            if ev.get("cat") == "serve":
+                serve_legs.setdefault(gen_to_rid(sid), set()).add(
+                    (int(ev.get("pid", -1)), sid))
+            elif ev.get("cat") == "fleet":
+                router_rids.add(sid)
+        elif ev.get("ph") == "e" and args.get("process_death"):
+            try:
+                death_gens.add(int(ev.get("id")))
+            except (TypeError, ValueError):
+                pass
+    moved = []
+    for rid, legs in sorted(serve_legs.items()):
+        pids = {p for p, _ in legs}
+        gens = {g for _, g in legs}
+        if (len(pids) >= 2 and len(gens) >= 2 and rid in router_rids
+                and any(gen_to_rid(g) == rid for g in death_gens)):
+            moved.append(rid)
+    return moved, len(events)
+
+
+def _snapshot_eq_report(fleet_dir: str, snap_path: str) -> bool:
+    """The final control-plane snapshot's per-class end-to-end
+    p50/p95 must equal observe.report's fleet_request fold exactly —
+    same population, same nearest-rank percentile."""
+    from tensorflow_distributed_tpu.observe.report import summarize
+    rep = summarize(_load_jsonl(
+        os.path.join(fleet_dir, "fleet.jsonl"))).get("fleet", {})
+    with open(snap_path) as f:
+        snap = json.load(f)
+    keys = [k for k in snap if k.startswith("ttft_ms_p95_")
+            or k.startswith("ttft_ms_p50_")]
+    return bool(keys) and all(snap[k] == rep.get(k) for k in keys)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--size", default="tiny")
+    parser.add_argument("--seq-len", type=int, default=64)
+    parser.add_argument("--new-tokens", type=int, default=48)
+    parser.add_argument("--prompt-len-min", type=int, default=4)
+    parser.add_argument("--prompt-len-max", type=int, default=16)
+    parser.add_argument("--num-slots", type=int, default=2)
+    parser.add_argument("--requests", type=int, default=18)
+    parser.add_argument("--overhead-requests", type=int, default=12)
+    parser.add_argument("--arrival-rate", type=float, default=3.0)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--slo", default="ttft_p95=30s,tok_p99=80ms",
+                        help="fleet SLO targets; the tok_p99 leg is "
+                        "the one the fault run's stall must trip")
+    parser.add_argument("--stall-s", type=float, default=6.0,
+                        help="decode stall injected on the SURVIVOR "
+                        "(its cost lands in client-perceived "
+                        "inter-token latency)")
+    parser.add_argument("--stall-step", type=int, default=30)
+    parser.add_argument("--kill-frac", type=float, default=0.35,
+                        help="SIGKILL arm time as a fraction of the "
+                        "arrival span")
+    parser.add_argument("--stale-s", type=float, default=10.0,
+                        help="router staleness bound — must exceed "
+                        "--stall-s so the stalled survivor is never "
+                        "quarantined mid-drill")
+    parser.add_argument("--export-every", type=float, default=0.5)
+    parser.add_argument("--residual-tol", type=float, default=0.10,
+                        help="max mean |residual|/e2e on the control "
+                        "run's latency decomposition")
+    parser.add_argument("--min-tps-ratio", type=float, default=0.95)
+    parser.add_argument("--overhead-runs", type=int, default=2,
+                        help="interleaved off/on run PAIRS")
+    parser.add_argument("--phases", default="failover,overhead",
+                        help="comma list from {failover, overhead}")
+    parser.add_argument("--timeout", type=float, default=600.0)
+    parser.add_argument("--workdir", default="",
+                        help="scratch dir (default: fresh tempdir, "
+                        "removed on success)")
+    parser.add_argument("--no-check", action="store_true")
+    parser.add_argument("--out", default="FLEETOBSBENCH.json")
+    args = parser.parse_args(argv)
+    phases = [p.strip() for p in args.phases.split(",") if p.strip()]
+    bad = set(phases) - {"failover", "overhead"}
+    if bad:
+        parser.error(f"unknown phases {sorted(bad)}")
+    if args.stall_s >= args.stale_s:
+        parser.error("--stall-s must stay under --stale-s (a stalled "
+                     "survivor must not be quarantined)")
+
+    from tensorflow_distributed_tpu.fleet.controller import (
+        ControllerConfig)
+    from tensorflow_distributed_tpu.fleet.router import RouterConfig
+    from tensorflow_distributed_tpu.fleet.run import (
+        FleetObsConfig, load_workload, run_fleet)
+    from tensorflow_distributed_tpu.observe import fleetview
+
+    work = args.workdir or tempfile.mkdtemp(prefix="fleetobsbench-")
+    os.makedirs(work, exist_ok=True)
+    ckpt = os.path.join(work, "ckpt")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYTHONUNBUFFERED"] = "1"
+
+    common = [
+        "--model", "gpt_lm", "--model-size", args.size,
+        "--seq-len", str(args.seq_len), "--seed", str(args.seed),
+        "--compute-dtype", "float32",
+    ]
+
+    def serve_args(ckpt_dir: str) -> list:
+        return [
+            "--mode", "serve", *common,
+            "--checkpoint-dir", ckpt_dir,
+            "--serve.num-slots", str(args.num_slots),
+            # ONE prefill bucket at the cache length: continuation
+            # re-prefills (the failover leg) share the original
+            # admissions' compiled program (fleetbench's rationale).
+            "--serve.buckets", str(args.seq_len),
+            "--observe.anomaly", "true",
+        ]
+
+    # 0. Seed checkpoint (2 steps) + warmup so the persistent compile
+    # cache is hot before anything is timed.
+    _run([sys.executable, "-m", "tensorflow_distributed_tpu.cli",
+          *common, "--dataset", "synthetic", "--batch-size", "8",
+          "--eval-every", "0", "--log-every", "0",
+          "--checkpoint-dir", ckpt, "--checkpoint-every", "2",
+          "--train-steps", "2"],
+         env, args.timeout, "checkpoint prep")
+    _run([sys.executable, "-m", "tensorflow_distributed_tpu.cli",
+          *serve_args(ckpt), "--serve.num-requests", "4",
+          "--serve.max-new-tokens", "8",
+          "--serve.prompt-len-min", str(args.prompt_len_min),
+          "--serve.prompt-len-max", str(args.prompt_len_max)],
+         env, args.timeout, "warmup serve")
+
+    def arm_kill(name: str, deadline_s: float = 60.0):
+        """SIGKILL ``name`` the moment its journal shows a request
+        mid-decode with real budget left (fleetbench's arm: the
+        journal is fresh to within one decode step, so the killed
+        replica reliably leaves in-flight work — and durable trace
+        spans — behind)."""
+        def act(ctl, router):
+            import threading
+            import time as time_mod
+
+            def mid_decode() -> bool:
+                h = ctl.members[name].handle
+                jr = h.read_journal(epoch=h.epoch)
+                return any(
+                    not e.get("done") and not e.get("reject")
+                    and 1 <= len(e.get("tokens", ()))
+                    <= args.new_tokens // 2
+                    for e in jr.values())
+
+            def hunt():
+                t_end = time_mod.monotonic() + deadline_s
+                while time_mod.monotonic() < t_end:
+                    if mid_decode():
+                        break
+                    time_mod.sleep(0.01)
+                ctl.kill(name)
+            threading.Thread(target=hunt, daemon=True).start()
+        return act
+
+    router_cfg = RouterConfig(stale_s=args.stale_s,
+                              dispatch_timeout_s=90.0)
+    controller_cfg = ControllerConfig(backoff_base_s=0.25)
+
+    def fleet_obs(fleet_dir: str) -> FleetObsConfig:
+        return FleetObsConfig(
+            trace=True, slo=args.slo,
+            export_path=os.path.join(fleet_dir, "fleet_snapshot.json"),
+            export_every=args.export_every)
+
+    def observed_run(tag: str, wl_path: str, actions=(),
+                     extra_args=None, obs_on: bool = True):
+        fleet_dir = os.path.join(work, f"{tag}-fleet")
+        summary = run_fleet(
+            fleet_dir=fleet_dir, replicas=2,
+            base_args=serve_args(ckpt),
+            workload=load_workload(wl_path), ckpt_dir=ckpt, env=env,
+            actions=list(actions), extra_args=extra_args,
+            router_cfg=router_cfg, controller_cfg=controller_cfg,
+            poll_s=0.02, timeout_s=args.timeout,
+            jsonl=os.path.join(fleet_dir, "fleet.jsonl"),
+            obs=fleet_obs(fleet_dir) if obs_on else None)
+        summary.pop("tokens", None)
+        return fleet_dir, summary
+
+    lines = []
+    checks = {"metric": "fleetobs_checks"}
+    common_tags = {
+        "model": f"gpt_lm/{args.size}", "num_slots": args.num_slots,
+        "new_tokens": args.new_tokens, "seed": args.seed,
+        "slo": args.slo,
+    }
+
+    # ---- phase 1: failover (control vs fault, observatory on) ------
+    if "failover" in phases:
+        wl = os.path.join(work, "failover.jsonl")
+        _write_workload(wl, args.requests, args.seed, args.new_tokens,
+                        args.prompt_len_min, args.prompt_len_max, 64,
+                        args.arrival_rate)
+        span = args.requests / args.arrival_rate
+
+        ctl_dir, ctl_sum = observed_run("control", wl)
+        # FAULT: r1 SIGKILLED mid-decode (the stitching drill) and the
+        # SURVIVOR r0 decode-stalled (the client-visible latency hit
+        # the fleet SLO must page on — the router clock keeps ticking
+        # while no per-replica monitor would blink).
+        fault_dir, fault_sum = observed_run(
+            "fault", wl,
+            actions=[(span * args.kill_frac, arm_kill("r1"))],
+            extra_args={"r0": [
+                "--resilience.fault-plan",
+                f"decode_stall@{args.stall_step}:{args.stall_s}s"]})
+
+        moved, trace_events = _failover_legs(
+            os.path.join(fault_dir, "fleet_trace.json"))
+        ctl_snap_eq = _snapshot_eq_report(
+            ctl_dir, os.path.join(ctl_dir, "fleet_snapshot.json"))
+        fault_snap_eq = _snapshot_eq_report(
+            fault_dir, os.path.join(fault_dir, "fleet_snapshot.json"))
+        view = fleetview.render(
+            fault_dir,
+            snapshot=os.path.join(fault_dir, "fleet_snapshot.json"))
+        view_ok = ("fleet observatory" in view
+                   and "stitched trace" in view
+                   and "balanced" in view)
+
+        decomp = [r for r in _load_jsonl(
+            os.path.join(ctl_dir, "fleet.jsonl"))
+            if r.get("event") == "fleet_decomp"]
+        comps = ("e2e_ms", "router_queue_ms", "inbox_lag_ms",
+                 "replica_queue_ms", "prefill_ms", "decode_ms",
+                 "absorb_ms", "residual_ms")
+        mean = {k: round(sum(float(d.get(k, 0)) for d in decomp)
+                         / max(len(decomp), 1), 3) for k in comps}
+
+        lines += [
+            {"metric": "fleetobs_failover_control",
+             "alerts": ctl_sum.get("fleet_slo_alerts"),
+             "done": ctl_sum.get("requests_done"),
+             "lost": ctl_sum.get("requests_lost"),
+             "shed": ctl_sum.get("requests_shed"),
+             "deaths": ctl_sum.get("deaths"),
+             "balanced": ctl_sum.get("stitch_balanced"),
+             "skipped": ctl_sum.get("stitch_skipped"),
+             "decomp_requests": ctl_sum.get("decomp_requests"),
+             "residual_frac_mean":
+                 ctl_sum.get("decomp_residual_frac_mean"),
+             "snapshot_eq_report": ctl_snap_eq,
+             "tokens_per_sec": ctl_sum.get("tokens_per_sec"),
+             "unit": ""},
+            {"metric": "fleetobs_failover_fault",
+             "alerts": fault_sum.get("fleet_slo_alerts"),
+             "done": fault_sum.get("requests_done"),
+             "lost": fault_sum.get("requests_lost"),
+             "deaths": fault_sum.get("deaths"),
+             "redispatches": fault_sum.get("redispatches"),
+             "balanced": fault_sum.get("stitch_balanced"),
+             "skipped": fault_sum.get("stitch_skipped"),
+             "closed_at_death":
+                 fault_sum.get("stitch_closed_at_death"),
+             "stitch_sources": fault_sum.get("stitch_sources"),
+             "trace_events": trace_events,
+             "moved_rids": moved,
+             "snapshot_eq_report": fault_snap_eq,
+             "budget_remaining_min":
+                 fault_sum.get("fleet_slo_budget_remaining_min"),
+             "unit": ""},
+            {"metric": "fleetobs_decomp", **mean,
+             "requests": len(decomp), "unit": "ms (control means)"},
+        ]
+        residual = ctl_sum.get("decomp_residual_frac_mean")
+        checks.update(
+            control_quiet=bool(
+                ctl_sum.get("fleet_slo_alerts") == 0
+                and ctl_sum.get("deaths") == 0
+                and ctl_sum.get("requests_shed") == 0),
+            fault_alerted=bool(
+                (fault_sum.get("fleet_slo_alerts") or 0) >= 1),
+            lost=(ctl_sum.get("requests_lost", 1)
+                  + fault_sum.get("requests_lost", 1)),
+            traces_balanced=bool(ctl_sum.get("stitch_balanced")
+                                 and fault_sum.get("stitch_balanced")),
+            failover_legs_ok=bool(
+                len(moved) >= 1
+                and (fault_sum.get("stitch_closed_at_death") or 0) >= 1
+                and (fault_sum.get("deaths") or 0) >= 1
+                and (fault_sum.get("redispatches") or 0) >= 1),
+            decomp_ok=bool(
+                ctl_sum.get("decomp_requests") == args.requests
+                and residual is not None
+                and residual <= args.residual_tol),
+            residual_frac_mean=residual,
+            residual_tol=args.residual_tol,
+            snapshot_agrees_with_report=bool(ctl_snap_eq
+                                             and fault_snap_eq),
+            fleetview_ok=view_ok)
+
+    # ---- phase 2: observatory overhead (min-of-interleaved) --------
+    if "overhead" in phases:
+        wl = os.path.join(work, "overhead.jsonl")
+        _write_workload(wl, args.overhead_requests, args.seed + 1,
+                        args.new_tokens, args.prompt_len_min,
+                        args.prompt_len_max, 64, args.arrival_rate)
+        tps = {"off": [], "on": []}
+        for i in range(args.overhead_runs):
+            for mode in ("off", "on"):
+                _, s = observed_run(f"ov-{mode}{i}", wl,
+                                    obs_on=(mode == "on"))
+                tps[mode].append(float(s.get("tokens_per_sec", 0.0)))
+        ratio = (min(tps["on"]) / max(min(tps["off"]), 1e-9))
+        lines.append({
+            "metric": "fleetobs_overhead",
+            "value": round(min(tps["on"]), 2),
+            "tracing_off": round(min(tps["off"]), 2),
+            "ratio": round(ratio, 4),
+            "runs_on": [round(v, 2) for v in tps["on"]],
+            "runs_off": [round(v, 2) for v in tps["off"]],
+            "unit": "tokens/sec"})
+        checks.update(
+            overhead_ok=bool(ratio >= args.min_tps_ratio),
+            overhead_ratio=round(ratio, 4),
+            min_tps_ratio=args.min_tps_ratio)
+
+    lines.append(checks)
+    lines = [dict(ln, **common_tags) for ln in lines]
+    print("\n".join(json.dumps(ln) for ln in lines))
+    if args.out:
+        from tensorflow_distributed_tpu.observe.registry import (
+            write_jsonl)
+        write_jsonl(args.out, lines)
+
+    ok = True
+    if "failover" in phases:
+        ok &= (checks["control_quiet"] and checks["fault_alerted"]
+               and checks["lost"] == 0
+               and checks["traces_balanced"]
+               and checks["failover_legs_ok"]
+               and checks["decomp_ok"]
+               and checks["snapshot_agrees_with_report"]
+               and checks["fleetview_ok"])
+    if "overhead" in phases:
+        ok &= checks["overhead_ok"]
+    if not args.no_check and not ok:
+        print(f"fleetobsbench: checks FAILED: {checks}",
+              file=sys.stderr)
+        return 1
+    if not args.workdir:
+        shutil.rmtree(work, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -79,6 +79,22 @@ Examples:
            --checkpoint-dir /tmp/ckpt --serve.num-slots 4 \
            --observe.anomaly true
 
+    # fleet observatory (observe/fleet_trace.py + fleetview; README
+    # "Fleet observatory"): one stitched Perfetto trace across router
+    # + every replica (failover legs land on one timeline), fleet-level
+    # SLO burn on client-perceived latency across retries, per-request
+    # latency decomposition, and an atomically-rewritten control-plane
+    # snapshot the fleetview CLI renders as a one-screen status page
+    python -m tensorflow_distributed_tpu.fleet.run \
+        --replicas 2 --fleet-dir /tmp/fleet \
+        --requests workload.jsonl \
+        --fleet.trace true \
+        --fleet.slo "ttft_p95=200ms,tok_p99=80ms" \
+        --fleet.export-path /tmp/fleet/fleet_snapshot.json \
+        --fleet.export-every 1 \
+        -- --mode serve --model gpt_lm --serve.num-slots 4
+    python -m tensorflow_distributed_tpu.observe.fleetview /tmp/fleet
+
     # graftcheck runtime checks (analysis/runtime.py; README "Static
     # analysis"): transfer guard + sharding-contract assertion
     python -m tensorflow_distributed_tpu.cli --train-steps 100 --check true

@@ -84,6 +84,14 @@ class ObserveConfig:
     # Pure Python: works even when jax.profiler / the TPU tunnel is
     # down. Open at https://ui.perfetto.dev or chrome://tracing.
     trace: str = ""
+    # Durable trace flushing (mode=serve): rewrite the trace file at
+    # every request-lifecycle edge (admission/completion/eviction)
+    # instead of only on the 5s cadence, so a SIGKILLed fleet replica
+    # leaves its in-flight requests' spans on disk for the stitcher
+    # (observe/fleet_trace.py). The controller sets this on replicas;
+    # don't arm it for a high-rate standalone serve — each flush
+    # rewrites the whole buffer.
+    trace_durable: bool = False
     # Per-device peak TFLOP/s for MFU. 0 = auto-detect for known TPU
     # generations (observe.mfu.PEAK_BF16_FLOPS); unknown devices omit
     # MFU rather than invent a number.
@@ -200,6 +208,10 @@ class ObserveConfig:
             raise ValueError(
                 f"observe.peak_tflops must be >= 0, "
                 f"got {self.peak_tflops}")
+        if self.trace_durable and not self.trace:
+            raise ValueError(
+                "observe.trace_durable has no effect without "
+                "observe.trace; set a trace path (--observe.trace)")
         if self.slo:
             from tensorflow_distributed_tpu.observe.slo import (
                 parse_slo)

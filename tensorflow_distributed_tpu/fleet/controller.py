@@ -81,6 +81,11 @@ class ControllerConfig:
     swap_timeout_s: float = 120.0  # per-replica roll acknowledgement
     drain_timeout_s: float = 60.0
     ready_timeout_s: float = 300.0
+    # Arm each replica's per-request ServeTracer on its per-epoch
+    # trace.json, with durable (per-request-edge) flushing so a
+    # SIGKILLed replica's last spans survive for the fleet stitcher
+    # (observe/fleet_trace.py). Set by --fleet.trace.
+    replica_trace: bool = False
 
     def validate(self) -> None:
         if self.max_restarts < 0:
@@ -177,6 +182,11 @@ class FleetController:
             "--observe.export-every", str(self.cfg.export_every_s),
             "--observe.metrics-jsonl", h.metrics,
         ]
+        if self.cfg.replica_trace:
+            args += [
+                "--observe.trace", h.trace,
+                "--observe.trace-durable", "true",
+            ]
         return [sys.executable, "-m",
                 "tensorflow_distributed_tpu.cli", *args]
 

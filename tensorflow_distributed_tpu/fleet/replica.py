@@ -77,6 +77,13 @@ class InboxFeed:
         self.clock = clock
         self._offset = 0
         self._last_poll = -1e9
+        # Inbox-poll lag: the router stamps each request line with its
+        # wall-clock enqueue time (enq_ts); intake-minus-stamp is the
+        # dispatch-file-write -> feed-intake latency — the replica-
+        # side anchor of the fleet latency decomposition, and an early
+        # warning for a wedged feed. Bounded recent-window deque.
+        import collections
+        self._lag_ms: collections.deque = collections.deque(maxlen=256)
 
     def _to_request(self, obj: Dict[str, Any]):
         import numpy as np
@@ -134,8 +141,24 @@ class InboxFeed:
                         f"{obj['cmd']!r}; have {COMMANDS}")
                 items.append(obj)
             else:
+                if "enq_ts" in obj:
+                    lag_ms = (time.time() - float(obj["enq_ts"])) * 1e3
+                    self._lag_ms.append(max(0.0, lag_ms))
                 items.append(self._to_request(obj))
         return items
+
+    def lag_stats(self) -> Dict[str, float]:
+        """Recent inbox-poll lag (ms): mean + nearest-rank p95 over
+        the last requests taken in. Empty dict before any stamped
+        intake (pre-PR routers send no enq_ts)."""
+        if not self._lag_ms:
+            return {}
+        from tensorflow_distributed_tpu.observe.slo import percentile
+        vals = sorted(self._lag_ms)
+        return {
+            "inbox_poll_lag_ms": round(sum(vals) / len(vals), 3),
+            "inbox_poll_lag_ms_p95": round(percentile(vals, 95), 3),
+        }
 
 
 class ReplicaHandle:
@@ -177,6 +200,32 @@ class ReplicaHandle:
     @property
     def metrics(self) -> str:
         return os.path.join(self.epoch_dir(), "metrics.jsonl")
+
+    @property
+    def trace(self) -> str:
+        """The replica's per-epoch ServeTracer file (written only
+        when the controller arms --observe.trace on its replicas) —
+        one stitch source per epoch this replica lived through."""
+        return os.path.join(self.epoch_dir(), "trace.json")
+
+    def trace_paths(self) -> List[str]:
+        """Every epoch's trace file that exists on disk, oldest
+        first — a restarted replica contributes one source per life."""
+        out = []
+        for e in range(self.epoch + 1):
+            p = os.path.join(self.epoch_dir(e), "trace.json")
+            if os.path.exists(p):
+                out.append(p)
+        return out
+
+    def snapshot_mtime(self) -> Optional[float]:
+        """The snapshot file's mtime (the ROUTER-frame half of a
+        clock-offset sample; the payload's wall_ts is the replica
+        half). None when no snapshot exists yet."""
+        try:
+            return os.stat(self.snapshot).st_mtime
+        except OSError:
+            return None
 
     def begin_epoch(self, epoch: int) -> None:
         """Advance to a fresh epoch directory (controller restart

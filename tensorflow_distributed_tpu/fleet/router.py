@@ -167,7 +167,8 @@ class Router:
 
     def __init__(self, replicas: Sequence[Any],
                  cfg: Optional[RouterConfig] = None,
-                 emit: Optional[Callable[..., Any]] = None):
+                 emit: Optional[Callable[..., Any]] = None,
+                 tracer: Any = None, slo_monitor: Any = None):
         self.cfg = cfg or RouterConfig()
         self.cfg.validate()
         self.reps: Dict[str, _Rep] = {
@@ -179,6 +180,18 @@ class Router:
         self._waiting: List[int] = []    # due, undispatched
         self._t0: Optional[float] = None
         self._emit_fn = emit
+        # Fleet observability (observe/fleet_trace.py): the router's
+        # own span recorder, and a fleet-level SLOMonitor scoring
+        # CLIENT-PERCEIVED latency (admission -> first token across
+        # retries/failovers) on the router's step clock. Both optional
+        # and None-safe.
+        self.tracer = tracer
+        self.slo_monitor = slo_monitor
+        self._steps = 0
+        # Per-replica clock-offset samples, (wall_ts, mtime) pairs
+        # from the snapshot liveness triplet — the stitcher's skew
+        # estimate (observe.fleet_trace.estimate_offset). Bounded.
+        self.clock_samples: Dict[str, List[Tuple[float, float]]] = {}
         self.events: List[Tuple[float, str, str]] = []  # (t, kind, rep)
         # Session stickiness: a conversation's turns land on the SAME
         # replica while it stays healthy, so the paged engine's
@@ -241,6 +254,9 @@ class Router:
         self.events.append((now, "death", name))
         self._emit("fleet_replica", replica=name, state="dead",
                    reason=rep.reason, t_s=round(self._now_s(now), 4))
+        if self.tracer is not None:
+            self.tracer.replica_event("replica_death", name,
+                                      inflight=len(rep.inflight))
         self._evacuate(rep, now, cancel=False)
 
     def mark_restarted(self, name: str, now: float) -> None:
@@ -256,6 +272,9 @@ class Router:
         self._emit("fleet_replica", replica=name, state="restarted",
                    epoch=rep.handle.epoch,
                    t_s=round(self._now_s(now), 4))
+        if self.tracer is not None:
+            self.tracer.replica_event("replica_restart", name,
+                                      epoch=rep.handle.epoch)
 
     def _quarantine(self, rep: _Rep, now: float, reason: str) -> None:
         rep.health = "quarantined"
@@ -269,6 +288,10 @@ class Router:
                    state="quarantined", reason=reason,
                    inflight=len(rep.inflight),
                    t_s=round(self._now_s(now), 4))
+        if self.tracer is not None:
+            self.tracer.replica_event("quarantine", rep.handle.name,
+                                      reason=reason,
+                                      inflight=len(rep.inflight))
         if self.cfg.redispatch_on_quarantine:
             self._evacuate(rep, now, cancel=True)
 
@@ -278,6 +301,8 @@ class Router:
         self.rejoins += 1
         self._emit("fleet_replica", replica=rep.handle.name,
                    state="rejoined", t_s=round(self._now_s(now), 4))
+        if self.tracer is not None:
+            self.tracer.replica_event("rejoin", rep.handle.name)
 
     def _bad_anomaly(self, snap: Dict[str, Any]) -> str:
         active = (snap.get("anomaly") or {}).get("active") or []
@@ -298,6 +323,21 @@ class Router:
                 rep.seq_t = now
                 rep.snap = snap
                 rep.sent_since_seq = 0
+                # Clock-offset sample: the replica stamped wall_ts
+                # (its clock) into the payload, the filesystem stamped
+                # mtime (the router's frame) onto the file — one
+                # (wall_ts, mtime) pair per seq advance feeds the
+                # trace stitcher's skew estimate. hasattr-guarded:
+                # fake replicas in tests need not implement it.
+                if (isinstance(snap.get("wall_ts"), (int, float))
+                        and hasattr(rep.handle, "snapshot_mtime")):
+                    mtime = rep.handle.snapshot_mtime()
+                    if mtime is not None:
+                        samples = self.clock_samples.setdefault(
+                            rep.handle.name, [])
+                        samples.append(
+                            (float(snap["wall_ts"]), float(mtime)))
+                        del samples[:-64]
             fresh = (rep.last_seq is not None
                      and now - rep.seq_t <= self.cfg.stale_s)
             if rep.health == "starting":
@@ -358,6 +398,9 @@ class Router:
                 tr.progress_t = now
                 if tr.first_tok_t is None:
                     tr.first_tok_t = now
+                    if self.tracer is not None:
+                        self.tracer.first_token(rid, tr.gen_rid,
+                                                rep.handle.name)
             if ent.get("done") or tr.finished():
                 rep.inflight.discard(rid)
                 rep.done_count += 1
@@ -368,6 +411,31 @@ class Router:
         tr.done_t = now
         if tr.first_tok_t is None:   # completed within one poll
             tr.first_tok_t = now
+        # Client-perceived latency, router clock: admission (arrival)
+        # -> first token / completion, every retry and failover
+        # included — the number no per-replica view can compute. One
+        # fleet_request record per completion is the durable form;
+        # summary(), the fleet snapshot, and observe/report.py all
+        # derive per-class percentiles from this SAME population with
+        # the shared nearest-rank percentile (snapshot == report).
+        arr = (self._t0 or 0.0) + tr.arrival_s
+        ttft_ms = 1e3 * (tr.first_tok_t - arr)
+        e2e_ms = 1e3 * (now - arr)
+        n_tok = len(tr.tokens)
+        tok_ms = (1e3 * (now - tr.first_tok_t) / max(1, n_tok - 1))
+        self._emit("fleet_request", rid=tr.rid, slo=tr.slo,
+                   tenant=tr.tenant, ttft_ms=round(ttft_ms, 3),
+                   e2e_ms=round(e2e_ms, 3), tok_ms=round(tok_ms, 4),
+                   tokens=n_tok, retries=tr.retries,
+                   redispatched=tr.redispatched,
+                   t_s=round(self._now_s(now), 4))
+        if self.tracer is not None:
+            self.tracer.request_done(tr.rid, finish="done",
+                                     tokens=n_tok, ttft_ms=ttft_ms,
+                                     retries=tr.retries)
+        if self.slo_monitor is not None:
+            self.slo_monitor.observe(tr.slo, ttft_ms, tok_ms,
+                                     self._steps)
 
     def _shed(self, tr: _Track, now: float, reason: str) -> None:
         tr.state = "shed"
@@ -378,6 +446,8 @@ class Router:
         self._emit("fleet_shed", rid=tr.rid, slo=tr.slo,
                    reason=reason, retries=tr.retries,
                    t_s=round(self._now_s(now), 4))
+        if self.tracer is not None:
+            self.tracer.shed(tr.rid, reason)
 
     # -- evacuation / retry ------------------------------------------------
 
@@ -393,6 +463,10 @@ class Router:
         self._absorb(rep, now, journal=jr)   # completions first
         for rid in sorted(rep.inflight):
             tr = self.tracks[rid]
+            if self.tracer is not None:
+                self.tracer.leg_failed(rid, tr.gen_rid,
+                                       rep.handle.name,
+                                       rep.reason or "evacuated")
             tr.base = tr.base + tr.cur
             tr.cur = []
             tr.owner = None
@@ -430,6 +504,9 @@ class Router:
                 if now - max(tr.dispatch_t, tr.progress_t) \
                         <= self.cfg.dispatch_timeout_s:
                     continue
+                if self.tracer is not None:
+                    self.tracer.leg_failed(rid, tr.gen_rid,
+                                           rep.handle.name, "timeout")
                 tr.base = tr.base + tr.cur
                 tr.cur = []
                 tr.owner = None
@@ -498,9 +575,14 @@ class Router:
         continuation contract, fleet-side). The wire rid is the
         DISPATCH GENERATION id (see _Track.gen_rid) — call
         ``next_gen()`` before building the payload."""
+        import time as _time
         out = {"rid": tr.gen_rid, "prompt": tr.prompt + tr.base,
                "max_new": tr.max_new - len(tr.base),
-               "eos": tr.eos, "slo": tr.slo, "tenant": tr.tenant}
+               "eos": tr.eos, "slo": tr.slo, "tenant": tr.tenant,
+               # Wall-clock enqueue stamp: the replica's InboxFeed
+               # measures intake-minus-stamp as inbox_poll_lag_ms —
+               # the latency decomposition's replica-side anchor.
+               "enq_ts": round(_time.time(), 6)}
         if tr.session:
             out["session"] = tr.session
         return out
@@ -535,6 +617,10 @@ class Router:
             tr.owner = (rep.handle.name, rep.handle.epoch)
             tr.state = "dispatched"
             tr.dispatch_t = now
+            if self.tracer is not None:
+                self.tracer.dispatch(rid, tr.gen_rid,
+                                     rep.handle.name,
+                                     retry=tr.retries)
             self._emit("fleet_dispatch", rid=rid,
                        replica=rep.handle.name,
                        kind="redispatch" if tr.retries else "fresh",
@@ -579,10 +665,22 @@ class Router:
                 self.tracks[self._arrivals[0]].arrival_s
                 <= self._now_s(now)):
             rid = self._arrivals.pop(0)
-            self.tracks[rid].state = "waiting"
+            tr = self.tracks[rid]
+            tr.state = "waiting"
             self._waiting.append(rid)
+            if self.tracer is not None:
+                self.tracer.request_queued(rid, slo=tr.slo,
+                                           prompt_len=len(tr.prompt))
         self._dispatch(now)
         self._shed_pass(now)
+        self._steps += 1
+        if self.slo_monitor is not None:
+            self.slo_monitor.on_step(self._steps)
+        if self.tracer is not None:
+            self.tracer.counters(
+                waiting=float(len(self._waiting)),
+                inflight=float(sum(len(r.inflight)
+                                   for r in self.reps.values())))
 
     # -- summary -----------------------------------------------------------
 
@@ -635,6 +733,25 @@ class Router:
             for q in (50, 95, 99):
                 out[f"ttft_ms_p{q}"] = round(
                     self._percentile(ttfts, q), 3)
+        # Per-class END-TO-END TTFT (router clock, admission -> first
+        # token, retries and failovers included — what the client
+        # sees, which per-replica p95s structurally cannot). Same
+        # population + same nearest-rank percentile as the fleet
+        # snapshot and observe/report.py's fleet_request fold, so all
+        # three agree exactly.
+        by_cls: Dict[str, List[float]] = {}
+        for t in done:
+            if t.first_tok_t is not None:
+                by_cls.setdefault(t.slo, []).append(
+                    1e3 * (t.first_tok_t - (self._t0 + t.arrival_s)))
+        for cls, vals in sorted(by_cls.items()):
+            out[f"ttft_ms_p50_{cls}"] = round(
+                self._percentile(vals, 50), 3)
+            out[f"ttft_ms_p95_{cls}"] = round(
+                self._percentile(vals, 95), 3)
+        if self.slo_monitor is not None:
+            out.update({"fleet_" + k: v
+                        for k, v in self.slo_monitor.summary().items()})
         # Recovery population: a replica death/quarantine/timeout fell
         # inside the request's arrival -> first-token window, or the
         # request itself was re-dispatched (firebench's
@@ -658,4 +775,70 @@ class Router:
             out["wall_s"] = round(t_last - self._t0, 4)
             out["tokens_per_sec"] = round(
                 out["total_new_tokens"] / max(out["wall_s"], 1e-9), 2)
+        return out
+
+    def fleet_snapshot(self, now: float) -> Dict[str, Any]:
+        """The control-plane feed payload (``--fleet.export-path``):
+        aggregate occupancy/queue, per-class end-to-end TTFT p50/p95
+        (same population + percentile as :meth:`summary` — the PR-11
+        snapshot==report contract at fleet level), per-replica health
+        with snapshot staleness, the quarantine set, and the fleet SLO
+        error budget — exactly what the ROADMAP item-2 elastic scaler
+        and item-5 autopilot will poll."""
+        slots = slots_live = queue = 0
+        per_rep: Dict[str, Any] = {}
+        quarantined: List[str] = []
+        for name, rep in sorted(self.reps.items()):
+            snap = rep.snap or {}
+            slots += int(snap.get("num_slots", 0))
+            slots_live += int(snap.get("requests_live", 0))
+            queue += int(snap.get("queue_depth", 0))
+            if rep.health == "quarantined":
+                quarantined.append(name)
+            per_rep[name] = {
+                "health": rep.health,
+                "epoch": rep.handle.epoch,
+                "load": self._load(rep),
+                "inflight": len(rep.inflight),
+                "done": rep.done_count,
+                "reason": rep.reason,
+                "stale_s": (round(now - rep.seq_t, 3)
+                            if rep.last_seq is not None else None),
+                "ckpt_step": snap.get("ckpt_step"),
+            }
+        done = [t for t in self.tracks.values() if t.state == "done"]
+        by_cls: Dict[str, List[float]] = {}
+        for t in done:
+            if t.first_tok_t is not None:
+                by_cls.setdefault(t.slo, []).append(
+                    1e3 * (t.first_tok_t
+                           - ((self._t0 or 0.0) + t.arrival_s)))
+        out: Dict[str, Any] = {
+            "t_s": round(self._now_s(now), 4),
+            "step": self._steps,
+            "requests": len(self.tracks),
+            "requests_done": len(done),
+            "requests_shed": sum(
+                1 for t in self.tracks.values() if t.state == "shed"),
+            "waiting": len(self._waiting),
+            "inflight": sum(len(r.inflight)
+                            for r in self.reps.values()),
+            "slots": slots,
+            "slots_live": slots_live,
+            "queue_depth": queue,
+            "quarantined": quarantined,
+            "deaths": self.deaths,
+            "replicas": per_rep,
+        }
+        for cls, vals in sorted(by_cls.items()):
+            out[f"ttft_ms_p50_{cls}"] = round(
+                self._percentile(vals, 50), 3)
+            out[f"ttft_ms_p95_{cls}"] = round(
+                self._percentile(vals, 95), 3)
+        if self.slo_monitor is not None:
+            out["slo"] = self.slo_monitor.snapshot()
+            out["slo_budget_remaining_min"] = min(
+                (e["budget_remaining"]
+                 for e in out["slo"].values()), default=1.0)
+            out["slo_alerting"] = self.slo_monitor.any_alerting()
         return out

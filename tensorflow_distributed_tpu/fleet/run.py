@@ -26,11 +26,22 @@ which is how fleetbench schedules trainer legs mid-run.
 The front-end emits ``fleet_*`` records (and one ``fleet_summary``)
 into ``<fleet-dir>/fleet.jsonl``; ``observe.report`` folds them into
 a Fleet section.
+
+The fleet observatory rides four more flags: ``--fleet.trace`` (router
+spans + durable per-replica traces, stitched into
+``<fleet-dir>/fleet_trace.json`` at run end — one balanced Perfetto
+timeline across every process, failovers included), ``--fleet.slo``
+(fleet-level burn-rate targets on CLIENT-perceived latency, emitting
+``fleet_slo_alert``/``fleet_slo_ok``), and ``--fleet.export-path`` /
+``--fleet.export-every`` (the atomically-rewritten control-plane
+snapshot). Render everything with
+``python -m tensorflow_distributed_tpu.observe.fleetview <fleet-dir>``.
 """
 
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import os
 import sys
@@ -41,6 +52,51 @@ from tensorflow_distributed_tpu.fleet.controller import (
     ControllerConfig, FleetController)
 from tensorflow_distributed_tpu.fleet.replica import ReplicaHandle
 from tensorflow_distributed_tpu.fleet.router import Router, RouterConfig
+
+
+@dataclasses.dataclass
+class FleetObsConfig:
+    """Fleet-observatory knobs (the ``--fleet.*`` CLI flags).
+
+    ``trace`` arms the router's own FleetTracer AND per-replica
+    durable ServeTracers (controller-appended), and stitches
+    everything into ``<fleet-dir>/fleet_trace.json`` at run end.
+    ``slo`` declares FLEET-level targets (observe/slo.py grammar)
+    scored on client-perceived latency — admission to first token
+    across retries and failovers — emitting ``fleet_slo_alert`` /
+    ``fleet_slo_ok`` records. ``export_path`` is the atomically-
+    rewritten control-plane snapshot (see Router.fleet_snapshot) on
+    the ``export_every`` cadence (0 = one final snapshot only)."""
+
+    trace: bool = False
+    slo: str = ""
+    slo_windows: str = "60,600"
+    slo_burn: float = 1.0
+    export_path: str = ""
+    export_every: float = 0.0
+
+    def validate(self) -> None:
+        from tensorflow_distributed_tpu.observe.slo import (
+            parse_slo, parse_windows)
+        if self.slo:
+            parse_slo(self.slo)
+        parse_windows(self.slo_windows)
+        if self.slo_burn <= 0:
+            raise ValueError(
+                f"fleet.slo_burn must be > 0, got {self.slo_burn}")
+        if not self.slo and (self.slo_windows != "60,600"
+                             or self.slo_burn != 1.0):
+            raise ValueError(
+                "fleet.slo_windows/slo_burn have no effect without "
+                "fleet.slo; declare targets (--fleet.slo)")
+        if self.export_every < 0:
+            raise ValueError(
+                f"fleet.export_every must be >= 0, "
+                f"got {self.export_every}")
+        if self.export_every and not self.export_path:
+            raise ValueError(
+                "fleet.export_every has no effect without "
+                "fleet.export_path; set a snapshot file")
 
 
 def load_workload(path: str) -> List[Dict[str, Any]]:
@@ -79,7 +135,8 @@ def run_fleet(*, fleet_dir: str, replicas: int,
               env: Optional[Dict[str, str]] = None,
               poll_s: float = 0.05, timeout_s: float = 900.0,
               linger: Optional[Callable[..., bool]] = None,
-              jsonl: str = "") -> Dict[str, Any]:
+              jsonl: str = "",
+              obs: Optional[FleetObsConfig] = None) -> Dict[str, Any]:
     """Serve ``workload`` on a ``replicas``-wide fleet; returns the
     merged router+controller summary. ``actions`` fire once each at
     their offset from serving start (clock = time.monotonic);
@@ -97,12 +154,47 @@ def run_fleet(*, fleet_dir: str, replicas: int,
         emit = registry.emit
     handles = [ReplicaHandle(f"r{i}", os.path.join(fleet_dir, f"r{i}"))
                for i in range(replicas)]
-    router = Router(handles, router_cfg, emit=emit)
+    obs = obs or FleetObsConfig()
+    obs.validate()
+    ftracer = None
+    slo_monitor = None
+    if obs.trace:
+        from tensorflow_distributed_tpu.observe.fleet_trace import (
+            FleetTracer)
+        ftracer = FleetTracer(
+            os.path.join(fleet_dir, "router_trace.json"))
+        # Replicas get durable per-epoch ServeTracers so every leg of
+        # a failover leaves spans for the stitcher (copy: the caller's
+        # config object stays untouched).
+        controller_cfg = dataclasses.replace(
+            controller_cfg or ControllerConfig(), replica_trace=True)
+    if obs.slo:
+        from tensorflow_distributed_tpu.observe.slo import (
+            SLOMonitor, parse_slo, parse_windows)
+        fast, slow = parse_windows(obs.slo_windows)
+        slo_monitor = SLOMonitor(
+            parse_slo(obs.slo), fast_window=fast, slow_window=slow,
+            burn_threshold=obs.slo_burn, emit=emit,
+            tracer=ftracer.tracer if ftracer is not None else None,
+            event_prefix="fleet_")
+    router = Router(handles, router_cfg, emit=emit, tracer=ftracer,
+                    slo_monitor=slo_monitor)
     ctl = FleetController(handles, base_args, ckpt_dir=ckpt_dir,
                           cfg=controller_cfg, extra_args=extra_args,
                           emit=emit, env=env,
                           on_death=router.mark_dead,
                           on_restart=router.mark_restarted)
+
+    def export_snapshot(now: float) -> None:
+        """Atomic (tmp+rename) control-plane snapshot — a poller
+        always reads a complete payload, never a torn write."""
+        snap = router.fleet_snapshot(now)
+        tmp = obs.export_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(snap, f)
+        os.replace(tmp, obs.export_path)
+        if emit is not None:
+            emit("fleet_snapshot", **snap)
     clock = time.monotonic
     summary: Dict[str, Any] = {}
     try:
@@ -118,6 +210,7 @@ def run_fleet(*, fleet_dir: str, replicas: int,
         pending_actions = sorted(actions, key=lambda ta: ta[0])
         fired = 0
         timed_out = False
+        last_export = t0
         while True:
             now = clock()
             while (fired < len(pending_actions)
@@ -126,6 +219,10 @@ def run_fleet(*, fleet_dir: str, replicas: int,
                 fired += 1
             ctl.poll(now)
             router.step(now)
+            if (obs.export_path and obs.export_every
+                    and now - last_export >= obs.export_every):
+                last_export = now
+                export_snapshot(now)
             if not router.active() and not ctl.swap_in_progress \
                     and fired >= len(pending_actions) \
                     and (linger is None or not linger(ctl, router)):
@@ -136,11 +233,21 @@ def run_fleet(*, fleet_dir: str, replicas: int,
             time.sleep(poll_s)
         ctl.request_stop(clock())
         drained = ctl.wait_stopped()
-        summary = {**router.summary(), **ctl.summary(),
+        obs_extra: Dict[str, Any] = {}
+        if ftracer is not None:
+            ftracer.close()
+            obs_extra = _stitch_fleet(fleet_dir, router, handles, emit)
+        summary = {**router.summary(), **ctl.summary(), **obs_extra,
                    "drained_clean": bool(drained),
                    "timed_out": timed_out}
         if emit is not None:
             emit("fleet_summary", **summary)
+        if obs.export_path:
+            # The FINAL snapshot — forced, after the fleet stopped, so
+            # its per-class e2e p95 is computed over the same (now
+            # frozen) done population summary() and observe.report use
+            # (the PR-11 snapshot==report contract, fleet level).
+            export_snapshot(clock())
         # Returned (not emitted — records stay lean): the assembled
         # per-request streams for token-identity comparisons.
         summary["tokens"] = {
@@ -157,6 +264,59 @@ def run_fleet(*, fleet_dir: str, replicas: int,
                     pass
         if registry is not None:
             registry.close()
+
+
+def _stitch_fleet(fleet_dir: str, router: Router,
+                  handles: Sequence[ReplicaHandle],
+                  emit: Optional[Callable[..., Any]]
+                  ) -> Dict[str, Any]:
+    """End-of-run merge: router trace + every replica epoch's trace
+    -> ``<fleet-dir>/fleet_trace.json``, then the per-request latency
+    decomposition from the merged timeline (one ``fleet_decomp``
+    record each). Returns the summary fields; never raises — a failed
+    merge reports itself instead of sinking the run's summary."""
+    from tensorflow_distributed_tpu.observe.fleet_trace import (
+        decompose, estimate_offset, stitch)
+    from tensorflow_distributed_tpu.observe.trace import load_trace
+    out_path = os.path.join(fleet_dir, "fleet_trace.json")
+    sources: List[Tuple[str, str, float]] = []
+    for h in handles:
+        offset = estimate_offset(
+            router.clock_samples.get(h.name, []))
+        for path in h.trace_paths():
+            epoch = os.path.basename(os.path.dirname(path))
+            sources.append((f"{h.name}/{epoch}", path, offset))
+    try:
+        stats = stitch(os.path.join(fleet_dir, "router_trace.json"),
+                       sources, out_path)
+    except (OSError, ValueError) as e:
+        return {"stitch_error": str(e)}
+    fields: Dict[str, Any] = {
+        "stitch_sources": stats["sources"],
+        "stitch_skipped": stats["skipped"],
+        "stitch_balanced": stats["balanced"],
+        "stitch_closed_at_death": stats["closed_at_death"],
+        "fleet_trace": out_path,
+    }
+    if emit is not None:
+        emit("fleet_stitch", **{k: v for k, v in fields.items()
+                                if k != "fleet_trace"},
+             events=stats["events"])
+    try:
+        decomp = decompose(load_trace(out_path))
+    except (OSError, ValueError, KeyError):
+        decomp = []
+    fracs = []
+    for d in decomp:
+        if emit is not None:
+            emit("fleet_decomp", **d)
+        if d["e2e_ms"] > 0:
+            fracs.append(abs(d["residual_ms"]) / d["e2e_ms"])
+    fields["decomp_requests"] = len(decomp)
+    if fracs:
+        fields["decomp_residual_frac_mean"] = round(
+            sum(fracs) / len(fracs), 4)
+    return fields
 
 
 def _parse_at(spec: str) -> Tuple[str, float, float]:
@@ -197,8 +357,41 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         help="freeze NAME's snapshot exports for S "
                         "seconds starting at T")
     parser.add_argument("--timeout", type=float, default=900.0)
+    # Fleet observatory (observe/fleet_trace.py + Router.fleet_snapshot)
+    parser.add_argument("--fleet.trace", dest="fleet_trace",
+                        type=lambda s: s.lower() in ("1", "true", "yes"),
+                        default=False,
+                        help="router spans + durable replica traces, "
+                        "stitched into <fleet-dir>/fleet_trace.json")
+    parser.add_argument("--fleet.slo", dest="fleet_slo", default="",
+                        help="fleet-level SLO targets on client-"
+                        "perceived latency (observe/slo.py grammar)")
+    parser.add_argument("--fleet.slo-windows", dest="fleet_slo_windows",
+                        default="60,600",
+                        help="fast,slow burn windows in router steps")
+    parser.add_argument("--fleet.slo-burn", dest="fleet_slo_burn",
+                        type=float, default=1.0)
+    parser.add_argument("--fleet.export-path", dest="fleet_export_path",
+                        default="",
+                        help="atomically-rewritten fleet control-plane "
+                        "snapshot (occupancy, per-class e2e p95, "
+                        "quarantine set, per-replica health)")
+    parser.add_argument("--fleet.export-every", dest="fleet_export_every",
+                        type=float, default=0.0,
+                        help="snapshot cadence in seconds (0 = one "
+                        "final snapshot when export-path is set)")
     opts = parser.parse_args(argv[:split])
     base_args = argv[split + 1:]
+    obs = FleetObsConfig(
+        trace=opts.fleet_trace, slo=opts.fleet_slo,
+        slo_windows=opts.fleet_slo_windows,
+        slo_burn=opts.fleet_slo_burn,
+        export_path=opts.fleet_export_path,
+        export_every=opts.fleet_export_every)
+    try:
+        obs.validate()
+    except ValueError as e:
+        parser.error(str(e))
 
     actions: List[Tuple[float, Callable]] = []
     for spec in opts.kill:
@@ -219,7 +412,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         workload=load_workload(opts.requests),
         ckpt_dir=opts.checkpoint_dir, actions=actions,
         timeout_s=opts.timeout,
-        jsonl=os.path.join(opts.fleet_dir, "fleet.jsonl"))
+        jsonl=os.path.join(opts.fleet_dir, "fleet.jsonl"),
+        obs=obs)
     summary.pop("tokens", None)   # per-request streams: bulky, and
     #                               the journals already hold them
     print(json.dumps(summary))

@@ -1,0 +1,256 @@
+"""One-screen fleet status + incident summary.
+
+::
+
+    python -m tensorflow_distributed_tpu.observe.fleetview /tmp/fleet \\
+        [--snapshot /tmp/fleet/snapshot.json]
+
+Renders everything a fleet run leaves on disk into one terminal
+screen: the control-plane snapshot (``--fleet.export-path``: aggregate
+occupancy/queue, per-class end-to-end p95, quarantine set, SLO error
+budget, per-replica health), the ``fleet.jsonl`` record stream
+(summary, SLO alert transitions, sheds, deaths, latency
+decomposition), the stitched ``fleet_trace.json`` (source/balance
+stats), and any flight-recorder bundles the replicas left behind
+(``flight-*.jsonl`` / ``postmortem-*.jsonl`` under the per-epoch
+workspaces). Every section is optional — the view renders whatever
+exists and says what is missing, because the most interesting fleets
+are the ones that died halfway.
+
+Pure stdlib; :func:`render` returns the screen as a string (tests),
+``main`` prints it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Sequence
+
+_BAR = "=" * 66
+
+
+def _load_jsonl(path: str) -> List[Dict[str, Any]]:
+    out: List[Dict[str, Any]] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue  # torn tail
+    except OSError:
+        pass
+    return out
+
+
+def _load_json(path: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _fmt(v: Any) -> str:
+    if isinstance(v, float):
+        return f"{v:.3f}".rstrip("0").rstrip(".")
+    return str(v)
+
+
+def _snapshot_section(snap: Optional[Dict[str, Any]],
+                      lines: List[str]) -> None:
+    lines.append("fleet snapshot")
+    if snap is None:
+        lines.append("  (no snapshot — run with --fleet.export-path)")
+        return
+    lines.append(
+        f"  t={_fmt(snap.get('t_s', 0))}s step={snap.get('step', 0)}  "
+        f"requests done={snap.get('requests_done', 0)}"
+        f"/{snap.get('requests', 0)} shed={snap.get('requests_shed', 0)}"
+        f"  waiting={snap.get('waiting', 0)} "
+        f"inflight={snap.get('inflight', 0)}")
+    lines.append(
+        f"  slots {snap.get('slots_live', 0)}/{snap.get('slots', 0)} "
+        f"live, queue={snap.get('queue_depth', 0)}, "
+        f"deaths={snap.get('deaths', 0)}, "
+        f"quarantined={snap.get('quarantined', []) or 'none'}")
+    cls_bits = [
+        f"{k[len('ttft_ms_p95_'):]}: p95="
+        f"{_fmt(v)}ms/p50={_fmt(snap.get('ttft_ms_p50_' + k[len('ttft_ms_p95_'):], 0))}ms"
+        for k, v in sorted(snap.items())
+        if k.startswith("ttft_ms_p95_")]
+    if cls_bits:
+        lines.append("  e2e ttft  " + "  ".join(cls_bits))
+    if "slo" in snap:
+        mark = " ALERTING" if snap.get("slo_alerting") else ""
+        lines.append(
+            f"  slo budget remaining min="
+            f"{_fmt(snap.get('slo_budget_remaining_min', 1.0))}{mark}")
+        for key, ent in sorted(snap["slo"].items()):
+            a = "!" if ent.get("alerting") else " "
+            lines.append(
+                f"   {a}{key}: burn {_fmt(ent.get('burn_fast', 0))}/"
+                f"{_fmt(ent.get('burn_slow', 0))} "
+                f"budget={_fmt(ent.get('budget_remaining', 1.0))} "
+                f"alerts={ent.get('alerts', 0)}")
+    reps = snap.get("replicas") or {}
+    for name, r in sorted(reps.items()):
+        stale = r.get("stale_s")
+        lines.append(
+            f"  {name:<4} {r.get('health', '?'):<12} "
+            f"e{r.get('epoch', 0)} load={r.get('load', 0)} "
+            f"inflight={r.get('inflight', 0)} done={r.get('done', 0)}"
+            + (f" stale={_fmt(stale)}s" if stale is not None else "")
+            + (f" [{r['reason']}]" if r.get("reason") else ""))
+
+
+def _records_section(records: List[Dict[str, Any]],
+                     lines: List[str]) -> None:
+    lines.append("record stream (fleet.jsonl)")
+    if not records:
+        lines.append("  (no fleet.jsonl)")
+        return
+    by_kind: Dict[str, int] = {}
+    for r in records:
+        by_kind[str(r.get("event"))] = by_kind.get(
+            str(r.get("event")), 0) + 1
+    summary = next((r for r in reversed(records)
+                    if r.get("event") == "fleet_summary"), None)
+    if summary is not None:
+        lines.append(
+            f"  summary: done={summary.get('requests_done', 0)}"
+            f"/{summary.get('requests', 0)} "
+            f"shed={summary.get('requests_shed', 0)} "
+            f"redispatches={summary.get('redispatches', 0)} "
+            f"deaths={summary.get('deaths', 0)} "
+            f"tok/s={_fmt(summary.get('tokens_per_sec', 0))}")
+        cls_bits = [
+            f"{k[len('ttft_ms_p95_'):]}={_fmt(v)}ms"
+            for k, v in sorted(summary.items())
+            if k.startswith("ttft_ms_p95_")]
+        if cls_bits:
+            lines.append("  e2e ttft p95  " + "  ".join(cls_bits))
+    alerts = [r for r in records
+              if r.get("event") == "fleet_slo_alert"]
+    oks = by_kind.get("fleet_slo_ok", 0)
+    lines.append(
+        f"  slo: {len(alerts)} alert(s), {oks} all-clear(s)"
+        + ("" if not alerts else " — last: " + ", ".join(
+            f"{a.get('target')} burn={_fmt(a.get('burn_fast', 0))}"
+            for a in alerts[-3:])))
+    incidents = [r for r in records if r.get("event") == "fleet_replica"
+                 and r.get("state") in ("dead", "quarantined")]
+    for r in incidents[-5:]:
+        lines.append(
+            f"  incident t={_fmt(r.get('t_s', 0))}s "
+            f"{r.get('replica')}: {r.get('state')}"
+            + (f" ({r['reason']})" if r.get("reason") else ""))
+    decomp = [r for r in records if r.get("event") == "fleet_decomp"]
+    if decomp:
+        n = len(decomp)
+        mean = {k: sum(float(d.get(k, 0)) for d in decomp) / n
+                for k in ("e2e_ms", "router_queue_ms", "inbox_lag_ms",
+                          "replica_queue_ms", "prefill_ms",
+                          "decode_ms", "absorb_ms", "residual_ms")}
+        lines.append(
+            f"  latency decomposition (mean over {n}): "
+            f"e2e={mean['e2e_ms']:.1f}ms = "
+            f"router_q {mean['router_queue_ms']:.1f} + "
+            f"inbox {mean['inbox_lag_ms']:.1f} + "
+            f"replica_q {mean['replica_queue_ms']:.1f} + "
+            f"prefill {mean['prefill_ms']:.1f} + "
+            f"decode {mean['decode_ms']:.1f} + "
+            f"absorb {mean['absorb_ms']:.1f} + "
+            f"residual {mean['residual_ms']:.1f}")
+
+
+def _trace_section(fleet_dir: str, lines: List[str]) -> None:
+    path = os.path.join(fleet_dir, "fleet_trace.json")
+    lines.append("stitched trace")
+    data = _load_json(path)
+    if data is None:
+        lines.append("  (no fleet_trace.json — run with --fleet.trace)")
+        return
+    events = data.get("traceEvents", [])
+    from tensorflow_distributed_tpu.observe.trace import (
+        unbalanced_async)
+    sources = sorted(
+        e["args"]["name"] for e in events
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+        and str(e.get("args", {}).get("name", "")).startswith("fleet:"))
+    bal = not unbalanced_async(events)
+    deaths = sum(1 for e in events if e.get("ph") == "e"
+                 and (e.get("args") or {}).get("process_death"))
+    lines.append(
+        f"  {path}: {len(events)} events, "
+        f"{len(sources)} sources, "
+        f"{'balanced' if bal else 'UNBALANCED'}, "
+        f"{deaths} span(s) closed at process death")
+    for s in sources:
+        lines.append(f"    {s}")
+
+
+def _flightrec_section(fleet_dir: str, lines: List[str]) -> None:
+    bundles = sorted(
+        glob.glob(os.path.join(fleet_dir, "*", "e*", "flight-*.jsonl"))
+        + glob.glob(os.path.join(fleet_dir, "*", "e*",
+                                 "postmortem-*.jsonl")))
+    if not bundles:
+        return
+    lines.append("flight-recorder bundles")
+    for b in bundles[-8:]:
+        recs = _load_jsonl(b)
+        last = recs[-1] if recs else {}
+        lines.append(
+            f"  {os.path.relpath(b, fleet_dir)}: {len(recs)} records"
+            + (f", last={last.get('event')}" if last else ""))
+
+
+def render(fleet_dir: str, snapshot: str = "") -> str:
+    """The one-screen fleet view as a string."""
+    snap = None
+    for cand in ([snapshot] if snapshot else []) + [
+            os.path.join(fleet_dir, "fleet_snapshot.json"),
+            os.path.join(fleet_dir, "snapshot.json")]:
+        snap = _load_json(cand)
+        if snap is not None:
+            break
+    records = _load_jsonl(os.path.join(fleet_dir, "fleet.jsonl"))
+    lines = [_BAR, f"fleet observatory — {fleet_dir}", _BAR]
+    _snapshot_section(snap, lines)
+    lines.append("")
+    _records_section(records, lines)
+    lines.append("")
+    _trace_section(fleet_dir, lines)
+    _flightrec_section(fleet_dir, lines)
+    lines.append(_BAR)
+    return "\n".join(lines)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tensorflow_distributed_tpu.observe.fleetview",
+        description="one-screen fleet status + incident summary")
+    parser.add_argument("fleet_dir",
+                        help="the fleet run's --fleet-dir")
+    parser.add_argument("--snapshot", default="",
+                        help="the --fleet.export-path file (default: "
+                        "fleet_snapshot.json under the fleet dir)")
+    opts = parser.parse_args(argv)
+    if not os.path.isdir(opts.fleet_dir):
+        print(f"fleetview: {opts.fleet_dir} is not a directory",
+              file=sys.stderr)
+        return 2
+    print(render(opts.fleet_dir, opts.snapshot))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -173,7 +173,9 @@ class ServeObservatory:
         if ocfg.trace:
             self.tracer = ServeTracer(ocfg.trace, enabled=chief,
                                       pid=process_index,
-                                      resume=resumed)
+                                      resume=resumed,
+                                      durable=getattr(
+                                          ocfg, "trace_durable", False))
         self.slo_monitor = None
         self.status_every = 0
         fast, _slow = parse_windows(ocfg.slo_windows)

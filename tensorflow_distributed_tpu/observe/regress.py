@@ -195,6 +195,24 @@ MANIFEST: Dict[str, Tuple[str, List[Check]]] = {
         ("fleet_goodput.value", "higher", 0.15),
         ("fleet_fault_staleness.rolling_swaps", "equal"),
     )),
+    "FLEETOBSBENCH.json": ("jsonl", _jsonl_checks(
+        # Observatory gates are bools the bench itself derives
+        # (stitched-trace balance across a real SIGKILL failover,
+        # alert-on-fault/quiet-on-control, decomposition residual,
+        # snapshot==report parity, fleetview render); the only analog
+        # metric is the tracing-overhead throughput ratio, banded
+        # generously for CPU noise on top of its own >= gate.
+        ("fleetobs_checks.control_quiet", "truthy"),
+        ("fleetobs_checks.fault_alerted", "truthy"),
+        ("fleetobs_checks.lost", "lower", 0.0, 0.0),
+        ("fleetobs_checks.traces_balanced", "truthy"),
+        ("fleetobs_checks.failover_legs_ok", "truthy"),
+        ("fleetobs_checks.decomp_ok", "truthy"),
+        ("fleetobs_checks.snapshot_agrees_with_report", "truthy"),
+        ("fleetobs_checks.fleetview_ok", "truthy"),
+        ("fleetobs_checks.overhead_ok", "truthy"),
+        ("fleetobs_overhead.ratio", "higher", 0.1),
+    )),
     "GENBENCH.json": ("jsonl", _jsonl_checks(
         ("gen_prefill_tokens_per_sec.value", "higher", 0.3),
         ("gen_decode_tokens_per_sec.value", "higher", 0.3),

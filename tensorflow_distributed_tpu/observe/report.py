@@ -315,6 +315,79 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             e["swaps"] = e.get("swaps", 0) + 1
         if replicas:
             entry["replicas"] = dict(sorted(replicas.items()))
+        # Fleet observatory (PR 16): client-perceived end-to-end
+        # latency per class from the router's fleet_request records —
+        # the SAME population + nearest-rank percentile the router's
+        # summary and the --fleet.export-path snapshot use, so all
+        # three agree exactly (snapshot == report, fleet level).
+        fl_req = [r for r in records
+                  if r.get("event") == "fleet_request"]
+        if fl_req:
+            entry["e2e_requests"] = len(fl_req)
+            by_cls: Dict[str, List[float]] = {}
+            for r in fl_req:
+                if isinstance(r.get("ttft_ms"), (int, float)):
+                    by_cls.setdefault(
+                        str(r.get("slo", "standard")), []).append(
+                        float(r["ttft_ms"]))
+            for cls, vals in sorted(by_cls.items()):
+                vals.sort()
+                entry[f"ttft_ms_p50_{cls}"] = round(
+                    _percentile(vals, 50), 3)
+                entry[f"ttft_ms_p95_{cls}"] = round(
+                    _percentile(vals, 95), 3)
+            e2es = sorted(float(r["e2e_ms"]) for r in fl_req
+                          if isinstance(r.get("e2e_ms"), (int, float)))
+            if e2es:
+                entry["e2e_ms_p95"] = round(_percentile(e2es, 95), 3)
+        # Fleet SLO transitions (the router-level monitor): alert /
+        # all-clear counts per target + the final budget floor.
+        fl_alerts = [r for r in records
+                     if r.get("event") == "fleet_slo_alert"]
+        fl_oks = [r for r in records
+                  if r.get("event") == "fleet_slo_ok"]
+        if fl_alerts or fl_oks:
+            slo_entry: Dict[str, Any] = {
+                "alerts": len(fl_alerts), "oks": len(fl_oks)}
+            by_tgt: Dict[str, int] = {}
+            for r in fl_alerts:
+                t = str(r.get("target", "?"))
+                by_tgt[t] = by_tgt.get(t, 0) + 1
+            if by_tgt:
+                slo_entry["alerts_by_target"] = dict(
+                    sorted(by_tgt.items()))
+            budgets = [r.get("budget_remaining")
+                       for r in fl_alerts + fl_oks
+                       if isinstance(r.get("budget_remaining"),
+                                     (int, float))]
+            if budgets:
+                slo_entry["budget_remaining_min"] = min(budgets)
+            entry["slo"] = slo_entry
+        # Per-dispatch latency decomposition (stitched-trace derived):
+        # mean component split + the residual fraction the bench
+        # gates.
+        fl_dec = [r for r in records
+                  if r.get("event") == "fleet_decomp"]
+        if fl_dec:
+            comps = ("e2e_ms", "router_queue_ms", "inbox_lag_ms",
+                     "replica_queue_ms", "prefill_ms", "decode_ms",
+                     "absorb_ms", "residual_ms")
+            dec_entry: Dict[str, Any] = {"requests": len(fl_dec)}
+            for key in comps:
+                vals = [float(r.get(key, 0.0)) for r in fl_dec]
+                dec_entry[f"{key}_mean"] = round(
+                    sum(vals) / len(vals), 3)
+            fracs = [abs(float(r.get("residual_ms", 0.0)))
+                     / float(r["e2e_ms"]) for r in fl_dec
+                     if float(r.get("e2e_ms", 0.0)) > 0]
+            if fracs:
+                dec_entry["residual_frac_mean"] = round(
+                    sum(fracs) / len(fracs), 4)
+            entry["decomposition"] = dec_entry
+        fl_snaps = [r for r in records
+                    if r.get("event") == "fleet_snapshot"]
+        if fl_snaps:
+            entry["snapshots"] = len(fl_snaps)
         out["fleet"] = entry
     # Incident observatory (observe/anomaly.py "anomaly" records +
     # observe/flightrec.py "postmortem" records): per-detector counts,
@@ -656,6 +729,38 @@ def render(summary: Dict[str, Any]) -> str:
         if "shed_by_class" in fl and fl["shed_by_class"]:
             lines.append(f"  {'shed_by_class':<28} "
                          f"{fl['shed_by_class']}")
+        e2e_bits = [f"{k}={v}" for k, v in sorted(fl.items())
+                    if k.startswith("ttft_ms_p50_")
+                    or k.startswith("ttft_ms_p95_")]
+        if "e2e_ms_p95" in fl:
+            e2e_bits.append(f"e2e_ms_p95={fl['e2e_ms_p95']}")
+        if e2e_bits:
+            lines.append(f"  {'e2e latency (per class)':<28} "
+                         + " ".join(e2e_bits))
+        if "slo" in fl:
+            se = fl["slo"]
+            bits = [f"alerts={se.get('alerts', 0)}",
+                    f"oks={se.get('oks', 0)}"]
+            if "budget_remaining_min" in se:
+                bits.append(
+                    f"budget_min={se['budget_remaining_min']}")
+            if se.get("alerts_by_target"):
+                bits.append(str(se["alerts_by_target"]))
+            lines.append(f"  {'fleet slo':<28} " + " ".join(bits))
+        if "decomposition" in fl:
+            de = fl["decomposition"]
+            lines.append(
+                f"  {'decomposition (mean ms)':<28} "
+                f"e2e={de.get('e2e_ms_mean', 0)} = "
+                f"router_q {de.get('router_queue_ms_mean', 0)} + "
+                f"inbox {de.get('inbox_lag_ms_mean', 0)} + "
+                f"replica_q {de.get('replica_queue_ms_mean', 0)} + "
+                f"prefill {de.get('prefill_ms_mean', 0)} + "
+                f"decode {de.get('decode_ms_mean', 0)} + "
+                f"absorb {de.get('absorb_ms_mean', 0)} + "
+                f"residual {de.get('residual_ms_mean', 0)}"
+                + (f" (frac={de['residual_frac_mean']})"
+                   if "residual_frac_mean" in de else ""))
         for name, entry in (fl.get("replicas") or {}).items():
             bits = " ".join(f"{k}={v}" for k, v in
                             sorted(entry.items()))

@@ -48,11 +48,20 @@ class ServeTracer:
 
     def __init__(self, path: str = "", enabled: bool = True,
                  pid: int = 0, clock=time.perf_counter,
-                 resume: bool = False, max_events: int = 200_000):
+                 resume: bool = False, max_events: int = 200_000,
+                 durable: bool = False):
         self.tracer = ChromeTracer(path, pid=pid, enabled=enabled,
                                    process_name="tfd-serve",
                                    clock=clock, max_events=max_events)
         self.enabled = self.tracer.enabled
+        # durable=True flushes at every request-lifecycle edge
+        # (admission, completion, eviction) instead of only on the 5s
+        # cadence: a fleet replica can be SIGKILLed at any moment, and
+        # the stitcher needs the moved request's spans ON DISK for the
+        # failover to render — fleet runs are short and low-rate, so
+        # the extra rewrites are cheap there (don't set it for a
+        # 10k-request standalone serve).
+        self.durable = bool(durable)
         self._open: Dict[str, set] = {}   # rid -> open child span names
         if self.enabled and resume and os.path.exists(path):
             try:
@@ -107,6 +116,8 @@ class ServeTracer:
             self.tracer.async_end("prefill", rid, cat=_CAT)
             self.tracer.async_begin("decode", rid, cat=_CAT)
             spans.add("decode")
+            if self.durable:
+                self.tracer.flush()
 
     def request_done(self, rid: int, finish: str, tokens: int,
                      ttft_ms: float) -> None:
@@ -118,6 +129,8 @@ class ServeTracer:
         self.tracer.async_end("request", rid, cat=_CAT, finish=finish,
                               tokens=tokens,
                               ttft_ms=round(ttft_ms, 3))
+        if self.durable:
+            self.tracer.flush()
 
     def request_evicted(self, rid: int, why: str) -> None:
         """Quarantine/preemption: the request leaves its slot and goes
@@ -133,6 +146,8 @@ class ServeTracer:
         if "queue" not in spans:
             self.tracer.async_begin("queue", rid, cat=_CAT, why=why)
             spans.add("queue")
+        if self.durable:
+            self.tracer.flush()
 
     # -- engine + recovery ------------------------------------------------
 

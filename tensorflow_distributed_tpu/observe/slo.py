@@ -242,7 +242,7 @@ class SLOMonitor:
     def __init__(self, targets: List[SLOTarget], fast_window: int = 60,
                  slow_window: int = 600, burn_threshold: float = 1.0,
                  emit: Optional[Callable[..., Any]] = None,
-                 tracer: Any = None):
+                 tracer: Any = None, event_prefix: str = ""):
         if not targets:
             raise ValueError("SLOMonitor needs at least one target")
         if burn_threshold <= 0:
@@ -258,6 +258,12 @@ class SLOMonitor:
         self.burn_threshold = burn_threshold
         self._emit = emit
         self._tracer = tracer
+        # "fleet_" at the router makes the monitor emit
+        # fleet_slo_alert / fleet_slo_ok — same machinery, a namespace
+        # that keeps fleet-level and per-replica records separable in
+        # one merged JSONL (observe/report.py folds them into
+        # different sections).
+        self.event_prefix = event_prefix
         self._state = [_TargetState(t, fast, slow) for t in targets]
 
     def observe(self, slo_class: str, ttft_ms: float, tok_ms: float,
@@ -283,7 +289,8 @@ class SLOMonitor:
             if firing == st.alerting:
                 continue
             st.alerting = firing
-            kind = "slo_alert" if firing else "slo_ok"
+            kind = self.event_prefix + (
+                "slo_alert" if firing else "slo_ok")
             if firing:
                 st.alerts += 1
             fields = {

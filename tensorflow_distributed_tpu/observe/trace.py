@@ -82,6 +82,20 @@ class ChromeTracer:
             self._events.append({
                 "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
                 "args": {"name": process_name}})
+            # Wall-clock anchor: this tracer's ts=0 corresponds to
+            # wall_ts seconds since the epoch. perf_counter timelines
+            # from DIFFERENT processes share no origin; the fleet
+            # stitcher (observe/fleet_trace.py) reads each file's
+            # FIRST clock_sync to place every source on one absolute
+            # axis (refined by the snapshot wall_ts<->mtime offsets).
+            # Named-process tracers only — exactly the ones that can
+            # become stitch sources.
+            self._events.append({
+                "ph": "M", "name": "clock_sync", "pid": pid, "tid": 0,
+                "args": {"wall_ts": round(time.time(), 6)}})
+        # Constructor metadata doesn't eat into the event budget —
+        # max_events caps RECORDED work, not the preamble.
+        self._preamble = len(self._events)
 
     def _ts(self) -> float:
         return (self._clock() - self._t0) * 1e6 + self._ts_offset
@@ -111,7 +125,8 @@ class ChromeTracer:
         return threading.get_ident() & 0xFFFF
 
     def _add(self, event: Dict[str, Any], force: bool = False) -> None:
-        if len(self._events) >= self.max_events and not force:
+        if (len(self._events) - self._preamble >= self.max_events
+                and not force):
             self.dropped += 1
             return
         self._events.append(event)

@@ -1151,6 +1151,13 @@ class Scheduler:
         pstats = getattr(self.engine, "paging_stats", None)
         if pstats is not None:
             snap.update(pstats())
+        lag_stats = getattr(self.feed, "lag_stats", None)
+        if lag_stats is not None:
+            # Inbox-poll lag (fleet replica mode): dispatch-file write
+            # -> feed intake, from the router's enq_ts stamp — the
+            # fleet latency decomposition's replica-side anchor and an
+            # early warning for a wedged feed.
+            snap.update(lag_stats())
         if self.slo_monitor is not None:
             snap["slo"] = self.slo_monitor.snapshot()
         if self.anomaly_hub is not None:

@@ -1,0 +1,677 @@
+"""Fleet observatory: the router's FleetTracer, clock-offset
+estimation, cross-replica trace stitching on canned fake traces
+(skewed clocks, torn files, dead legs), the latency decomposition,
+fleet-level SLO plumbing, the control-plane snapshot, the fleetview
+screen, and one slow supervised e2e (real 2-replica fleet, SIGKILL
+mid-stream, merged trace balanced).
+
+The fast tier is jax-free: every stitcher scenario runs on hand-built
+Chrome-trace JSON with explicit clock anchors, so skew, tears and
+process death are exact, not raced.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from tensorflow_distributed_tpu.observe.fleet_trace import (
+    FleetTracer, decompose, estimate_offset, gen_to_rid, stitch)
+from tensorflow_distributed_tpu.observe.trace import (
+    load_trace, unbalanced_async)
+
+
+# --- FleetTracer (router-side spans) --------------------------------------
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _events_by(events, ph=None, name=None, cat=None):
+    return [e for e in events
+            if (ph is None or e.get("ph") == ph)
+            and (name is None or e.get("name") == name)
+            and (cat is None or e.get("cat") == cat)]
+
+
+def test_fleet_tracer_request_lifecycle_balanced(tmp_path):
+    path = str(tmp_path / "router_trace.json")
+    clock = _Clock()
+    ft = FleetTracer(path, clock=clock)
+    ft.request_queued(0, slo="high", prompt_len=7)
+    clock.t = 0.010
+    ft.dispatch(0, 1, "r0", retry=0)
+    clock.t = 0.025
+    ft.first_token(0, 1, "r0")
+    clock.t = 0.100
+    ft.request_done(0, finish="done", tokens=32, ttft_ms=15.0,
+                    retries=0)
+    ft.counters(waiting=2, inflight=1)
+    ft.close()
+    ev = load_trace(path)
+    assert not unbalanced_async(ev)
+    # The anchor the stitcher needs, and the named process row.
+    assert _events_by(ev, ph="M", name="clock_sync")
+    names = {e["args"]["name"] for e in
+             _events_by(ev, ph="M", name="process_name")}
+    assert "tfd-router" in names
+    # request + client_queue keyed by rid, dispatch by the WIRE id.
+    req = _events_by(ev, ph="b", name="request", cat="fleet")
+    assert [e["id"] for e in req] == ["0"]
+    assert req[0]["args"] == {"slo": "high", "prompt_len": 7}
+    disp = _events_by(ev, ph="b", name="dispatch", cat="fleet")
+    assert [e["id"] for e in disp] == ["1"]
+    assert disp[0]["args"]["replica"] == "r0"
+    # client_queue closed AT dispatch, not at done.
+    qe = _events_by(ev, ph="e", name="client_queue")[0]
+    assert qe["ts"] == pytest.approx(10_000, abs=1)
+    assert _events_by(ev, ph="i", name="first_token")
+    done = _events_by(ev, ph="e", name="request")[0]
+    assert done["args"]["finish"] == "done"
+    assert done["args"]["tokens"] == 32
+    assert _events_by(ev, ph="C", name="waiting")
+
+
+def test_fleet_tracer_leg_failed_reopens_queue_and_marks(tmp_path):
+    path = str(tmp_path / "router_trace.json")
+    clock = _Clock()
+    ft = FleetTracer(path, clock=clock)
+    ft.request_queued(3)
+    clock.t = 0.01
+    ft.dispatch(3, 3073, "r1")
+    clock.t = 0.05
+    ft.leg_failed(3, 3073, "r1", why="replica_death")
+    clock.t = 0.08
+    ft.dispatch(3, 3074, "r0", retry=1)
+    clock.t = 0.20
+    ft.request_done(3, finish="done", tokens=8, retries=1)
+    ft.close()
+    ev = load_trace(path)
+    assert not unbalanced_async(ev)
+    # The stitcher's dead-leg hook: a redispatch instant carrying the
+    # failed generation id.
+    redisp = _events_by(ev, ph="i", name="redispatch")
+    assert [e["args"]["gen"] for e in redisp] == [3073]
+    # Both dispatch legs present; the failed one says so.
+    ends = {e["id"]: e for e in
+            _events_by(ev, ph="e", name="dispatch")}
+    assert ends["3073"]["args"]["failed"] is True
+    assert ends["3074"]["args"]["finish"] == "done"
+    # client_queue opened twice (arrival + back-at-router).
+    assert len(_events_by(ev, ph="b", name="client_queue")) == 2
+
+
+def test_fleet_tracer_shed_and_close_balance(tmp_path):
+    path = str(tmp_path / "router_trace.json")
+    ft = FleetTracer(path, clock=_Clock())
+    ft.request_queued(0)
+    ft.shed(0, reason="saturated")
+    ft.request_queued(1)
+    ft.dispatch(1, 1025, "r0")
+    ft.replica_event("replica_death", "r0", pid=123)
+    ft.close()                       # rid 1 still open: closed here
+    ev = load_trace(path)
+    assert not unbalanced_async(ev)
+    assert _events_by(ev, ph="i", name="shed")
+    assert _events_by(ev, ph="i", name="replica_death")
+    end = _events_by(ev, ph="e", name="dispatch")[0]
+    assert end["args"]["finish"] == "open_at_close"
+
+
+# --- clock-offset estimation ----------------------------------------------
+
+def test_estimate_offset_median_and_empty():
+    assert estimate_offset([]) == 0.0
+    # Odd count: the middle delta.
+    assert estimate_offset([(10.0, 10.3), (20.0, 20.1),
+                            (30.0, 30.2)]) == pytest.approx(0.2)
+    # Even count: mean of the two middles.
+    assert estimate_offset([(0.0, 0.1), (1.0, 1.3)]) \
+        == pytest.approx(0.2)
+    # One wild poll-lagged sample doesn't move the median.
+    samples = [(float(i), float(i) + 0.05) for i in range(9)]
+    samples.append((100.0, 109.0))
+    assert estimate_offset(samples) == pytest.approx(0.05)
+
+
+def test_gen_to_rid_inverts_router_wire_ids():
+    assert gen_to_rid(1025) == 1
+    assert gen_to_rid(3074) == 3
+    assert gen_to_rid(0) == 0
+
+
+# --- the stitcher on canned traces ----------------------------------------
+
+def _trace_file(path, name, wall_ts, events):
+    """A minimal ChromeTracer-shaped file with an explicit anchor."""
+    pre = [
+        {"ph": "M", "name": "process_name", "pid": 0, "tid": 0,
+         "args": {"name": name}},
+        {"ph": "M", "name": "clock_sync", "pid": 0, "tid": 0,
+         "args": {"wall_ts": wall_ts}},
+    ]
+    with open(path, "w") as f:
+        json.dump({"traceEvents": pre + events,
+                   "displayTimeUnit": "ms"}, f)
+
+
+def _b(name, id, ts, cat="serve", **args):
+    ev = {"ph": "b", "name": name, "cat": cat, "pid": 0, "tid": 0,
+          "id": str(id), "ts": float(ts)}
+    if args:
+        ev["args"] = args
+    return ev
+
+
+def _e(name, id, ts, cat="serve", **args):
+    ev = dict(_b(name, id, ts, cat=cat, **args))
+    ev["ph"] = "e"
+    return ev
+
+
+def _i(name, ts, cat="fleet", **args):
+    return {"ph": "i", "name": name, "cat": cat, "pid": 0, "tid": 0,
+            "ts": float(ts), "s": "p", "args": args}
+
+
+def test_stitch_skewed_clocks_one_ordered_timeline(tmp_path):
+    # Router starts at wall 1000.0; r0's tracer started 0.5s later
+    # but its clock reads 0.2s FAST (offset -0.2 corrects it).
+    router = str(tmp_path / "router.json")
+    rep = str(tmp_path / "r0.json")
+    out = str(tmp_path / "merged.json")
+    _trace_file(router, "tfd-router", 1000.0, [
+        _b("request", 0, 0.0, cat="fleet"),
+        _b("dispatch", 1, 100.0, cat="fleet", rid=0, replica="r0"),
+        _e("dispatch", 1, 900_000.0, cat="fleet", finish="done"),
+        _e("request", 0, 900_100.0, cat="fleet", finish="done"),
+    ])
+    _trace_file(rep, "tfd-serve[r0]", 1000.7, [
+        _b("request", 1, 0.0),
+        _e("request", 1, 500_000.0, finish="length"),
+    ])
+    stats = stitch(router, [("r0/e0", rep, -0.2)], out)
+    assert stats == {"sources": 2, "skipped": 0,
+                     "events": stats["events"],
+                     "closed_at_death": 0, "balanced": True}
+    merged = load_trace(out)
+    assert not unbalanced_async(merged)
+    # Per-source process rows renamed fleet:<name>.
+    rows = sorted(e["args"]["name"] for e in merged
+                  if e.get("ph") == "M"
+                  and e.get("name") == "process_name")
+    assert rows == ["fleet:r0/e0", "fleet:router"]
+    # r0's corrected start is 1000.7 - 0.2 = 1000.5 -> its events are
+    # shifted +0.5s onto the router's axis: the replica request begins
+    # AFTER the dispatch, inside it.
+    by = {(e.get("cat"), e.get("ph"), e.get("name")): e
+          for e in merged if e.get("ph") in ("b", "e")}
+    rep_b = by[("serve", "b", "request")]
+    assert rep_b["ts"] == pytest.approx(500_000.0, abs=1)
+    assert by[("fleet", "b", "dispatch")]["ts"] < rep_b["ts"] \
+        < by[("fleet", "e", "dispatch")]["ts"]
+    # Distinct pids per source (Perfetto track separation).
+    assert len({e["pid"] for e in merged}) == 2
+
+
+def test_stitch_torn_replica_file_skipped_with_marker(tmp_path):
+    router = str(tmp_path / "router.json")
+    torn = str(tmp_path / "torn.json")
+    out = str(tmp_path / "merged.json")
+    _trace_file(router, "tfd-router", 1000.0, [
+        _b("request", 0, 0.0, cat="fleet"),
+        _e("request", 0, 1000.0, cat="fleet"),
+    ])
+    with open(torn, "w") as f:
+        f.write('{"traceEvents": [{"ph": "b", "na')   # SIGKILL mid-write
+    stats = stitch(router, [("r1/e0", torn, 0.0),
+                            ("r2/e0", str(tmp_path / "absent.json"),
+                             0.0)], out)
+    assert stats["sources"] == 1 and stats["skipped"] == 2
+    assert stats["balanced"]
+    merged = load_trace(out)
+    markers = {e["name"] for e in merged if e.get("ph") == "i"}
+    assert "trace_skipped:r1/e0" in markers
+    assert "trace_skipped:r2/e0" in markers
+
+
+def test_stitch_closes_dead_leg_at_redispatch_instant(tmp_path):
+    # r1 was SIGKILLed mid-decode: its durable trace has open request/
+    # decode spans for gen 1025. The router's redispatch instant for
+    # that generation is the fleet-level end of the leg.
+    router = str(tmp_path / "router.json")
+    rep = str(tmp_path / "r1.json")
+    out = str(tmp_path / "merged.json")
+    _trace_file(router, "tfd-router", 1000.0, [
+        _b("request", 1, 0.0, cat="fleet"),
+        _b("dispatch", 1025, 50.0, cat="fleet", rid=1, replica="r1"),
+        _e("dispatch", 1025, 300_000.0, cat="fleet", failed=True),
+        _i("redispatch", 300_000.0, rid=1, gen=1025,
+           replica="r1", why="replica_death"),
+        _b("dispatch", 1026, 300_100.0, cat="fleet", rid=1,
+           replica="r0", retry=1),
+        _e("dispatch", 1026, 700_000.0, cat="fleet", finish="done"),
+        _e("request", 1, 700_050.0, cat="fleet", finish="done"),
+        # A second request SHED with no redispatch: its dead leg falls
+        # back to the router-side request end.
+        _b("request", 2, 0.0, cat="fleet"),
+        _e("request", 2, 800_000.0, cat="fleet", finish="shed:x"),
+    ])
+    _trace_file(rep, "tfd-serve[r1]", 1000.0, [
+        _b("request", 1025, 60.0),
+        _b("decode", 1025, 2_000.0),
+        _b("request", 2049, 70.0),
+    ])
+    stats = stitch(router, [("r1/e0", rep, 0.0)], out)
+    assert stats["closed_at_death"] == 3
+    assert stats["balanced"]
+    merged = load_trace(out)
+    assert not unbalanced_async(merged)
+    deaths = [e for e in merged if e.get("ph") == "e"
+              and (e.get("args") or {}).get("process_death")]
+    by_id = {}
+    for e in deaths:
+        by_id.setdefault(e["id"], []).append(float(e["ts"]))
+    # gen-1025 spans close exactly at the redispatch instant...
+    assert by_id["1025"] == [pytest.approx(300_000.0, abs=1)] * 2
+    # ...the shed request's at its router request end.
+    assert by_id["2049"] == [pytest.approx(800_000.0, abs=1)]
+
+
+def test_stitch_no_readable_source_raises(tmp_path):
+    with pytest.raises(ValueError, match="no readable trace"):
+        stitch(str(tmp_path / "nope.json"), [],
+               str(tmp_path / "out.json"))
+
+
+# --- latency decomposition ------------------------------------------------
+
+def test_decompose_components_sum_to_e2e():
+    ev = [
+        _b("request", 0, 0.0, cat="fleet"),
+        _b("client_queue", 0, 0.0, cat="fleet"),
+        _e("client_queue", 0, 5_000.0, cat="fleet"),
+        _b("dispatch", 1, 5_000.0, cat="fleet"),
+        _b("request", 1, 15_000.0),            # inbox lag 10ms
+        _b("queue", 1, 15_000.0),
+        _e("queue", 1, 17_000.0),              # replica queue 2ms
+        _b("prefill", 1, 17_000.0),
+        _e("prefill", 1, 25_000.0),            # prefill 8ms
+        _b("decode", 1, 25_000.0),
+        _e("decode", 1, 85_000.0),             # decode 60ms
+        _e("request", 1, 85_500.0),
+        _e("dispatch", 1, 99_000.0, cat="fleet"),  # absorb 13.5ms
+        _e("request", 0, 100_000.0, cat="fleet"),
+    ]
+    rows = decompose(ev)
+    assert len(rows) == 1
+    r = rows[0]
+    assert r["rid"] == 0 and r["gens"] == [1]
+    assert r["e2e_ms"] == pytest.approx(100.0)
+    assert r["router_queue_ms"] == pytest.approx(5.0)
+    assert r["inbox_lag_ms"] == pytest.approx(10.0)
+    assert r["replica_queue_ms"] == pytest.approx(2.0)
+    assert r["prefill_ms"] == pytest.approx(8.0)
+    assert r["decode_ms"] == pytest.approx(60.0)
+    assert r["absorb_ms"] == pytest.approx(13.5)
+    # Residual = e2e - sum(parts): the 1.5ms of unattributed gap.
+    assert r["residual_ms"] == pytest.approx(1.5)
+
+
+def test_decompose_failover_spans_both_generations():
+    ev = [
+        _b("request", 2, 0.0, cat="fleet"),
+        _b("dispatch", 2049, 1_000.0, cat="fleet"),
+        _b("request", 2049, 2_000.0),
+        _b("decode", 2049, 3_000.0),
+        _e("decode", 2049, 30_000.0, process_death=True),
+        _e("request", 2049, 30_000.0, process_death=True),
+        _e("dispatch", 2049, 30_000.0, cat="fleet", failed=True),
+        _b("dispatch", 2050, 31_000.0, cat="fleet"),
+        _b("request", 2050, 33_000.0),
+        _b("decode", 2050, 33_500.0),
+        _e("decode", 2050, 60_000.0),
+        _e("request", 2050, 60_100.0),
+        _e("dispatch", 2050, 61_000.0, cat="fleet"),
+        _e("request", 2, 61_500.0, cat="fleet"),
+    ]
+    r = decompose(ev)[0]
+    assert r["gens"] == [2049, 2050]
+    # Decode accumulates across BOTH legs (27 + 26.5 ms).
+    assert r["decode_ms"] == pytest.approx(53.5)
+    # Inbox lag and absorb likewise per leg.
+    assert r["inbox_lag_ms"] == pytest.approx(1.0 + 2.0)
+    assert r["absorb_ms"] == pytest.approx(0.0 + 0.9)
+
+
+# --- fleet SLO plumbing ---------------------------------------------------
+
+def test_slo_monitor_event_prefix_namespaces_records():
+    from tensorflow_distributed_tpu.observe.slo import (
+        SLOMonitor, parse_slo)
+    emitted = []
+    mon = SLOMonitor(parse_slo("ttft_p95=10ms"), fast_window=4,
+                     slow_window=8,
+                     emit=lambda e, **f: emitted.append((e, f)),
+                     event_prefix="fleet_")
+    for i in range(6):
+        mon.observe("standard", ttft_ms=500.0, tok_ms=1.0, step=i)
+        mon.on_step(i)
+    kinds = [e for e, _ in emitted]
+    assert "fleet_slo_alert" in kinds and "slo_alert" not in kinds
+    assert mon.summary()["slo_alerts"] >= 1
+
+
+def test_fleet_obs_config_validation():
+    from tensorflow_distributed_tpu.fleet.run import FleetObsConfig
+    FleetObsConfig().validate()
+    FleetObsConfig(trace=True, slo="ttft_p95=100ms",
+                   export_path="/t/s.json",
+                   export_every=0.5).validate()
+    with pytest.raises(ValueError, match="export_path"):
+        FleetObsConfig(export_every=1.0).validate()
+    with pytest.raises(ValueError, match="slo_burn"):
+        FleetObsConfig(slo="ttft_p95=1ms", slo_burn=0).validate()
+    with pytest.raises(ValueError, match="fleet.slo"):
+        FleetObsConfig(slo_windows="5,10").validate()
+    with pytest.raises(ValueError, match="export_every"):
+        FleetObsConfig(export_path="/t/s.json",
+                       export_every=-1).validate()
+
+
+# --- inbox-poll lag (the decomposition's replica-side anchor) -------------
+
+def test_inbox_feed_lag_stats_from_enq_ts(tmp_path):
+    from tensorflow_distributed_tpu.fleet.replica import (
+        InboxFeed, append_line)
+    import time as time_mod
+    path = str(tmp_path / "inbox.jsonl")
+    feed = InboxFeed(path, poll_s=0.0)
+    assert feed.lag_stats() == {}           # nothing stamped yet
+    now = time_mod.time()
+    append_line(path, {"rid": 1, "prompt": [1], "max_new": 2,
+                       "enq_ts": now - 0.05})
+    append_line(path, {"rid": 2, "prompt": [1], "max_new": 2})
+    assert len(feed.poll()) == 2
+    stats = feed.lag_stats()
+    assert stats["inbox_poll_lag_ms"] >= 50.0
+    assert stats["inbox_poll_lag_ms_p95"] >= stats["inbox_poll_lag_ms"]
+
+
+def test_scheduler_snapshot_carries_inbox_poll_lag():
+    from tensorflow_distributed_tpu.serve.scheduler import Scheduler
+    import tests.test_fleet as tf
+    import tests.test_serve as ts
+
+    class _LagFeed(tf._ScriptedFeed if hasattr(tf, "_ScriptedFeed")
+                   else object):
+        def __init__(self):
+            self.batches = [[{"cmd": "drain"}]]
+
+        def poll(self):
+            return self.batches.pop(0) if self.batches else []
+
+        def lag_stats(self):
+            return {"inbox_poll_lag_ms": 7.5,
+                    "inbox_poll_lag_ms_p95": 12.0}
+
+    sched = Scheduler(ts._FakeEngine(num_slots=2), feed=_LagFeed())
+    sched.run([])
+    snap = sched.metrics_snapshot()
+    assert snap["inbox_poll_lag_ms"] == 7.5
+    assert snap["inbox_poll_lag_ms_p95"] == 12.0
+
+
+# --- control-plane snapshot == report (per-class e2e TTFT) ----------------
+
+def test_router_fleet_snapshot_matches_summary_per_class():
+    import tests.test_fleet as tf
+    a = tf.FakeReplica("a", tok_per_tick=2)
+    b = tf.FakeReplica("b", tok_per_tick=2)
+    a.tick(), b.tick()
+    router = tf._router([a, b])
+    router.submit([tf._req(0, slo="high"), tf._req(1, slo="high"),
+                   tf._req(2, slo="batch")])
+    tf._spin(router, [a, b], 0.0, 3.0)
+    summ = router.summary()
+    snap = router.fleet_snapshot(3.0)
+    keys = [k for k in summ if k.startswith(("ttft_ms_p95_",
+                                             "ttft_ms_p50_"))]
+    assert any(k.endswith("_high") for k in keys)
+    for k in keys:
+        # EXACT equality: same population, same nearest-rank
+        # percentile, same rounding — the snapshot==report contract.
+        assert snap[k] == summ[k], k
+    assert snap["requests_done"] == 3
+    assert set(snap["replicas"]) == {"a", "b"}
+    for rep in snap["replicas"].values():
+        assert rep["health"] == "up"
+
+
+def test_router_emits_fleet_request_records():
+    import tests.test_fleet as tf
+    a = tf.FakeReplica("a", tok_per_tick=2)
+    a.tick()
+    events = []
+    router = tf._router([a], emit=lambda e, **f: events.append((e, f)))
+    router.submit([tf._req(0, slo="batch", max_new=4)])
+    tf._spin(router, [a], 0.0, 2.0)
+    recs = [f for e, f in events if e == "fleet_request"]
+    assert len(recs) == 1
+    r = recs[0]
+    assert r["slo"] == "batch" and r["retries"] == 0
+    assert not r["redispatched"]
+    assert r["ttft_ms"] >= 0 and r["e2e_ms"] >= r["ttft_ms"]
+    assert r["tokens"] == 4 and "tok_ms" in r
+
+
+# --- fleetview + report folding -------------------------------------------
+
+def _seed_fleet_dir(tmp_path):
+    d = str(tmp_path / "fleet")
+    os.makedirs(d)
+    with open(os.path.join(d, "fleet_snapshot.json"), "w") as f:
+        json.dump({"t_s": 9.5, "step": 42, "requests": 10,
+                   "requests_done": 9, "requests_shed": 1,
+                   "waiting": 0, "inflight": 0, "slots": 4,
+                   "slots_live": 0, "queue_depth": 0,
+                   "quarantined": [], "deaths": 1,
+                   "ttft_ms_p95_high": 12.5, "ttft_ms_p50_high": 8.0,
+                   "slo_alerting": True,
+                   "slo_budget_remaining_min": -0.5,
+                   "slo": {"high:ttft_p95": {
+                       "alerting": True, "alerts": 1,
+                       "burn_fast": 2.0, "burn_slow": 1.5,
+                       "budget_remaining": -0.5}},
+                   "replicas": {"r0": {"health": "up", "epoch": 0,
+                                       "load": 0, "inflight": 0,
+                                       "done": 9, "reason": "",
+                                       "stale_s": 0.1}}}, f)
+    records = [
+        {"event": "fleet_summary", "requests": 10, "requests_done": 9,
+         "requests_shed": 1, "redispatches": 1, "deaths": 1,
+         "tokens_per_sec": 55.0, "ttft_ms_p95_high": 12.5},
+        {"event": "fleet_slo_alert", "target": "high:ttft_p95",
+         "burn_fast": 2.0, "burn_slow": 1.5, "budget_remaining": -0.5,
+         "t_s": 4.0},
+        {"event": "fleet_slo_ok", "target": "high:ttft_p95",
+         "burn_fast": 0.1, "burn_slow": 0.9, "budget_remaining": 0.2,
+         "t_s": 8.0},
+        {"event": "fleet_replica", "replica": "r1", "state": "dead",
+         "t_s": 3.0},
+        {"event": "fleet_decomp", "rid": 0, "e2e_ms": 100.0,
+         "router_queue_ms": 5.0, "inbox_lag_ms": 10.0,
+         "replica_queue_ms": 2.0, "prefill_ms": 8.0,
+         "decode_ms": 60.0, "absorb_ms": 13.5, "residual_ms": 1.5},
+        {"event": "fleet_snapshot", "t_s": 9.5},
+    ]
+    with open(os.path.join(d, "fleet.jsonl"), "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+    with open(os.path.join(d, "fleet_trace.json"), "w") as f:
+        json.dump({"traceEvents": [
+            {"ph": "M", "name": "process_name", "pid": 0, "tid": 0,
+             "args": {"name": "fleet:router"}},
+            {"ph": "M", "name": "process_name", "pid": 1, "tid": 0,
+             "args": {"name": "fleet:r0/e0"}},
+            _b("request", 0, 0.0, cat="fleet"),
+            _e("request", 0, 1000.0, cat="fleet",
+               process_death=True),
+        ]}, f)
+    return d, records
+
+
+def test_fleetview_renders_all_sections(tmp_path):
+    from tensorflow_distributed_tpu.observe import fleetview
+    d, _ = _seed_fleet_dir(tmp_path)
+    view = fleetview.render(d)
+    assert "fleet observatory" in view
+    assert "ALERTING" in view
+    assert "high: p95=12.5ms" in view
+    assert "1 alert(s), 1 all-clear(s)" in view
+    assert "incident t=3s r1: dead" in view
+    assert "absorb 13.5" in view
+    assert "stitched trace" in view and "balanced" in view
+    assert "1 span(s) closed at process death" in view
+    assert "fleet:r0/e0" in view
+    # Empty dir: every section degrades, none crashes.
+    empty = str(tmp_path / "empty")
+    os.makedirs(empty)
+    view2 = fleetview.render(empty)
+    assert "(no snapshot" in view2 and "(no fleet.jsonl)" in view2
+    assert "(no fleet_trace.json" in view2
+
+
+def test_fleetview_cli_main(tmp_path, capsys):
+    from tensorflow_distributed_tpu.observe import fleetview
+    d, _ = _seed_fleet_dir(tmp_path)
+    assert fleetview.main([d]) == 0
+    assert "fleet observatory" in capsys.readouterr().out
+    assert fleetview.main([str(tmp_path / "nope")]) == 2
+
+
+def test_report_folds_decomposition_and_fleet_slo(tmp_path):
+    from tensorflow_distributed_tpu.observe.report import (
+        render, summarize)
+    _, records = _seed_fleet_dir(tmp_path)
+    out = summarize(records)
+    fl = out["fleet"]
+    dec = fl["decomposition"]
+    assert dec["requests"] == 1
+    assert dec["absorb_ms_mean"] == pytest.approx(13.5)
+    assert dec["residual_frac_mean"] == pytest.approx(0.015)
+    assert fl["slo"]["alerts"] == 1
+    assert fl["slo"]["budget_remaining_min"] == pytest.approx(-0.5)
+    assert fl["snapshots"] == 1
+    text = render(out)
+    assert "absorb 13.5" in text and "frac=0.015" in text
+
+
+# --- the real thing (slow) -----------------------------------------------
+
+@pytest.mark.slow
+def test_fleet_obs_e2e_sigkill_merged_trace_balanced(tmp_path):
+    """Real 2-replica fleet with the full observatory armed, SIGKILL
+    one replica mid-stream: the stitched trace is balanced with the
+    dead leg closed at process death, the decomposition covers every
+    request, and the exported snapshot agrees with the report."""
+    import subprocess
+    import sys as _sys
+
+    import numpy as np
+
+    from tensorflow_distributed_tpu.fleet.controller import (
+        ControllerConfig as CC)
+    from tensorflow_distributed_tpu.fleet.router import (
+        RouterConfig as RC)
+    from tensorflow_distributed_tpu.fleet.run import (
+        FleetObsConfig, load_workload, run_fleet)
+
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYTHONUNBUFFERED"] = "1"
+    ckpt = str(tmp_path / "ckpt")
+    common = ["--model", "gpt_lm", "--model-size", "tiny",
+              "--seq-len", "48", "--seed", "0",
+              "--compute-dtype", "float32"]
+    subprocess.run(
+        [_sys.executable, "-m", "tensorflow_distributed_tpu.cli",
+         *common, "--dataset", "synthetic", "--train-steps", "2",
+         "--batch-size", "8", "--eval-every", "0", "--log-every",
+         "0", "--checkpoint-dir", ckpt, "--checkpoint-every", "2"],
+        env=env, check=True, capture_output=True, timeout=300)
+    wl = str(tmp_path / "wl.jsonl")
+    rng = np.random.default_rng(0)
+    with open(wl, "w") as f:
+        for i in range(8):
+            plen = int(rng.integers(4, 12))
+            f.write(json.dumps({
+                "prompt": [int(t) for t in rng.integers(0, 64, plen)],
+                "max_new_tokens": 24,
+                "arrival_s": round(0.15 * i, 3)}) + "\n")
+
+    def arm_kill(ctl, router):
+        import threading
+        import time as time_mod
+
+        def hunt():
+            t_end = time_mod.monotonic() + 30
+            while time_mod.monotonic() < t_end:
+                h = ctl.members["r1"].handle
+                jr = h.read_journal(epoch=h.epoch)
+                if any(not e.get("done")
+                       and 1 <= len(e.get("tokens", ())) <= 12
+                       for e in jr.values()):
+                    break
+                time_mod.sleep(0.01)
+            ctl.kill("r1")
+        threading.Thread(target=hunt, daemon=True).start()
+
+    fleet_dir = str(tmp_path / "fleet")
+    snap_path = os.path.join(fleet_dir, "fleet_snapshot.json")
+    summary = run_fleet(
+        fleet_dir=fleet_dir, replicas=2,
+        base_args=["--mode", "serve", *common,
+                   "--checkpoint-dir", ckpt,
+                   "--serve.num-slots", "2",
+                   "--serve.buckets", "48"],
+        workload=load_workload(wl), ckpt_dir=ckpt, env=env,
+        actions=[(0.2, arm_kill)],
+        router_cfg=RC(dispatch_timeout_s=60.0),
+        controller_cfg=CC(backoff_base_s=0.25),
+        timeout_s=300.0, poll_s=0.02,
+        jsonl=os.path.join(fleet_dir, "fleet.jsonl"),
+        obs=FleetObsConfig(trace=True, slo="ttft_p95=30s",
+                           export_path=snap_path,
+                           export_every=0.5))
+    assert summary["requests_lost"] == 0
+    assert summary["requests_done"] == 8
+    assert summary["deaths"] == 1
+    # The tentpole artifact: ONE merged, balanced timeline.
+    assert summary["stitch_balanced"]
+    assert summary["stitch_sources"] >= 3    # router + r1 e0 + ...
+    assert summary["stitch_closed_at_death"] >= 1
+    merged = load_trace(os.path.join(fleet_dir, "fleet_trace.json"))
+    assert not unbalanced_async(merged)
+    assert any((e.get("args") or {}).get("process_death")
+               for e in merged if e.get("ph") == "e")
+    # Decomposition covered every request.
+    assert summary["decomp_requests"] == 8
+    # The control-plane snapshot parses and agrees with the report.
+    with open(snap_path) as f:
+        snap = json.load(f)
+    from tensorflow_distributed_tpu.observe.report import (
+        load_records, summarize)
+    rep = summarize(load_records(
+        os.path.join(fleet_dir, "fleet.jsonl")))["fleet"]
+    keys = [k for k in snap if k.startswith(("ttft_ms_p95_",
+                                             "ttft_ms_p50_"))]
+    assert keys
+    for k in keys:
+        assert snap[k] == rep[k], k
