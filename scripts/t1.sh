@@ -217,6 +217,40 @@ if [ "$serve_rc" -ne 0 ]; then
        "$SERVELOG" >&2
 fi
 
+# Servebench TP smoke (tensor-parallel replica: --serve.mesh-model 2
+# over the 8 virtual CPU devices — benchmarks/servebench.py --phases
+# tp). Separate run from the spec/int8/slo smoke above so its timing
+# envelope is untouched. Gates are pure CORRECTNESS plus the cache
+# arithmetic: per-device cache bytes/slot ratio >= 1.9 (exact head
+# sharding gives 2.0) and token identity of the model=2 engine vs the
+# model=1 engine across dense, int8-KV and speculative configs. The
+# per-step collective schedule itself is pinned by the
+# serve_decode_tp/serve_verify_tp census goldens in scripts/lint.sh.
+# Same abort-guard shape as the smokes above: a run that dies to the
+# known container XLA:CPU abort prints no serve_checks line and is
+# retried once; a genuine gate failure prints one and is NOT retried.
+TPLOG="${TPLOG:-/tmp/_t1_serve_tp.log}"
+run_servebench_tp() {
+  rm -f "$TPLOG"
+  timeout -k 10 300 env JAX_PLATFORMS=cpu python -m \
+    tensorflow_distributed_tpu.benchmarks.servebench \
+    --phases tp --requests 6 --new-tokens 16 --out "" \
+    2>&1 | tee "$TPLOG"
+  return "${PIPESTATUS[0]}"
+}
+run_servebench_tp
+tp_rc=$?
+if ! grep -qa '"metric": "serve_checks"' "$TPLOG"; then
+  echo "[t1] no serve_checks line in $TPLOG (known container" \
+       "XLA:CPU abort) — rerunning servebench tp once" >&2
+  run_servebench_tp
+  tp_rc=$?
+fi
+if [ "$tp_rc" -ne 0 ]; then
+  echo "[t1] servebench tp smoke FAILED (tp_rc=$tp_rc) — see" \
+       "$TPLOG" >&2
+fi
+
 # Slobench smoke (serve observatory: per-request trace validity +
 # span balance across a SIGKILL restart, burn-rate alert fires on the
 # over-capacity burst and stays quiet on the clean control, snapshot
@@ -422,6 +456,9 @@ if [ "$rc" -eq 0 ] && [ "$gradsync_rc" -ne 0 ]; then
 fi
 if [ "$rc" -eq 0 ] && [ "$serve_rc" -ne 0 ]; then
   exit "$serve_rc"
+fi
+if [ "$rc" -eq 0 ] && [ "$tp_rc" -ne 0 ]; then
+  exit "$tp_rc"
 fi
 if [ "$rc" -eq 0 ] && [ "$slo_rc" -ne 0 ]; then
   exit "$slo_rc"

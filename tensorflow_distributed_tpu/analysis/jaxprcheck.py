@@ -129,17 +129,17 @@ def _clm_batch():
     return ds.batch(np.arange(_B))
 
 
-def _mesh(data: int = 1, pipe: int = 1):
+def _mesh(data: int = 1, pipe: int = 1, model: int = 1):
     from tensorflow_distributed_tpu.config import MeshConfig
     from tensorflow_distributed_tpu.parallel.mesh import make_mesh
-    need = data * pipe
+    need = data * pipe * model
     devs = jax.devices()[:need]
     if len(devs) < need:
         raise RuntimeError(
             f"census needs {need} devices, have {len(devs)} — run via "
             f"the CLI (it forces an 8-device CPU topology) or under "
             f"tests/conftest.py")
-    return make_mesh(MeshConfig(data=data, pipe=pipe), devs)
+    return make_mesh(MeshConfig(data=data, pipe=pipe, model=model), devs)
 
 
 def _train_jaxpr(model_name: str, health_every: int = 0,
@@ -419,6 +419,103 @@ def _serve_prefill_paged_jaxpr():
         jnp.asarray(1, jnp.int32))
 
 
+# --- tensor-parallel serve censuses ------------------------------------
+#
+# GSPMD inserts the TP collectives during PARTITIONING, after the jaxpr
+# — jax.make_jaxpr sees none of them, so the TP entries census the
+# COMPILED HLO text instead (the same artifact the AOT planner costs).
+# The op names below are HLO's, not jaxpr primitives; the "-start"
+# variants catch an async split, which counts the same program once.
+
+HLO_COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",
+                   "collective-permute", "all-to-all")
+
+_SERVE_TP = 2  # the model-axis width the TP censuses pin
+
+
+def _hlo_collectives(hlo: str) -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for op in HLO_COLLECTIVES:
+        n = hlo.count(f" {op}(") + hlo.count(f" {op}-start(")
+        if n:
+            counts[op] = n
+    return dict(sorted(counts.items()))
+
+
+def _serve_tp_model(kv_cache_quant: str = "none"):
+    """The tiny bf16 causal LM over a [data=1, model=2] mesh — the
+    layout ``--serve.mesh-model 2`` builds (serve/run.py): params
+    placed via the partition metadata (heads/MLP width sharded over
+    "model"), slot cache head-sharded by serve.engine.shard_cache."""
+    import flax.linen as nn
+
+    from tensorflow_distributed_tpu.models import transformer
+    from tensorflow_distributed_tpu.parallel.sharding import (
+        param_sharding)
+    from tensorflow_distributed_tpu.serve.engine import zero_cache
+
+    num_slots = 4
+    mesh = _mesh(model=_SERVE_TP)
+    model = transformer.gpt_lm(mesh, size="tiny",
+                               compute_dtype=jnp.bfloat16,
+                               kv_cache_quant=kv_cache_quant)
+    sample = jnp.zeros((1, 8), jnp.int32)
+    abstract = jax.eval_shape(lambda k: model.init(k, sample),
+                              jax.random.key(0))
+    variables = jax.jit(
+        lambda k: nn.meta.unbox(model.init(k, sample)),
+        out_shardings=param_sharding(mesh, abstract))(jax.random.key(0))
+    params = variables["params"]
+    cache = zero_cache(model, params, num_slots)
+    return model, params, cache, num_slots
+
+
+def _serve_decode_tp_census(kv_cache_quant: str = "none"):
+    """THE tensor-parallel decode step: the golden pins the per-step
+    collective schedule (attention out-proj + MLP down-proj psums and
+    the logits gather land as all-reduce/all-gather here) — NONZERO by
+    construction, and a count jump means a program change re-gathers
+    the sharded cache or activations every token."""
+    from tensorflow_distributed_tpu.models.generate import decode_token
+
+    model, params, cache, num_slots = _serve_tp_model(kv_cache_quant)
+
+    def run(params, cache, tok, pos):
+        last, cache = decode_token(model, params, cache, tok, pos)
+        ok = jnp.isfinite(last).all(axis=-1)
+        return (cache, jnp.argmax(last, axis=-1).astype(jnp.int32),
+                ok)
+
+    args = (params, cache, jnp.zeros((num_slots,), jnp.int32),
+            jnp.zeros((num_slots,), jnp.int32))
+    hlo = jax.jit(run).lower(*args).compile().as_text()
+    return {"collectives": _hlo_collectives(hlo),
+            "upcasts": census_of(jax.make_jaxpr(run)(*args))["upcasts"]}
+
+
+def _serve_verify_tp_census():
+    """THE tensor-parallel speculative verify — same sharded attend
+    over k + 1 positions; its collective schedule must match the
+    decode step's shape (per-dispatch, not per-token)."""
+    model, params, cache, num_slots = _serve_tp_model()
+    k = _VERIFY_K
+
+    def run(params, cache, toks, pos):
+        positions = pos[:, None] + jnp.arange(k + 1)[None, :]
+        logits, state = model.apply(
+            {"params": params, "cache": cache}, toks, decode=True,
+            positions=positions, mutable=["cache"])
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        ok = jnp.isfinite(logits).all(axis=(-1, -2))
+        return state["cache"], nxt, ok
+
+    args = (params, cache, jnp.zeros((num_slots, k + 1), jnp.int32),
+            jnp.zeros((num_slots,), jnp.int32))
+    hlo = jax.jit(run).lower(*args).compile().as_text()
+    return {"collectives": _hlo_collectives(hlo),
+            "upcasts": census_of(jax.make_jaxpr(run)(*args))["upcasts"]}
+
+
 PROGRAMS = {
     "gpt_train": lambda: _train_jaxpr("gpt_lm"),
     "moe_train": lambda: _train_jaxpr("moe_lm"),
@@ -448,6 +545,12 @@ PROGRAMS = {
     "serve_decode_paged": _serve_decode_paged_jaxpr,
     "serve_verify_paged": _serve_verify_paged_jaxpr,
     "serve_prefill_paged": _serve_prefill_paged_jaxpr,
+    # Tensor-parallel serving (--serve.mesh-model 2): censused from
+    # the compiled HLO (GSPMD inserts these collectives after the
+    # jaxpr) — the ONLY entries whose collective budget is NONZERO,
+    # pinning the per-step schedule the sharded replica pays.
+    "serve_decode_tp": _serve_decode_tp_census,
+    "serve_verify_tp": _serve_verify_tp_census,
 }
 
 
@@ -458,7 +561,12 @@ def census(programs: Optional[Sequence[str]] = None
     names = list(programs) if programs else sorted(PROGRAMS)
     out = {}
     for name in names:
-        out[name] = census_of(PROGRAMS[name]())
+        result = PROGRAMS[name]()
+        # TP entries return a READY census (collectives counted from
+        # compiled HLO — a jaxpr walk cannot see GSPMD's insertions);
+        # everything else returns a jaxpr to walk here.
+        out[name] = (result if isinstance(result, dict)
+                     else census_of(result))
     return out
 
 

@@ -22,13 +22,26 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 MESH_AXES = ("data", "pipe", "seq", "model", "expert")
 
 #: planner family -> the model registry name the train CLI uses.
+#: "serve" plans the gpt family's DECODE program over the serve
+#: replica's own tensor-parallel mesh (--serve.mesh-model), not a
+#: train step — enumerate_candidates and the scorer branch on it.
 FAMILY_MODELS = {"gpt": "gpt_lm", "moe": "moe_lm",
-                 "pipelined": "pipelined_lm"}
-MODEL_FAMILIES = {v: k for k, v in FAMILY_MODELS.items()}
+                 "pipelined": "pipelined_lm", "serve": "gpt_lm"}
+#: registry name -> TRAIN family (serve excluded: gpt_lm's inverse is
+#: the train family; serve is an explicit planner choice, never an
+#: inference from a model name).
+MODEL_FAMILIES = {v: k for k, v in FAMILY_MODELS.items()
+                  if k != "serve"}
 
 #: the factory-default size per family (models/transformer.py
 #: gpt_lm(size="small"), moe_lm(size="tiny"), pipelined_lm("tiny")).
-DEFAULT_SIZES = {"gpt": "small", "moe": "tiny", "pipelined": "tiny"}
+DEFAULT_SIZES = {"gpt": "small", "moe": "tiny", "pipelined": "tiny",
+                 "serve": "small"}
+
+#: the TP widths the serve family enumerates (ISSUE: rank
+#: --serve.mesh-model without executing; width 1 is the single-device
+#: engine the others are ranked against).
+SERVE_TP_WIDTHS = (1, 2, 4)
 
 #: Partition-like strategy choices. "overlap" = zero1 slot sharding +
 #: the explicit bucketed reduce-scatter/all-gather grad sync
@@ -57,6 +70,9 @@ class ModelFacts:
     n_heads: int
     n_layers: int
     n_experts: int = 0          # 0 = dense (no expert axis)
+    vocab_size: int = 0         # factory base vocab; 0 = unknown
+    #                             (only the serve family prunes on it:
+    #                             the TP head is vocab-parallel)
 
     def validate(self) -> None:
         if self.family not in FAMILY_MODELS:
@@ -84,6 +100,7 @@ def model_facts(family: str, size: str = "",
     if size == "tiny":
         tiny = tiny_config()
         heads, layers = tiny.n_heads, tiny.n_layers
+        vocab = tiny.vocab_size
         if family == "pipelined":
             # pipelined_lm bumps tiny's layer count so common stage
             # counts divide it — the same constant the factory uses.
@@ -91,15 +108,18 @@ def model_facts(family: str, size: str = "",
                 PIPELINED_TINY_LAYERS)
             layers = PIPELINED_TINY_LAYERS
     elif size in GPT2_SIZES:
+        from tensorflow_distributed_tpu.models.transformer import (
+            gpt2_small_config)
         heads = GPT2_SIZES[size]["n_heads"]
         layers = GPT2_SIZES[size]["n_layers"]
+        vocab = gpt2_small_config().vocab_size
     else:
         raise ValueError(f"unknown size {size!r}; have "
                          f"(tiny, {', '.join(GPT2_SIZES)})")
     experts = ((moe_experts or MOE_DEFAULT_EXPERTS)
                if family == "moe" else 0)
     return ModelFacts(family=family, n_heads=heads, n_layers=layers,
-                      n_experts=experts)
+                      n_experts=experts, vocab_size=vocab)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -110,13 +130,16 @@ class Candidate:
     axes: Tuple[Tuple[str, int], ...]   # hashable (axis, size) pairs
     partition: str = "replicated"       # replicated | fsdp | zero1
     microbatches: int = 0               # pipelined only (0 = n/a)
+    serve: bool = False                 # serve family: the mesh is the
+    #                                     ENGINE's (--serve.mesh-model),
+    #                                     not the train --mesh.*
 
     @staticmethod
     def make(axes: Dict[str, int], partition: str = "replicated",
-             microbatches: int = 0) -> "Candidate":
+             microbatches: int = 0, serve: bool = False) -> "Candidate":
         full = {a: int(axes.get(a, 1)) for a in MESH_AXES}
         return Candidate(axes=tuple(full.items()), partition=partition,
-                         microbatches=microbatches)
+                         microbatches=microbatches, serve=serve)
 
     @property
     def mesh(self) -> Dict[str, int]:
@@ -142,6 +165,11 @@ class Candidate:
 
     def cli_args(self) -> List[str]:
         """The train-CLI flags that launch this candidate."""
+        if self.serve:
+            # The serve engine builds its OWN mesh from this one knob
+            # (serve/run.py validates heads/devices at launch); the
+            # train --mesh.* flags are rejected under mode=serve.
+            return ["--serve.mesh-model", str(self.mesh["model"])]
         out: List[str] = []
         for axis, size in self.axes:
             out += [f"--mesh.{axis}", str(size)]
@@ -244,6 +272,43 @@ def enumerate_candidates(
     allowed = set(strategies) if strategies else None
     feasible: List[Candidate] = []
     pruned: List[Pruned] = []
+    if facts.family == "serve":
+        # The serve replica's OWN mesh: always [data=1, model=N] — the
+        # engine serves one replica; data-scaling is the fleet
+        # router's job, not this mesh's. ``batch`` is the slot count
+        # (replicated), so the mesh rules' batch-divisibility checks
+        # don't apply; what does: devices and head divisibility.
+        for width in SERVE_TP_WIDTHS:
+            cand = Candidate.make({"data": 1, "model": width},
+                                  serve=True)
+            if width > devices:
+                pruned.append(Pruned(cand, (
+                    f"model={width} needs {width} devices, have "
+                    f"{devices}")))
+                continue
+            if width > 1 and facts.n_heads % width:
+                pruned.append(Pruned(cand, (
+                    f"n_heads {facts.n_heads} not divisible by model "
+                    f"axis {width} (heads shard over 'model')")))
+                continue
+            if width > 1 and facts.vocab_size % width:
+                # The TP LM head is vocab-parallel (column-split over
+                # "model"); an odd vocab like GPT-2's 50257 only
+                # shards padded (--shard-vocab), which the serve
+                # scorer does not model — prune, don't error-row.
+                pruned.append(Pruned(cand, (
+                    f"vocab {facts.vocab_size} not divisible by model "
+                    f"axis {width} (the LM head is vocab-parallel; "
+                    f"--shard-vocab pads it)")))
+                continue
+            if allowed is not None and not (
+                    set(cand.strategy.split("+")) <= allowed):
+                pruned.append(Pruned(cand, (
+                    f"strategy {cand.strategy!r} excluded by "
+                    f"--strategies")))
+                continue
+            feasible.append(cand)
+        return feasible, pruned
     second_axes = _second_axes(facts)
     for second in second_axes:
         for k in range(1, devices + 1):

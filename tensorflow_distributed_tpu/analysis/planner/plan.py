@@ -261,7 +261,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         "visible; on CPU forces that many virtual "
                         "devices)")
     parser.add_argument("--batch-size", type=int, default=128,
-                        help="global batch the plan must divide")
+                        help="global batch the plan must divide "
+                        "(serve family: the decode slot count)")
     parser.add_argument("--size", default="",
                         help="family size preset (tiny or the GPT-2 "
                         "ladder; default: the family's factory "

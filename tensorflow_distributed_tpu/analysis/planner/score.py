@@ -289,6 +289,14 @@ def build_candidate_step(cand: Candidate, facts: ModelFacts,
     mesh = make_mesh(MeshConfig(**axes), devs[:n])
     size = size or DEFAULT_SIZES[facts.family]
     dtype = jnp.bfloat16 if compute_dtype == "bfloat16" else jnp.float32
+    if facts.family == "serve":
+        if not abstract:
+            raise ValueError(
+                "serve candidates score abstractly: the decode "
+                "program is ranked by AOT costs, never executed by "
+                "the planner (launch the pick via its cli_args)")
+        return _build_serve_decode(cand, mesh, batch, seq_len, size,
+                                   dtype)
     sample = np.zeros((2, seq_len), np.int32)
     kw: Dict[str, Any] = dict(dropout_rate=dropout_rate,
                               compute_dtype=dtype, max_len=seq_len)
@@ -350,6 +358,69 @@ def build_candidate_step(cand: Candidate, facts: ModelFacts,
             np.int32 if k != "mask" else np.float32, sharding=sh[k])
         for k in ("tokens", "targets", "mask")}
     return step, state, abatch, mesh
+
+
+def _build_serve_decode(cand: Candidate, mesh, num_slots: int,
+                        max_len: int, size: str, dtype):
+    """(decode step, abstract (params, cache), abstract (tok, pos),
+    mesh) for one serve-family candidate — THE program
+    serve/engine.py dispatches every token, over the layout
+    --serve.mesh-model would build: params placed by the partition
+    metadata, the slot cache's head axis (dim 2 of every >= 3-d leaf,
+    serve.engine.shard_cache's rule) sharded over "model". Everything
+    is ShapeDtypeStructs: candidates rank by compiled AOT costs with
+    zero bytes allocated. ``batch`` arrives as the SLOT count (decode
+    batch == slots), ``seq_len`` as the cache depth."""
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from tensorflow_distributed_tpu.models import transformer
+    from tensorflow_distributed_tpu.models.generate import decode_token
+    from tensorflow_distributed_tpu.parallel.sharding import (
+        param_sharding)
+
+    model = transformer.gpt_lm(mesh, size=size, dropout_rate=0.0,
+                               compute_dtype=dtype, max_len=max_len)
+    abstract_vars = jax.eval_shape(
+        lambda k: model.init(k, jnp.zeros((1, 8), jnp.int32)),
+        jax.random.key(0))
+    aparams = jax.tree_util.tree_map(
+        lambda leaf, sd: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
+                                              sharding=sd),
+        nn.meta.unbox(abstract_vars)["params"],
+        param_sharding(mesh, abstract_vars)["params"])
+    tp = dict(mesh.shape).get("model", 1)
+    tok = jnp.zeros((num_slots, 1), jnp.int32)
+    cache_shapes = jax.eval_shape(
+        lambda p, t, q: model.apply({"params": p}, t, decode=True,
+                                    positions=q,
+                                    mutable=["cache"])[1]["cache"],
+        aparams, tok, tok)
+
+    def cache_sds(leaf):
+        spec = (PartitionSpec(None, None, "model")
+                if tp > 1 and leaf.ndim >= 3 else PartitionSpec())
+        return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
+                                    sharding=NamedSharding(mesh, spec))
+
+    acache = jax.tree_util.tree_map(cache_sds, cache_shapes)
+
+    def step(state, batch):
+        params, cache = state
+        tok, pos = batch
+        last, cache = decode_token(model, params, cache, tok, pos)
+        ok = jnp.isfinite(last).all(axis=-1)
+        return (cache, jnp.argmax(last, axis=-1).astype(jnp.int32),
+                ok)
+
+    rep = NamedSharding(mesh, PartitionSpec())
+    slots = jax.ShapeDtypeStruct((num_slots,), np.int32, sharding=rep)
+    # jit like the train-step builders do — aot_lower_compile wants a
+    # lowerable callable.
+    return jax.jit(step), (aparams, acache), (slots, slots), mesh
 
 
 def score_candidate(cand: Candidate, facts: ModelFacts, batch: int,

@@ -33,6 +33,16 @@ Four phases, each gated, one committed ``SERVEBENCH.json``:
   policy="slo"; gate: the high class's p95 TTFT under SLO <=
   --max-slo-ratio x FIFO's. The artifact's ``p95_ttft_under_load``
   is the SLO run's high-class p95.
+- **tp** — tensor-parallel serving (``--serve.mesh-model``): the SAME
+  engine built over a [data=1, model=2] mesh — params and the slot
+  cache's head axis sharded over "model". Gates: token identity vs
+  the model=1 engine on the same seeded workload for a dense, an
+  int8, and a SPECULATIVE config (greedy determinism must survive
+  GSPMD's psums), and the per-device cache-bytes ratio
+  (model=1 / model=2, the engine's own ``cache_bytes_per_slot``)
+  >= --min-tp-ratio. The per-step collective schedule itself is
+  pinned by the ``serve_decode_tp``/``serve_verify_tp`` census
+  goldens (analysis/jaxprcheck.py).
 
 ``--phases`` subsets for the t1 smoke; ``--no-check`` reports without
 gating. --out writes SERVEBENCH.json (overwritten per run, like the
@@ -138,9 +148,9 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--size", default="tiny",
                         help="gpt_lm size preset for the base phase")
-    parser.add_argument("--phases", default="base,spec,int8,slo",
+    parser.add_argument("--phases", default="base,spec,int8,slo,tp",
                         help="comma-separated subset of "
-                             "base,spec,int8,slo")
+                             "base,spec,int8,slo,tp")
     parser.add_argument("--requests", type=int, default=16)
     parser.add_argument("--num-slots", type=int, default=4)
     parser.add_argument("--prompt-len-min", type=int, default=4)
@@ -165,6 +175,10 @@ def main(argv=None) -> int:
                              "fraction, int8 vs bf16 greedy")
     parser.add_argument("--max-slo-ratio", type=float, default=0.5)
     parser.add_argument("--slo-requests", type=int, default=24)
+    parser.add_argument("--min-tp-ratio", type=float, default=1.9,
+                        help="required model=1 / model=2 per-device "
+                             "cache-bytes ratio (exact head-sharding "
+                             "gives 2.0; headroom for rounding)")
     parser.add_argument("--no-check", action="store_true",
                         help="report without gating on the checks")
     parser.add_argument("--out", default="SERVEBENCH.json")
@@ -172,9 +186,17 @@ def main(argv=None) -> int:
     if args.requests < 1 or args.num_slots < 1:
         parser.error("--requests and --num-slots must be >= 1")
     phases = [p.strip() for p in args.phases.split(",") if p.strip()]
-    unknown = set(phases) - {"base", "spec", "int8", "slo"}
+    unknown = set(phases) - {"base", "spec", "int8", "slo", "tp"}
     if unknown:
         parser.error(f"unknown phases {sorted(unknown)}")
+    if "tp" in phases:
+        # The TP A/B needs >= 2 devices: same virtual-CPU topology
+        # discipline as analysis/jaxprcheck (the flags must land
+        # before the backend is first USED; a no-op when the caller
+        # already forced them, e.g. under tests/conftest.py).
+        from tensorflow_distributed_tpu.analysis.jaxprcheck import (
+            _force_cpu_topology)
+        _force_cpu_topology()
 
     import jax
     import numpy as np
@@ -474,6 +496,99 @@ def main(argv=None) -> int:
             p95_ttft_under_load=round(slo_p95, 2),
             slo_token_identical=int(slo_ident), slo_of=n)
 
+    # --- tp: tensor-parallel replica A/B vs the model=1 engine ----------
+    if "tp" in phases:
+        import flax.linen as nn
+
+        from tensorflow_distributed_tpu.config import MeshConfig
+        from tensorflow_distributed_tpu.parallel.mesh import make_mesh
+        from tensorflow_distributed_tpu.parallel.sharding import (
+            param_sharding)
+
+        tp_width = 2
+        if len(jax.devices()) < tp_width:
+            raise RuntimeError(
+                f"tp phase needs {tp_width} devices, have "
+                f"{len(jax.devices())}")
+        mesh_tp = make_mesh(MeshConfig(data=1, model=tp_width),
+                            jax.devices()[:tp_width])
+        tp_lens = rng.integers(args.prompt_len_min,
+                               args.prompt_len_max + 1,
+                               size=args.requests)
+        tp_buckets = default_buckets(int(tp_lens.max()))
+        # Verify headroom for the speculative config rides max_len.
+        tp_max_len = max(tp_buckets) + args.new_tokens \
+            + args.spec_tokens
+        # tiny (4 heads) — the tuned bigram model is 1-head by design
+        # (its int8 grain) and cannot shard; heads must divide tp.
+        tp_kw = dict(size="tiny", max_len=tp_max_len, dropout_rate=0.0,
+                     compute_dtype=jnp.bfloat16)
+
+        def tp_place(model_tp, params):
+            """The model=1 weights, placed into the TP layout derived
+            from the TP model's own partition metadata — both engines
+            serve IDENTICAL values, so every output mismatch is the
+            sharded program's fault."""
+            abstract = jax.eval_shape(
+                lambda k: model_tp.init(k, jnp.zeros((1, 8), jnp.int32)),
+                jax.random.key(0))
+            return jax.device_put(
+                params, param_sharding(mesh_tp, abstract)["params"])
+
+        ident = {}
+        for quant in ("none", "int8"):
+            m1 = gpt_lm(None, kv_cache_quant=quant, **tp_kw)
+            m2 = gpt_lm(mesh_tp, kv_cache_quant=quant, **tp_kw)
+            if quant == "none":
+                params_1 = nn.meta.unbox(m1.init(
+                    jax.random.key(args.seed),
+                    jnp.zeros((1, 8), jnp.int32)))["params"]
+                prompts_tp = [
+                    rng.integers(0, m1.cfg.vocab_size,
+                                 size=int(n)).astype(np.int32)
+                    for n in tp_lens]
+            params_2 = tp_place(m2, params_1)
+            done_1, _, _, eng_1 = _serve(
+                m1, params_1, prompts_tp, args.new_tokens,
+                args.num_slots, tp_buckets, args.decode_priority)
+            done_2, _, _, eng_2 = _serve(
+                m2, params_2, prompts_tp, args.new_tokens,
+                args.num_slots, tp_buckets, args.decode_priority)
+            ident[quant] = sum(done_1[i].tokens == done_2[i].tokens
+                               for i in range(args.requests))
+            if quant == "none":
+                bps_1 = eng_1.cache_bytes_per_slot()
+                bps_2 = eng_2.cache_bytes_per_slot()
+                done_base = done_1
+        # Speculative config on the TP mesh vs the model=1 PLAIN run:
+        # greedy determinism must hold across BOTH the verify program
+        # and the sharding at once.
+        m2s = gpt_lm(mesh_tp, **tp_kw)
+        done_2s, sum_2s, _, _ = _serve(
+            m2s, tp_place(m2s, params_1), prompts_tp, args.new_tokens,
+            args.num_slots, tp_buckets, args.decode_priority,
+            spec_tokens=args.spec_tokens)
+        ident["spec"] = sum(done_base[i].tokens == done_2s[i].tokens
+                            for i in range(args.requests))
+        tp_ratio = bps_1 / max(bps_2, 1)
+        lines += [
+            {"metric": "serve_tp_cache_bytes_per_slot",
+             "model1": int(bps_1), "model2": int(bps_2),
+             "ratio": round(tp_ratio, 3), "tp": tp_width,
+             "unit": "bytes/device"},
+            {"metric": "serve_tp_identity",
+             "dense": int(ident["none"]), "int8": int(ident["int8"]),
+             "spec": int(ident["spec"]), "of": args.requests,
+             "tp": tp_width,
+             "spec_verify_steps": sum_2s.get("verify_steps")},
+        ]
+        checks.update(
+            tp_cache_ratio=round(tp_ratio, 3),
+            tp_cache_ratio_ok=bool(tp_ratio >= args.min_tp_ratio),
+            min_tp_ratio=args.min_tp_ratio,
+            tp_token_identical=int(sum(ident.values())),
+            tp_of=3 * args.requests)
+
     lines.append(checks)
     common = {"device": dev.device_kind, "phases": ",".join(phases),
               "seed": args.seed}
@@ -489,13 +604,15 @@ def main(argv=None) -> int:
     gate_keys = [k for k in ("speedup_ok", "prefill_programs_ok",
                              "bigram_memorized", "spec_ok",
                              "int8_slots_ok", "int8_divergence_ok",
-                             "slo_ok") if k in checks]
+                             "slo_ok", "tp_cache_ratio_ok")
+                 if k in checks]
     identity_ok = all((
         checks.get("token_identical", 0) == checks.get("of", 0),
         checks.get("spec_token_identical", 0) == checks.get("spec_of",
                                                             0),
         checks.get("slo_token_identical", 0) == checks.get("slo_of",
-                                                           0)))
+                                                           0),
+        checks.get("tp_token_identical", 0) == checks.get("tp_of", 0)))
     if not args.no_check and not (
             all(checks[k] for k in gate_keys) and identity_ok):
         print(f"servebench: checks FAILED: {checks}", file=sys.stderr)

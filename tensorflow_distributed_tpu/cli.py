@@ -31,6 +31,17 @@ Examples:
         --serve.policy slo --serve.slo-mix "high:0.25,batch:0.25" \
         --serve.tenants 4 --serve.tenant-quota 512
 
+    # tensor-parallel serving (README "Tensor-parallel serving"): the
+    # replica itself sharded over a model=2 mesh — params AND every
+    # slot-cache leaf head-sharded, per-device cache bytes / 2,
+    # token-identical to the single-device engine; composes with the
+    # spec/int8/paged flags above ("--family serve" on the planner
+    # ranks the widths without executing)
+    # (odd vocabs like GPT-2's 50257 need --shard-vocab true to pad)
+    python -m tensorflow_distributed_tpu.cli --mode serve \
+        --model gpt_lm --model-size tiny --serve.mesh-model 2 \
+        --serve.num-slots 8 --serve.num-requests 32
+
     # paged KV + radix prefix reuse (serve/paging; README "Paged KV
     # + prefix reuse"): shared system prompts / few-shot headers /
     # multi-turn sessions attach cached pages instead of

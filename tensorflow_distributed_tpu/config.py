@@ -402,6 +402,21 @@ class ServeConfig:
     # Synthetic-workload tenant count (requests assigned round-robin);
     # request files carry their own "tenant" field.
     tenants: int = 1
+    # --- tensor-parallel serving (README "Tensor-parallel serving") -
+    # Shard the replica ITSELF over a model axis: the engine's
+    # programs (prefill/insert/decode/verify) build over a
+    # [data=1, model=N] mesh with tp_partitioning on — attention
+    # heads and MLP width shard over the axis, the slot KV cache's
+    # head dim shards with them (per-device cache bytes shrink by N),
+    # and GSPMD inserts the block psums. Output stays token-identical
+    # to the single-device engine (greedy determinism; SERVEBENCH's
+    # tp phase gates it). Needs n_heads (and n_kv_heads under GQA)
+    # divisible by N and N local devices — validated in serve/run.py
+    # where both are known. 1 = the single-device engine, unchanged.
+    # NOTE: this is deliberately NOT --mesh.model — the train mesh
+    # flags keep their pure-data-mesh contract under mode=serve; the
+    # serve mesh is the engine's own.
+    mesh_model: int = 1
     # --- fleet serving (fleet/; README "Fleet serving") ------------
     # Inbox file this replica TAILS for requests and control commands
     # (fleet/replica.py line protocol): with an inbox the scheduler
@@ -579,6 +594,10 @@ class ServeConfig:
                     "serve.inbox needs --serve.journal: the journal "
                     "is how the fleet router reads tokens back and "
                     "re-dispatches after a replica death")
+        if self.mesh_model < 1:
+            raise ValueError(
+                f"serve.mesh_model must be >= 1, "
+                f"got {self.mesh_model}")
         if self.tenants < 1:
             raise ValueError(
                 f"serve.tenants must be >= 1, got {self.tenants}")
@@ -1379,9 +1398,10 @@ class TrainConfig:
             if (self.mesh.model > 1 or self.mesh.seq > 1
                     or self.mesh.pipe > 1 or self.mesh.expert > 1):
                 raise ValueError(
-                    "mode=serve requires a pure data mesh (model/seq/"
-                    "pipe/expert == 1): the slot engine's single-token "
-                    "steps can't be model-sharded yet")
+                    "mode=serve requires a pure data --mesh.* (model/"
+                    "seq/pipe/expert == 1): the serve engine builds "
+                    "its OWN tensor-parallel mesh — use "
+                    "--serve.mesh-model N to shard the replica")
         if self.resilience.fault_plan:
             # Phase check: a fault keyed to a phase that never consults
             # it would sit silently unfired — reject at startup
@@ -1422,6 +1442,10 @@ class TrainConfig:
             raise ValueError(
                 "serve.journal is written by the mode=serve "
                 "scheduler; drop the flag")
+        if self.serve.mesh_model > 1 and self.mode != "serve":
+            raise ValueError(
+                "serve.mesh_model shards the mode=serve engine's "
+                "mesh; drop the flag or add --mode serve")
         if self.serve.inbox:
             if self.mode != "serve":
                 raise ValueError(

@@ -805,6 +805,14 @@ class Router:
                 "stale_s": (round(now - rep.seq_t, 3)
                             if rep.last_seq is not None else None),
                 "ckpt_step": snap.get("ckpt_step"),
+                # PER-DEVICE capacity facts (scheduler's
+                # _capacity_fields): a tensor-parallel replica's cache
+                # spend per device is 1/tp_width of the logical bytes
+                # — headroom math over the logical figure would
+                # overcount a TP replica tp_width-fold.
+                "tp_width": snap.get("tp_width", 1),
+                "per_device_cache_bytes": snap.get(
+                    "per_device_cache_bytes"),
             }
         done = [t for t in self.tracks.values() if t.state == "done"]
         by_cls: Dict[str, List[float]] = {}

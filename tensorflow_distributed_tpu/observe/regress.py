@@ -97,6 +97,9 @@ MANIFEST: Dict[str, Tuple[str, List[Check]]] = {
         ("serve_slo_p95_ttft_high.ratio", "lower", 1.0),
         ("serve_checks.speedup_ok", "truthy"),
         ("serve_checks.token_identical", "equal"),
+        ("serve_tp_cache_bytes_per_slot.ratio", "higher", 0.0, 0.05),
+        ("serve_checks.tp_cache_ratio_ok", "truthy"),
+        ("serve_checks.tp_token_identical", "equal"),
     )),
     "SLOBENCH.json": ("jsonl", _jsonl_checks(
         ("slo_control_alerts.value", "lower", 0.0, 0.0),

@@ -157,12 +157,47 @@ def _poison_row_jit(cache, slot):
     return jax.tree_util.tree_map(bad, cache)
 
 
+def tp_width(model) -> int:
+    """The model's tensor-parallel width: the "model" axis of the mesh
+    it was built on (1 when mesh-less or unsharded). The ONE derivation
+    every piece of per-device serve arithmetic divides by."""
+    mesh = getattr(model, "mesh", None)
+    if mesh is None:
+        return 1
+    return int(dict(mesh.shape).get("model", 1))
+
+
+def shard_cache(model, cache):
+    """Place a decode-cache pytree for ``model``'s tensor-parallel
+    mesh: the head axis (dim 2 of every [.., .., nk, dh] / [.., .., nk]
+    leaf — dense rows, int8 scales, and the paged pool all put heads
+    there) shards over "model"; scalar leaves (the compat ``index``)
+    replicate. A no-op at TP width 1, so the single-device engine's
+    arrays are untouched. One explicit placement here is what lets
+    GSPMD keep every subsequent decode/insert/verify output in the
+    same layout (asserted by the engine's first-step sharding
+    contract)."""
+    if tp_width(model) == 1:
+        return cache
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    mesh = model.mesh
+
+    def put(c):
+        spec = (PartitionSpec(None, None, "model")
+                if getattr(c, "ndim", 0) >= 3 else PartitionSpec())
+        return jax.device_put(c, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(put, cache)
+
+
 def zero_cache(model, params, num_slots: int):
     """A zeroed [num_slots, max_len, ...] decode-cache pytree for
     ``model``, shaped via eval_shape (no device work, no params
     flops). Shared by the engine and the draft speculator's mirrored
     cache (serve/speculate.py); int8 quantized caches come back with
-    their scale leaves included."""
+    their scale leaves included. On a TP mesh the head axis comes back
+    sharded over "model" (see :func:`shard_cache`)."""
     tok = jnp.zeros((num_slots, 1), jnp.int32)
     pos = jnp.zeros((num_slots, 1), jnp.int32)
     shapes = jax.eval_shape(
@@ -170,8 +205,8 @@ def zero_cache(model, params, num_slots: int):
             {"params": p}, t, decode=True, positions=q,
             mutable=["cache"])[1]["cache"],
         params, tok, pos)
-    return jax.tree_util.tree_map(
-        lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+    return shard_cache(model, jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), shapes))
 
 
 class SlotDecodeEngine:
@@ -206,6 +241,14 @@ class SlotDecodeEngine:
             raise ValueError(
                 f"largest bucket {max(self.buckets)} exceeds the "
                 f"model's max_len {cfg.max_len}")
+        # Tensor parallelism: the width comes off the mesh the model
+        # was built on — the engine itself has no TP knob. At width > 1
+        # the cache's head axis is sharded over "model"
+        # (shard_cache), per-device accounting divides by the width,
+        # and the first-step sharding contract is ALWAYS armed (layout
+        # drift under TP re-lays-out every subsequent step — too
+        # expensive to leave to an opt-in flag).
+        self.tp_width = tp_width(model)
         self.cache = self._zero_cache()
         self.tok = np.zeros((num_slots,), np.int32)
         self.pos = np.zeros((num_slots,), np.int32)
@@ -240,7 +283,7 @@ class SlotDecodeEngine:
         # cache was created with (analysis/runtime.py).
         self._check = check
         self._declared_cache = (graftcheck.sharding_tree(self.cache)
-                                if check else None)
+                                if check or self.tp_width > 1 else None)
 
     def _zero_cache(self):
         return zero_cache(self.model, self.params, self.num_slots)
@@ -266,22 +309,40 @@ class SlotDecodeEngine:
         with graftcheck.transfer_guard(self._check):
             return self._verify_fn(self.params, self.cache, tok, pos)
 
+    def _h2d(self, a):
+        """Host->device upload of a guarded-dispatch input. At TP
+        width 1 this is plain ``jnp.asarray``. Under TP the upload
+        places explicitly REPLICATED on the engine's mesh: a bare
+        asarray lands uncommitted on one device, and the compiled
+        program's broadcast to the other shards would then be a
+        device-to-device transfer INSIDE the transfer guard — tripping
+        --check on the engine's own designed input path."""
+        if self.tp_width == 1:
+            return jnp.asarray(a)
+        from jax.sharding import NamedSharding, PartitionSpec
+        return jax.device_put(
+            a, NamedSharding(self.model.mesh, PartitionSpec()))
+
     def _span(self, name: str, **args):
         if self._tracer is None:
             return contextlib.nullcontext()
         return self._tracer.engine_span(name, **args)
 
     def cache_bytes_per_slot(self) -> int:
-        """HBM the decode cache spends per slot (scale leaves of an
-        int8 cache included) — the number the "choosing num_slots
-        under an HBM budget" math divides by (README "Serving";
-        servebench's int8 slots-at-budget gate)."""
+        """PER-DEVICE HBM the decode cache spends per slot (scale
+        leaves of an int8 cache included) — the number the "choosing
+        num_slots under an HBM budget" math divides by (README
+        "Serving"; servebench's int8 and TP slots-at-budget gates).
+        Under TP every counted leaf is head-sharded over the "model"
+        axis (shard_cache's placement), so each device holds
+        ``1/tp_width`` of the logical bytes — the division below is
+        exact, not an estimate, and collapses to a no-op at width 1."""
         total = sum(
             int(np.prod(c.shape)) * c.dtype.itemsize
             for c in jax.tree_util.tree_leaves(self.cache)
             if getattr(c, "ndim", 0)
             and c.shape[:1] == (self.num_slots,))
-        return total // self.num_slots
+        return total // (self.num_slots * self.tp_width)
 
     @property
     def prefill_compiles(self) -> int:
@@ -453,7 +514,7 @@ class SlotDecodeEngine:
             toks_in[s] = window
             start[s] = self.pos[s] - k
             fallback.append(s)
-        tok, pos = jnp.asarray(toks_in), jnp.asarray(start)
+        tok, pos = self._h2d(toks_in), self._h2d(start)
         self.cache, nxt, ok = self._dispatch_verify(tok, pos)
         step_no = self.decode_steps + 1
 
@@ -558,12 +619,14 @@ class SlotDecodeEngine:
         # Host->device conversion of the slot scalars stays OUTSIDE the
         # transfer guard: these two tiny explicit uploads are the
         # engine's designed input path.
-        tok, pos = jnp.asarray(self.tok), jnp.asarray(self.pos)
+        tok, pos = self._h2d(self.tok), self._h2d(self.pos)
         self.cache, nxt, ok = self._dispatch_step(tok, pos)
-        if self._check and self.decode_steps == 0:
+        if self._declared_cache is not None and self.decode_steps == 0:
             # First decode step: the cache must come back in the
             # layout it was created with — sharding drift here
-            # re-lays-out every subsequent step.
+            # re-lays-out every subsequent step. Armed by --check, and
+            # ALWAYS under TP (a drifted head shard silently
+            # re-gathers the cache every step).
             graftcheck.assert_sharding_contract(
                 self.cache, self._declared_cache, what="decode cache")
         step_no = self.decode_steps + 1

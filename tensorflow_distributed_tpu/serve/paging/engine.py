@@ -56,7 +56,8 @@ from tensorflow_distributed_tpu.models.generate import lookup_program
 from tensorflow_distributed_tpu.observe import device as observe_device
 from tensorflow_distributed_tpu.observe.registry import emit_event
 from tensorflow_distributed_tpu.serve.buckets import pick_bucket
-from tensorflow_distributed_tpu.serve.engine import SlotDecodeEngine
+from tensorflow_distributed_tpu.serve.engine import (
+    SlotDecodeEngine, shard_cache)
 from tensorflow_distributed_tpu.serve.paging.pool import (
     GARBAGE_PAGE, PagePool)
 from tensorflow_distributed_tpu.serve.paging.radix import RadixCache
@@ -260,7 +261,7 @@ class PagedSlotEngine(SlotDecodeEngine):
         engine's slot scalars, the upload stays OUTSIDE the transfer
         guard: it is the designed input path)."""
         if self._tables_dev is None:
-            self._tables_dev = jnp.asarray(self.tables)
+            self._tables_dev = self._h2d(self.tables)
         return self._tables_dev
 
     def _dispatch_step(self, tok, pos):
@@ -284,20 +285,26 @@ class PagedSlotEngine(SlotDecodeEngine):
                 {"params": p}, t, decode=True, positions=q,
                 page_table=g, mutable=["cache"])[1]["cache"],
             self.params, tok, pos, pt)
-        return jax.tree_util.tree_map(
-            lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+        # The paged pool's head axis sits at dim 2 like the dense
+        # cache's ([num_pages, page_size, nk, dh]) — the same TP
+        # placement applies (no-op at width 1).
+        return shard_cache(self.model, jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), shapes))
 
     # -- accounting --------------------------------------------------------
 
     def page_bytes(self) -> int:
-        """HBM per page summed over the cache leaves (int8 scale
-        leaves included) — the unit the "choosing num_slots under an
-        HBM budget" arithmetic multiplies (README "Paged KV")."""
+        """PER-DEVICE HBM per page summed over the cache leaves (int8
+        scale leaves included) — the unit the "choosing num_slots
+        under an HBM budget" arithmetic multiplies (README "Paged
+        KV"). Under TP every pool leaf is head-sharded over "model"
+        (shard_cache), so each device holds ``1/tp_width`` of a page's
+        logical bytes — exact division, no-op at width 1."""
         return sum(
             int(np.prod(c.shape[1:])) * c.dtype.itemsize
             for c in jax.tree_util.tree_leaves(self.cache)
             if getattr(c, "ndim", 0)
-            and c.shape[:1] == (self.pool.num_pages,))
+            and c.shape[:1] == (self.pool.num_pages,)) // self.tp_width
 
     def cache_bytes_per_slot(self) -> int:
         """WORST-CASE bytes per slot (a full-depth request holds
@@ -619,21 +626,24 @@ class PagedSlotEngine(SlotDecodeEngine):
 
 # -- num_pages auto-sizing (serve/run.py; README "Paged KV") ---------------
 
-def page_bytes_estimate(cfg, page_size: int) -> int:
-    """Bytes one page will occupy, from the model CONFIG alone — so
-    ``--serve.num-pages`` can be sized BEFORE any cache (or compiled
-    program) exists. Mirrors the cache leaves models/transformer.py
-    creates (K + V rows in the cache dtype, plus the f32
-    per-(token, head) absmax scales under int8); parity with the
-    built engine's measured :meth:`PagedSlotEngine.page_bytes` is
-    pinned in tests/test_fleet.py."""
+def page_bytes_estimate(cfg, page_size: int, tp: int = 1) -> int:
+    """PER-DEVICE bytes one page will occupy, from the model CONFIG
+    alone — so ``--serve.num-pages`` can be sized BEFORE any cache (or
+    compiled program) exists. Mirrors the cache leaves
+    models/transformer.py creates (K + V rows in the cache dtype, plus
+    the f32 per-(token, head) absmax scales under int8), divided by
+    the TP width ``tp`` (the pool is head-sharded over "model" —
+    shard_cache); parity with the built engine's measured
+    :meth:`PagedSlotEngine.page_bytes` is pinned in
+    tests/test_fleet.py."""
     nk = cfg.n_kv_heads or cfg.n_heads
     dh = cfg.d_model // cfg.n_heads
     if cfg.kv_cache_quant == "int8":
         per_tok = 2 * nk * dh + 2 * nk * 4   # int8 rows + f32 scales
     else:
         per_tok = 2 * nk * dh * np.dtype(cfg.compute_dtype).itemsize
-    return int(page_size) * int(cfg.n_layers) * int(per_tok)
+    return int(page_size) * int(cfg.n_layers) * int(per_tok) \
+        // max(1, int(tp))
 
 
 def auto_num_pages(*, num_slots: int, need_pages: int,

@@ -215,12 +215,59 @@ def serve_run(cfg: TrainConfig) -> Dict:
 
     bootstrap()
     mesh = make_mesh(cfg.mesh)
+    tp = cfg.serve.mesh_model
+    if tp > 1:
+        # Tensor-parallel replica: the engine's programs build over a
+        # [data=1, model=tp] mesh of this replica's own — attention
+        # heads / MLP width / the cache's head axis shard over
+        # "model" (README "Tensor-parallel serving"). Validated here,
+        # where devices and the model facts are both known; the
+        # config layer only vets tp >= 1.
+        import jax
+        from tensorflow_distributed_tpu.analysis.planner.candidates \
+            import MODEL_FAMILIES, model_facts
+        from tensorflow_distributed_tpu.config import MeshConfig
+        devs = jax.devices()
+        if len(devs) < tp:
+            raise ValueError(
+                f"--serve.mesh-model {tp} needs {tp} devices, have "
+                f"{len(devs)}")
+        facts = model_facts(MODEL_FAMILIES[cfg.model],
+                            cfg.model_size or "")
+        nk = cfg.n_kv_heads or facts.n_heads
+        if facts.n_heads % tp or nk % tp:
+            raise ValueError(
+                f"--serve.mesh-model {tp} must divide n_heads "
+                f"{facts.n_heads} and n_kv_heads {nk}: attention "
+                f"heads and the KV cache's head axis shard over the "
+                f"model axis")
+        if (cfg.dataset != "text" and not cfg.shard_vocab
+                and facts.vocab_size % tp):
+            raise ValueError(
+                f"--serve.mesh-model {tp} must divide the vocab "
+                f"{facts.vocab_size}: the TP head is vocab-parallel. "
+                f"Pass --shard-vocab true (pads the table to a "
+                f"multiple of the model axis; the checkpoint must be "
+                f"trained with the same flag) or pick a width that "
+                f"divides")
+        mesh = make_mesh(MeshConfig(data=1, model=tp), devs[:tp])
+        if is_chief():
+            print(f"[serve] tensor-parallel replica: model={tp} over "
+                  f"{tp} device(s) (params + KV cache head-sharded)",
+                  flush=True)
 
     encode = None
     if cfg.dataset == "text":
         from tensorflow_distributed_tpu.data.lm import text_codec
         encode, _, vocab = text_codec(cfg.data_dir, cfg.text_tokenizer,
                                       cfg.bpe_vocab_size)
+        # The model vocab follows the tokenizer here, so the TP
+        # head's divisibility is only checkable now.
+        if tp > 1 and not cfg.shard_vocab and vocab % tp:
+            raise ValueError(
+                f"--serve.mesh-model {tp} must divide the tokenizer "
+                f"vocab {vocab} (the TP head is vocab-parallel); "
+                f"pass --shard-vocab true to pad it")
     else:
         vocab = cfg.synthetic_vocab or 64
     # Fleet-replica intake (--serve.inbox; fleet/replica.py): no
@@ -399,7 +446,7 @@ def serve_run(cfg: TrainConfig) -> Dict:
             num_pages, rationale = auto_num_pages(
                 num_slots=cfg.serve.num_slots,
                 need_pages=-(-need // ps),
-                page_bytes=page_bytes_estimate(model.cfg, ps),
+                page_bytes=page_bytes_estimate(model.cfg, ps, tp=tp),
                 budget_bytes=int(cfg.serve.hbm_budget_gb * 2 ** 30),
                 reserved_bytes=reserved,
                 observed_peak=observed_peak)

@@ -1035,6 +1035,7 @@ class Scheduler:
             "retries": total_retries,
             "swaps": getattr(eng, "swaps", 0),
             "swap_seconds": round(self._swap_seconds, 4),
+            **self._capacity_fields(),
             **self.summary_extra,
         }
         if spec is not None:
@@ -1080,6 +1081,31 @@ class Scheduler:
         if tb <= ta:
             return None
         return (db - da) / (tb - ta)
+
+    def _capacity_fields(self) -> Dict[str, Any]:
+        """HBM-capacity facts for the fleet side, PER-DEVICE honest:
+        a tensor-parallel replica's cache is head-sharded over its
+        mesh, so each device holds 1/tp_width of the logical bytes —
+        a router pre-checking headroom from the logical figure would
+        overcount a TP replica's spend tp_width-fold. Rides both
+        ``serve_summary`` and :meth:`metrics_snapshot`. getattr-safe
+        throughout (test fakes model neither a cache nor a mesh)."""
+        out: Dict[str, Any] = {
+            "tp_width": int(getattr(self.engine, "tp_width", 1))}
+        bps = getattr(self.engine, "cache_bytes_per_slot", None)
+        if callable(bps):
+            out["per_device_cache_bytes"] = int(
+                bps() * getattr(self.engine, "num_slots", 0))
+        mesh = getattr(getattr(self.engine, "model", None), "mesh",
+                       None)
+        if mesh is not None:
+            from tensorflow_distributed_tpu.parallel.mesh import (
+                mesh_shape_dict)
+            # "engine_mesh", not "mesh": every registry record already
+            # carries the compact host "mesh" tag (observe/registry.py
+            # host_tags), and fields override tags on emit.
+            out["engine_mesh"] = mesh_shape_dict(mesh)
+        return out
 
     def metrics_snapshot(self) -> Dict[str, Any]:
         """Atomic point-in-time view of the serving engine — the exact
@@ -1128,6 +1154,7 @@ class Scheduler:
             "num_slots": getattr(self.engine, "num_slots", 0),
             "max_len": getattr(self.engine, "max_len", 0),
         }
+        snap.update(self._capacity_fields())
         if self.served_ckpt_step is not None:
             # The fleet controller's model-staleness feed: which
             # trained step these weights came from.

@@ -94,9 +94,16 @@ def create_train_state(model: nn.Module, tx: optax.GradientTransformation,
     def init_vars(key):
         return nn.meta.unbox(model.init(key, sample_input, train=False))
 
+    # Init OUTSIDE the mesh context: with a live mesh, flax's
+    # DenseGeneral validates its multi-dim kernels by applying the
+    # boxed rank-4 partition constraint to the pre-reshape rank-2
+    # value — a rank mismatch that rejects any tp_partitioning init at
+    # mesh.model > 1. The out_shardings are NamedShardings and carry
+    # the mesh themselves, so placement is identical; only the
+    # context-dependent constraint inside init is skipped.
+    variables = jax.jit(init_vars, out_shardings=var_shardings)(
+        prng.init_key(seed))
     with mesh:
-        variables = jax.jit(init_vars, out_shardings=var_shardings)(
-            prng.init_key(seed))
         params = variables["params"]
         extra = {k: v for k, v in variables.items()
                  if k != "params" and k not in TRANSIENT_COLLECTIONS}

@@ -605,7 +605,8 @@ def test_census_structure_sane():
                            "gpt_train_overlap", "moe_train_overlap",
                            "serve_verify", "serve_decode_int8",
                            "serve_decode_paged", "serve_verify_paged",
-                           "serve_prefill_paged"}
+                           "serve_prefill_paged", "serve_decode_tp",
+                           "serve_verify_tp"}
     assert golden["pipelined_train"]["collectives"].get("ppermute", 0) > 0
     assert golden["gpt_train"]["collectives"] == {}
     assert golden["serve_decode"]["collectives"] == {}
@@ -632,6 +633,19 @@ def test_census_structure_sane():
         assert golden[name]["collectives"] == {}, name
     assert (golden["serve_decode_paged"]["upcasts"]
             == golden["serve_decode"]["upcasts"])
+    # Tensor-parallel serving invariants: the model=2 decode/verify
+    # programs MUST carry collectives (head-sharded attention + MLP
+    # reassemble activations every step — TP that compiles to zero
+    # collectives silently replicated somewhere), while the upcast
+    # counts equal the dense program's (sharding relocates math, it
+    # does not widen it). These census entries are HLO-derived
+    # (GSPMD emits the collectives after partitioning), hence the
+    # hyphenated names.
+    for name in ("serve_decode_tp", "serve_verify_tp"):
+        tp_coll = golden[name]["collectives"]
+        assert sum(tp_coll.values()) > 0, name
+        assert (golden[name]["upcasts"]
+                == golden["serve_decode"]["upcasts"]), name
     # The overlap grad-sync invariant: an explicit reduce-scatter AND
     # an explicit all-gather per scatter bucket (counts equal — a
     # bucket that scatters but never gathers back would train on

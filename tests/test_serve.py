@@ -64,9 +64,16 @@ def test_serve_config_validation():
     bad.serve.buckets = "64,16"
     with pytest.raises(ValueError, match="ascending"):
         bad.validate()
+    # The TRAIN mesh flags keep their pure-data contract under serve;
+    # sharding the replica is --serve.mesh-model's job (and the
+    # rejection must say so).
     bad = TrainConfig(mode="serve", model="gpt_lm")
     bad.mesh.model = 2
-    with pytest.raises(ValueError, match="pure data mesh"):
+    with pytest.raises(ValueError, match="serve.mesh-model"):
+        bad.validate()
+    bad = TrainConfig(mode="serve", model="gpt_lm")
+    bad.serve.mesh_model = 0
+    with pytest.raises(ValueError, match="mesh_model"):
         bad.validate()
 
 

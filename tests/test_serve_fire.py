@@ -107,7 +107,7 @@ class _FireFakeEngine:
     rides prompt[0]; tokens count as len(prompt) - 1 (base prompts
     are length 1)."""
 
-    def __init__(self, num_slots=2, max_len=256):
+    def __init__(self, num_slots=2, max_len=256, spec_tokens=0):
         self.num_slots = num_slots
         self.max_len = max_len
         self.buckets = (64, 128)
@@ -117,6 +117,8 @@ class _FireFakeEngine:
         self.prefills = 0
         self.prefill_compiles = 0
         self.decode_steps = 0
+        self.verify_steps = 0
+        self.spec_tokens = spec_tokens
         self.swaps = 0
         self.params = object()
         self._poisoned = set()
@@ -154,6 +156,36 @@ class _FireFakeEngine:
             out[s] = rid * 100 + self.counts[rid]
         self.decode_steps += 1
         return out
+
+    def can_verify(self):
+        return self.spec_tokens > 0
+
+    def verify_step(self, props):
+        """Verify dispatch mirroring the real contract: [S, k+1]
+        tokens, per-slot accepted+1 counts, and the per-slot ok flag
+        surfaced through take_bad_slots — a poisoned slot's whole row
+        is garbage THIS dispatch, exactly like non-finite logits under
+        the real verify program."""
+        k = self.spec_tokens
+        toks = np.zeros((self.num_slots, k + 1), np.int32)
+        acc = np.zeros((self.num_slots,), np.int32)
+        self._bad = []
+        for s in range(self.num_slots):
+            if not self.active[s]:
+                continue
+            if s in self._poisoned:
+                toks[s, :] = 999_999         # garbage, must be dropped
+                acc[s] = k + 1
+                self._bad.append(s)
+                continue
+            rid = self.slot_rid[s]
+            for j in range(k + 1):
+                self.counts[rid] += 1
+                toks[s, j] = rid * 100 + self.counts[rid]
+            acc[s] = k + 1
+        self.decode_steps += 1
+        self.verify_steps += 1
+        return toks, acc
 
     def take_bad_slots(self):
         bad, self._bad = getattr(self, "_bad", []), []
@@ -203,6 +235,53 @@ def test_slot_retry_token_identity_and_budget():
     # Retried requests flag the recovery window in their records.
     assert any(r.get("recovery_window")
                for r in reg.records if r["event"] == "serve_request")
+
+
+class _FakeSpeculator:
+    """Proposal source for the fake verify path. Content is ignored —
+    the fake engine's verify_step derives truth from its own stream —
+    so this only has to satisfy the scheduler's speculator surface."""
+
+    needs_histories = False
+
+    def __init__(self, num_slots, k):
+        self.num_slots, self.k = num_slots, k
+
+    def propose(self, histories):
+        return np.zeros((self.num_slots, self.k), np.int32)
+
+    def observe_admit(self, slot, prompt, first_tok):
+        pass
+
+    def observe_free(self, slot):
+        pass
+
+    def sync_from(self, engine):
+        pass
+
+    def warmup(self):
+        pass
+
+
+def test_mid_verify_slot_retry_token_identity():
+    """slot_nan fired while speculation is armed lands INSIDE a verify
+    dispatch: the dispatch's own per-slot ok flag (take_bad_slots)
+    quarantines, the whole garbage row is dropped before retirement,
+    and the requeued continuation resumes the exact stream."""
+    plan = parse_fault_plan("slot_nan@2:0,slot_nan@3:1")
+    eng = _FireFakeEngine(num_slots=2, spec_tokens=3)
+    sched = Scheduler(eng, decode_priority=3, fault_plan=plan,
+                      slot_retries=2, speculator=_FakeSpeculator(2, 3))
+    done = {c.rid: c for c in sched.run(_reqs(5))}
+    assert len(done) == 5
+    for rid, c in done.items():
+        assert c.tokens == _expected(rid, 8), f"rid {rid} drifted"
+    assert sched.summary["retries"] == 2
+    # Every dispatch this engine took was a verify dispatch, so both
+    # containments necessarily rode the verify program's ok flag —
+    # never a separate probe step.
+    assert eng.verify_steps == eng.decode_steps >= 1
+    assert sched.summary["verify_steps"] == eng.verify_steps
 
 
 def test_slot_retry_budget_exhausted_is_diverged():
@@ -427,6 +506,36 @@ def test_slot_nan_containment_token_identical():
     done = {c.rid: c for c in sched.run(_mixed_requests())}
     assert {r: c.tokens for r, c in done.items()} == base
     assert sched.summary["retries"] >= 1
+
+
+@pytest.mark.slow
+def test_spec_slot_nan_mid_verify_token_identical():
+    """slot_nan under ARMED speculation: the poison is detected by the
+    VERIFY program's per-slot finiteness flag (the same fetch that
+    returns the verify tokens — no extra probe dispatch), the slot
+    quarantined, and the final streams are identical to the plain
+    greedy run. Containment composes with speculation, not around it."""
+    from tensorflow_distributed_tpu.serve.engine import SlotDecodeEngine
+    from tensorflow_distributed_tpu.serve.speculate import SelfDraft
+
+    model, params = _tiny_lm()
+    base_eng = SlotDecodeEngine(model, params, num_slots=2)
+    base = {c.rid: c.tokens
+            for c in Scheduler(base_eng, decode_priority=3).run(
+                _mixed_requests())}
+
+    k = 3
+    plan = parse_fault_plan("slot_nan@2:0,slot_nan@4:1")
+    eng = SlotDecodeEngine(model, params, num_slots=2, fault_plan=plan,
+                           spec_tokens=k)
+    sched = Scheduler(eng, decode_priority=3, fault_plan=plan,
+                      slot_retries=2, speculator=SelfDraft(2, k))
+    done = {c.rid: c for c in sched.run(_mixed_requests())}
+    assert {r: c.tokens for r, c in done.items()} == base
+    assert sched.summary["retries"] >= 1
+    # Headroom never ran out at these lengths, so EVERY dispatch was a
+    # verify dispatch — the quarantines came off the verify ok flag.
+    assert eng.verify_steps == eng.decode_steps >= 1
 
 
 def _tiny_state(max_len=64):
