@@ -1,9 +1,11 @@
 #!/usr/bin/env bash
-# graftcheck gate: the AST lint over the whole package, then the jaxpr
-# collective/upcast census against the committed goldens. Nonzero exit
-# on any finding or drift. Invoked from scripts/t1.sh ahead of the
-# pytest tier (fast: the lint is pure stdlib, the census only traces —
-# no XLA compiles).
+# graftcheck gate: the AST lint over the whole package (telemetry
+# schema, durability, and argv-protocol contract rules included), the
+# schema pass's RECORDS.md drift gate, then the jaxpr collective/upcast
+# census against the committed goldens. Nonzero exit on any finding or
+# drift. Invoked from scripts/t1.sh ahead of the pytest tier (fast: the
+# lint and schema passes are pure stdlib, the census only traces — no
+# XLA compiles).
 #
 # Usage: scripts/lint.sh            (from anywhere)
 #
@@ -11,6 +13,8 @@
 #   - lint finding: fix it, or suppress the statement with
 #     '# graftcheck: disable=<rule> -- <reason>' (rule catalog:
 #     python -m tensorflow_distributed_tpu.analysis.lint --list-rules)
+#   - RECORDS.md drift: edit observe/schemas.py, then regenerate:
+#     python -m tensorflow_distributed_tpu.analysis.schema --update
 #   - census drift: if the collective/upcast change is intentional,
 #     regenerate and commit the goldens:
 #     python -m tensorflow_distributed_tpu.analysis.jaxprcheck --update
@@ -21,6 +25,11 @@ rc=0
 
 python -m tensorflow_distributed_tpu.analysis.lint \
   tensorflow_distributed_tpu/ || rc=$?
+
+# Schema pass: the telemetry-contract rule subset plus the RECORDS.md
+# drift gate (the lint above already ran the rules repo-wide; this adds
+# the generated-doc check and gives the contract its own CLI surface).
+python -m tensorflow_distributed_tpu.analysis.schema || rc=$?
 
 env JAX_PLATFORMS=cpu python -m tensorflow_distributed_tpu.analysis.jaxprcheck \
   || rc=$?

@@ -58,6 +58,8 @@ import os
 import sys
 from typing import Any, Dict, List, Optional, Sequence
 
+from tensorflow_distributed_tpu.utils.atomicio import atomic_write_json
+
 CALIBRATION_VERSION = 1
 
 #: the sample fields a fit consumes (measured_ms > 0 required;
@@ -243,13 +245,9 @@ def make_profile(fit: Dict[str, Any], platform: str, device_kind: str,
 
 
 def write_calibration(profile: Dict[str, Any], path: str) -> None:
-    """Atomic (tmp+rename) so a poller — or a crashed fit — never
-    reads a torn profile."""
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(profile, f, indent=2)
-        f.write("\n")
-    os.replace(tmp, path)
+    """Atomic (tmp+fsync+rename) so a poller — or a crashed fit —
+    never reads a torn profile."""
+    atomic_write_json(path, profile, indent=2, trailing_newline=True)
 
 
 def load_calibration(path: str) -> Dict[str, Any]:

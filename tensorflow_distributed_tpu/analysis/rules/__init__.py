@@ -11,7 +11,8 @@ from __future__ import annotations
 from typing import Callable, Dict, Iterator, List
 
 from tensorflow_distributed_tpu.analysis.rules import (
-    donation, effects, hostsync, jitloop, prngreuse)
+    argvproto, donation, durability, effects, hostsync, jitloop,
+    prngreuse, telemetry)
 from tensorflow_distributed_tpu.analysis.rules.common import (  # noqa: F401
     Finding, ModuleContext)
 
@@ -36,6 +37,22 @@ CATALOG: Dict[str, str] = {
     effects.RULE:
         "print/time.time/... under trace (runs per compile, not per "
         "step)",
+    telemetry.RULE_KIND:
+        "emit of a record kind with no schema in observe/schemas.py",
+    telemetry.RULE_FIELD:
+        "emit with a field its record schema does not declare",
+    telemetry.RULE_REQUIRED:
+        "emit provably missing a required schema field",
+    telemetry.RULE_READ:
+        "telemetry consumer reads a field no producer declares",
+    durability.RULE_RAW:
+        "raw open(w/a) on a declared cross-process path family "
+        "(use utils.atomicio)",
+    durability.RULE_FSYNC:
+        "os.replace/rename onto a durable path with no fsync "
+        "(crash can publish an empty file)",
+    argvproto.RULE:
+        "parent-constructed child flag that config.py does not parse",
 }
 
 CHECKS: List[Callable[[ModuleContext], Iterator[Finding]]] = [
@@ -44,6 +61,9 @@ CHECKS: List[Callable[[ModuleContext], Iterator[Finding]]] = [
     jitloop.check,
     donation.check,
     effects.check,
+    telemetry.check,
+    durability.check,
+    argvproto.check,
 ]
 
 

@@ -1,0 +1,111 @@
+"""Argv protocol contract — parent-written flags must parse.
+
+The supervisor (``resilience/supervisor.py``) and the fleet
+controller (``fleet/controller.py``) construct child argv: mesh
+rewrites (``--mesh.data``), resume plumbing (``--resume``,
+``--checkpoint-dir``), replica wiring (``--serve.inbox``,
+``--observe.export-path``, …). The child parses them with the ONE
+flag namespace ``config.py`` derives from ``TrainConfig``
+(``config.known_flags()``). A flag the parent writes but the child
+does not parse is a crash loop at restart time — exactly the
+ps/worker-style implicit protocol this repo makes explicit.
+
+One rule, ``unparsed-child-flag``:
+
+* In the two argv-constructing modules, every ``--flag`` string
+  literal must be in ``config.known_flags()`` — except arguments to
+  ``add_argument`` (the module's OWN parser) and f-string prefixes
+  (``f"--mesh.{name}"``), which are checked as namespace prefixes.
+* Everywhere, ``config.child_flag("dotted_path")`` calls — the
+  blessed spelling helper both parents share — get their argument
+  verified the same way.
+
+Imports config lazily; config.py is pure stdlib, so the pass stays
+jax-free.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from tensorflow_distributed_tpu.analysis.rules.common import (
+    Finding, ModuleContext, qualname)
+
+RULE = "unparsed-child-flag"
+
+#: Modules that construct child argv — every literal flag in them is
+#: part of the parent->child protocol.
+ARGV_SUFFIXES = ("resilience/supervisor.py", "fleet/controller.py")
+
+_FLAG_RE = re.compile(r"--[a-z][a-z0-9]*([.\-][a-z0-9]+)*")
+
+
+def _known_flags():
+    from tensorflow_distributed_tpu import config
+    return config.known_flags()
+
+
+def _norm(path: str) -> str:
+    return path.replace("\\", "/")
+
+
+def _in_add_argument(ctx: ModuleContext, node: ast.AST) -> bool:
+    cur = ctx.parent(node)
+    while cur is not None:
+        if isinstance(cur, ast.Call) \
+                and qualname(cur.func).endswith("add_argument"):
+            return True
+        if isinstance(cur, ast.stmt):
+            return False
+        cur = ctx.parent(cur)
+    return False
+
+
+def check(ctx: ModuleContext) -> Iterator[Finding]:
+    npath = _norm(ctx.path)
+    argv_module = npath.endswith(ARGV_SUFFIXES)
+    known = None
+    for node in ast.walk(ctx.tree):
+        # The blessed helper, checked in EVERY module.
+        if isinstance(node, ast.Call) \
+                and qualname(node.func).rsplit(".", 1)[-1] == "child_flag" \
+                and node.args and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            if known is None:
+                known = _known_flags()
+            flag = "--" + node.args[0].value.replace("_", "-")
+            if flag not in known and not ctx.suppressed(node, RULE):
+                yield ctx.finding(
+                    node, RULE,
+                    f"child_flag({node.args[0].value!r}) -> '{flag}' "
+                    f"is not parsed by config.py")
+            continue
+        if not argv_module:
+            continue
+        if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+                and node.value.startswith("--"):
+            parent = ctx.parent(node)
+            joined = isinstance(parent, ast.JoinedStr)
+            if not joined and not _FLAG_RE.fullmatch(node.value):
+                continue
+            if joined and not re.fullmatch(r"--[a-z][a-z0-9.\-]*",
+                                           node.value):
+                continue
+            if _in_add_argument(ctx, node):
+                continue
+            if known is None:
+                known = _known_flags()
+            if joined:
+                # f"--mesh.{name}": the literal prefix must open a real
+                # flag namespace.
+                if any(f.startswith(node.value) for f in known):
+                    continue
+            elif node.value in known:
+                continue
+            if not ctx.suppressed(node, RULE):
+                yield ctx.finding(
+                    node, RULE,
+                    f"flag literal '{node.value}' is not parsed by "
+                    f"config.py (child would reject it)")

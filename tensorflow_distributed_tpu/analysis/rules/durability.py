@@ -1,0 +1,138 @@
+"""Durability lint — cross-process files go through utils/atomicio.
+
+``utils/atomicio.py`` declares the path families other processes
+read (export snapshots, inboxes, journals, trace files, the
+device-mask, checkpoint manifests, …) and owns the tmp+fsync+rename
+idiom. Two rules hold the tree to it:
+
+* ``raw-write-to-shared-path`` — a direct ``open(path, "w"|"a")``
+  whose path expression matches a declared family, outside
+  utils/atomicio.py. Use ``atomic_write_json`` /
+  ``atomic_write_jsonl`` / ``durable_append`` instead — or suppress
+  with a reason when raw is the point (flightrec's straight-through
+  postmortem dump; the journal's persistent hot-path handle).
+* ``missing-fsync-on-durable-path`` — an ``os.replace``/``os.rename``
+  onto a family path in a function with no ``os.fsync``: the rename
+  is atomic but the CONTENTS may still be in the page cache, so a
+  crash can publish an empty complete-looking file.
+
+Path matching is syntactic on purpose (source text of the path
+expression, plus one resolve hop through a local ``name = <expr>``
+assignment): conservative, jax-free, and cheap.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, List, Optional
+
+from tensorflow_distributed_tpu.analysis.rules.common import (
+    Finding, FuncInfo, ModuleContext, qualname)
+
+RULE_RAW = "raw-write-to-shared-path"
+RULE_FSYNC = "missing-fsync-on-durable-path"
+
+_WRITE_MODES = re.compile(r"[wax]|r\+")
+
+
+def _families():
+    from tensorflow_distributed_tpu.utils.atomicio import PATH_FAMILIES
+    return PATH_FAMILIES
+
+
+def _norm(path: str) -> str:
+    return path.replace("\\", "/")
+
+
+def _src(ctx: ModuleContext, node: ast.AST) -> str:
+    try:
+        return ast.get_source_segment(ctx.source, node) or ""
+    except Exception:
+        return ""
+
+
+def _resolved_srcs(ctx: ModuleContext, expr: ast.AST) -> List[str]:
+    """Source text of ``expr``, plus up to three hops through local
+    ``name = <rhs>`` assignments in the enclosing function (module
+    level otherwise) — enough to see through ``tmp = path + ".tmp"``."""
+    srcs = [_src(ctx, expr)]
+    fn = ctx.func_of(expr)
+    scope_root: ast.AST = fn.node if fn is not None else ctx.tree
+    cur = expr
+    for _ in range(3):
+        if not isinstance(cur, ast.Name):
+            break
+        target_rhs: Optional[ast.AST] = None
+        for node in ast.walk(scope_root):
+            if isinstance(node, ast.Assign) \
+                    and getattr(node, "lineno", 0) <= getattr(
+                        cur, "lineno", 0):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id == cur.id:
+                        target_rhs = node.value
+        if target_rhs is None:
+            break
+        srcs.append(_src(ctx, target_rhs))
+        cur = target_rhs
+    return srcs
+
+
+def _family_of(ctx: ModuleContext, expr: ast.AST) -> Optional[str]:
+    npath = _norm(ctx.path)
+    srcs = _resolved_srcs(ctx, expr)
+    for family, file_re, expr_re in _families():
+        if file_re and not re.search(file_re, npath):
+            continue
+        if any(re.search(expr_re, s, re.IGNORECASE) for s in srcs if s):
+            return family
+    return None
+
+
+def _has_fsync(ctx: ModuleContext, around: ast.AST) -> bool:
+    fn = ctx.func_of(around)
+    root: ast.AST = fn.node if fn is not None else ctx.tree
+    for node in ast.walk(root):
+        if isinstance(node, ast.Call) \
+                and qualname(node.func) == "os.fsync":
+            return True
+    return False
+
+
+def check(ctx: ModuleContext) -> Iterator[Finding]:
+    if _norm(ctx.path).endswith("utils/atomicio.py"):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = qualname(node.func)
+        if callee == "open" and node.args:
+            mode = ""
+            if len(node.args) >= 2 and isinstance(
+                    node.args[1], ast.Constant):
+                mode = str(node.args[1].value)
+            for kw in node.keywords:
+                if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                    mode = str(kw.value.value)
+            if not _WRITE_MODES.search(mode):
+                continue
+            family = _family_of(ctx, node.args[0])
+            if family is not None and not ctx.suppressed(node, RULE_RAW):
+                yield ctx.finding(
+                    node, RULE_RAW,
+                    f"raw open(..., {mode!r}) on '{family}' path — use "
+                    f"utils.atomicio (atomic_write_json / "
+                    f"durable_append)")
+        elif callee in ("os.replace", "os.rename") \
+                and len(node.args) >= 2:
+            family = _family_of(ctx, node.args[1])
+            if family is None:
+                continue
+            if _has_fsync(ctx, node):
+                continue
+            if not ctx.suppressed(node, RULE_FSYNC):
+                yield ctx.finding(
+                    node, RULE_FSYNC,
+                    f"{callee} onto '{family}' path without fsync — a "
+                    f"crash can publish an empty file; use "
+                    f"utils.atomicio.atomic_write_json")

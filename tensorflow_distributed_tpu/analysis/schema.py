@@ -1,0 +1,100 @@
+"""Telemetry schema contract checker + RECORDS.md drift gate.
+
+Usage::
+
+    python -m tensorflow_distributed_tpu.analysis.schema [paths...]
+    python -m tensorflow_distributed_tpu.analysis.schema --update
+
+Runs the telemetry contract rules (``analysis/rules/telemetry.py`` —
+producer emit sites and the four cross-process consumers, checked
+against ``observe/schemas.py``) over ``paths`` (default: the package),
+then gates ``RECORDS.md`` against the registry's rendering: the doc
+is GENERATED from the schemas, so a hand edit or a schema change
+without regeneration is drift and fails the run (mirroring the census
+goldens). ``--update`` rewrites RECORDS.md in place.
+
+Exit status: 0 clean, 1 findings or drift, 2 usage/parse errors.
+Pure stdlib + the stdlib-only ``observe/schemas.py`` — no jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional, Sequence
+
+from tensorflow_distributed_tpu.analysis.lint import (
+    PACKAGE_ROOT, lint_paths)
+from tensorflow_distributed_tpu.analysis.rules import Finding, telemetry
+from tensorflow_distributed_tpu.observe import schemas
+
+RECORDS_MD = os.path.join(os.path.dirname(PACKAGE_ROOT), "RECORDS.md")
+
+_SCHEMA_RULES = frozenset({
+    telemetry.RULE_KIND, telemetry.RULE_FIELD,
+    telemetry.RULE_REQUIRED, telemetry.RULE_READ,
+})
+
+
+def schema_findings(paths: Sequence[str]) -> List[Finding]:
+    """The telemetry-contract subset of a lint run over ``paths``."""
+    return [f for f in lint_paths(paths) if f.rule in _SCHEMA_RULES]
+
+
+def records_md_drift(path: str = RECORDS_MD) -> bool:
+    """True when RECORDS.md does not match the registry's rendering."""
+    want = schemas.render_records_md()
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            have = f.read()
+    except OSError:
+        return True
+    return have != want
+
+
+def update_records_md(path: str = RECORDS_MD) -> str:
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(schemas.render_records_md())
+    return path
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tensorflow_distributed_tpu.analysis.schema",
+        description="telemetry schema contract: emit sites and "
+                    "consumers vs observe/schemas.py, plus the "
+                    "RECORDS.md drift gate")
+    parser.add_argument("paths", nargs="*", default=None,
+                        help="files or directories (default: the "
+                             "package itself)")
+    parser.add_argument("--update", action="store_true",
+                        help="regenerate RECORDS.md from the schema "
+                             "registry and exit")
+    args = parser.parse_args(argv)
+    if args.update:
+        print(f"schema: wrote {update_records_md()}")
+        return 0
+    paths = args.paths or [PACKAGE_ROOT]
+    try:
+        findings = schema_findings(paths)
+    except (OSError, SyntaxError) as e:
+        print(f"schema: {e}", file=sys.stderr)
+        return 2
+    for f in findings:
+        print(f.render())
+    rc = 0
+    if findings:
+        print(f"schema: {len(findings)} finding"
+              f"{'s' if len(findings) != 1 else ''}", file=sys.stderr)
+        rc = 1
+    if not args.paths and records_md_drift():
+        print("schema: DRIFT — RECORDS.md does not match "
+              "observe/schemas.py; regenerate with --update",
+              file=sys.stderr)
+        rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
+import functools
 from typing import Optional, Sequence
 
 
@@ -1756,6 +1757,31 @@ def _add_dataclass_args(parser: argparse.ArgumentParser, cls, prefix: str = "") 
             parser.add_argument(name, type=float, default=None)
         else:
             parser.add_argument(name, type=type(default), default=default)
+
+
+@functools.lru_cache(maxsize=None)
+def known_flags() -> frozenset:
+    """Every ``--flag`` spelling the CLI parses — THE flag namespace
+    of the parent->child argv protocol. The supervisor and the fleet
+    controller spell child flags through :func:`child_flag`, and the
+    argv lint (``analysis/rules/argvproto.py``) verifies every flag
+    literal they construct is in this set."""
+    parser = argparse.ArgumentParser(add_help=False)
+    _add_dataclass_args(parser, TrainConfig)
+    return frozenset(parser._option_string_actions)
+
+
+def child_flag(path: str) -> str:
+    """The blessed child-argv spelling for a config field: dotted
+    dataclass path in, ``--flag`` out (``"mesh.data"`` ->
+    ``"--mesh.data"``, ``"checkpoint_dir"`` -> ``"--checkpoint-dir"``).
+    Raises KeyError for a field the CLI does not parse, so a typo'd
+    parent flag fails at construction, not as a child crash loop."""
+    flag = "--" + path.replace("_", "-")
+    if flag not in known_flags():
+        raise KeyError(
+            f"{flag!r} (from {path!r}) is not parsed by config.py")
+    return flag
 
 
 def parse_args(argv: Optional[Sequence[str]] = None) -> TrainConfig:

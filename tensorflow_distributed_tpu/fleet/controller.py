@@ -39,6 +39,7 @@ import sys
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
+from tensorflow_distributed_tpu.config import child_flag
 from tensorflow_distributed_tpu.fleet.replica import ReplicaHandle
 
 #: Native checkpoints are atomic dirs with a state.msgpack; orbax ones
@@ -176,16 +177,17 @@ class FleetController:
         args = build_leg_args(self.base_args + m.extra_args,
                               m.restarts)
         args += [
-            "--serve.inbox", h.inbox,
-            "--serve.journal", h.journal,
-            "--observe.export-path", h.snapshot,
-            "--observe.export-every", str(self.cfg.export_every_s),
-            "--observe.metrics-jsonl", h.metrics,
+            child_flag("serve.inbox"), h.inbox,
+            child_flag("serve.journal"), h.journal,
+            child_flag("observe.export_path"), h.snapshot,
+            child_flag("observe.export_every"),
+            str(self.cfg.export_every_s),
+            child_flag("observe.metrics_jsonl"), h.metrics,
         ]
         if self.cfg.replica_trace:
             args += [
-                "--observe.trace", h.trace,
-                "--observe.trace-durable", "true",
+                child_flag("observe.trace"), h.trace,
+                child_flag("observe.trace_durable"), "true",
             ]
         return [sys.executable, "-m",
                 "tensorflow_distributed_tpu.cli", *args]

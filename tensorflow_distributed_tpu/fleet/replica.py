@@ -37,6 +37,8 @@ import os
 import time
 from typing import Any, Dict, List, Optional
 
+from tensorflow_distributed_tpu.utils.atomicio import durable_append
+
 #: Control commands a replica's scheduler understands (see
 #: serve/scheduler.py): ``swap`` = live weight swap from the newest
 #: verifiable checkpoint; ``drain`` = finish in-flight work, accept
@@ -48,10 +50,10 @@ COMMANDS = ("swap", "drain", "cancel", "hold_export")
 
 def append_line(path: str, obj: Dict[str, Any]) -> None:
     """Append one JSON line, flushed to the OS — the inbox write side
-    (single writer per file; the reader tolerates a torn tail)."""
-    with open(path, "a") as f:
-        f.write(json.dumps(obj) + "\n")
-        f.flush()
+    (single writer per file; the reader tolerates a torn tail).
+    Delegates to the blessed :func:`durable_append` so every
+    cross-process append in the repo shares one spelling."""
+    durable_append(path, obj)
 
 
 class InboxFeed:

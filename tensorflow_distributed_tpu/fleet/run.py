@@ -48,6 +48,7 @@ import sys
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from tensorflow_distributed_tpu.utils.atomicio import atomic_write_json
 from tensorflow_distributed_tpu.fleet.controller import (
     ControllerConfig, FleetController)
 from tensorflow_distributed_tpu.fleet.replica import ReplicaHandle
@@ -189,10 +190,7 @@ def run_fleet(*, fleet_dir: str, replicas: int,
         """Atomic (tmp+rename) control-plane snapshot — a poller
         always reads a complete payload, never a torn write."""
         snap = router.fleet_snapshot(now)
-        tmp = obs.export_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(snap, f)
-        os.replace(tmp, obs.export_path)
+        atomic_write_json(obs.export_path, snap)
         if emit is not None:
             emit("fleet_snapshot", **snap)
     clock = time.monotonic

@@ -51,6 +51,7 @@ import os
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from tensorflow_distributed_tpu.utils.atomicio import atomic_write_json
 from tensorflow_distributed_tpu.observe.trace import (
     ChromeTracer, load_trace, unbalanced_async)
 
@@ -342,10 +343,8 @@ def stitch(router_path: str,
 
     merged.sort(key=lambda e: (e.get("ph") != "M",
                                float(e.get("ts", 0.0))))
-    tmp = out_path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump({"traceEvents": merged, "displayTimeUnit": "ms"}, f)
-    os.replace(tmp, out_path)
+    atomic_write_json(out_path, {"traceEvents": merged,
+                                 "displayTimeUnit": "ms"})
     return {
         "sources": len(sources),
         "skipped": len(skipped),

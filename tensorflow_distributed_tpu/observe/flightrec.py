@@ -54,6 +54,8 @@ import time
 import traceback
 from typing import Any, Dict, Iterator, Mapping, Optional
 
+from tensorflow_distributed_tpu.utils.atomicio import atomic_write_jsonl
+
 #: Record kinds kept in per-kind tails beyond the ring (the "last
 #: known good" lines a postmortem wants even when the ring has churned
 #: past them).
@@ -222,14 +224,10 @@ class FlightRecorder:
         """Atomic ring snapshot (tmp + fsync + rename): the file a
         poller or a post-SIGKILL supervisor reads is always a complete
         bundle, never a torn write."""
-        tmp = self.snapshot_path + ".tmp"
         try:
-            with open(tmp, "w") as f:
-                for line in self._bundle_lines("snapshot"):
-                    f.write(json.dumps(line, default=str) + "\n")
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, self.snapshot_path)
+            atomic_write_jsonl(self.snapshot_path,
+                               self._bundle_lines("snapshot"),
+                               default=str)
         except OSError:
             # Telemetry must never take down the run it observes.
             pass
@@ -245,6 +243,10 @@ class FlightRecorder:
         if self.dumped is not None:
             return self.dumped
         try:
+            # Straight-through on purpose (per-line durability over
+            # atomicity): a death mid-dump still leaves every complete
+            # line, and load_bundle tolerates the torn tail.
+            # graftcheck: disable=raw-write-to-shared-path -- postmortem dump favors per-line durability over atomicity
             with open(self.bundle_path, "w") as f:
                 for line in self._bundle_lines(
                         "postmortem", reason=reason, signum=signum,

@@ -158,7 +158,8 @@ class ServeObservatory:
             sinks.append(FlightRecorderSink(self.flightrec))
         self.registry = MetricsRegistry(
             sinks, enabled=chief, tags=tags or {},
-            max_records=ocfg.max_records)
+            max_records=ocfg.max_records,
+            validate=bool(getattr(run_config, "check", False)))
         # Online anomaly detection on the decode-step clock
         # (observe/anomaly.py): the scheduler feeds TTFT / decode-wall
         # / queue-depth samples it already has on host; "anomaly"
@@ -263,9 +264,13 @@ class Observatory:
                 sinks.append(FlightRecorderSink(self.flightrec))
             window, max_records = ocfg.window, ocfg.max_records
             trace_path = ocfg.trace
-        self.registry = MetricsRegistry(sinks, enabled=chief,
-                                        tags=tags or {},
-                                        max_records=max_records)
+        self.registry = MetricsRegistry(
+            sinks, enabled=chief, tags=tags or {},
+            max_records=max_records,
+            # --check arms per-record schema validation: every emit is
+            # checked against observe/schemas.py and a violation
+            # raises instead of landing in the artifact.
+            validate=bool(getattr(run_config, "check", False)))
         # Online anomaly detection (observe/anomaly.py): fed from
         # log_step / health records below — values the loop already
         # fetched; zero new host transfers.

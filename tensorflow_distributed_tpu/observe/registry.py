@@ -265,9 +265,11 @@ class MetricsRegistry:
 
     def __init__(self, sinks: Iterable[Sink] = (), enabled: bool = True,
                  tags: Optional[Mapping[str, Any]] = None,
-                 max_records: int = 100_000, clock=time.time):
+                 max_records: int = 100_000, clock=time.time,
+                 validate: bool = False):
         self.sinks = list(sinks)
         self.enabled = enabled
+        self.validate = validate
         self.tags = dict(tags or {})
         self.records: collections.deque = collections.deque(
             maxlen=max_records)
@@ -286,6 +288,17 @@ class MetricsRegistry:
             "t": round(self._clock() - self._t0, 6),
             **self.tags, **fields,
         }
+        if self.validate:
+            # Armed under --check only (observe.hub): a record that
+            # violates observe/schemas.py is a bug in the EMITTER, and
+            # check mode exists to surface exactly that class of bug
+            # loudly instead of shipping a malformed artifact.
+            from tensorflow_distributed_tpu.observe import schemas
+            errors = schemas.validate_record(event, rec)
+            if errors:
+                raise ValueError(
+                    f"observe record {event!r} violates its declared "
+                    f"schema: " + "; ".join(errors))
         with self._lock:
             self.records.append(rec)
             if self.enabled:

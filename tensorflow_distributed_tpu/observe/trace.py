@@ -34,6 +34,8 @@ import threading
 import time
 from typing import Any, Dict, Iterator, List, Optional
 
+from tensorflow_distributed_tpu.utils.atomicio import atomic_write_json
+
 _FLUSH_INTERVAL_S = 5.0  # min seconds between incremental rewrites:
                          # each flush rewrites the whole accumulated
                          # buffer, so an event-count trigger would go
@@ -234,11 +236,8 @@ class ChromeTracer:
                 f"(max_events={self.max_events})", "cat": "host",
                 "pid": self.pid, "tid": 0,
                 "ts": round(self._ts(), 3), "s": "p"}]
-        tmp = self.path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump({"traceEvents": events,
-                       "displayTimeUnit": "ms"}, f)
-        os.replace(tmp, self.path)
+        atomic_write_json(self.path, {"traceEvents": events,
+                                      "displayTimeUnit": "ms"})
         self._last_flush = self._clock()
 
     def close(self) -> None:

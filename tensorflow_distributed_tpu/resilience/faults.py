@@ -69,6 +69,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from tensorflow_distributed_tpu.observe.registry import emit_event
+from tensorflow_distributed_tpu.utils.atomicio import atomic_write_json
 
 KINDS = ("nan_grad", "ckpt_io_fail", "data_stall", "sigterm", "sigkill",
          "device_loss", "decode_stall", "slot_nan", "reload")
@@ -282,10 +283,10 @@ class FaultPlan:
                    fault="device_loss", step=step, lost=lost,
                    mask_file=path)
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        with open(path, "w") as f:
-            json.dump({"lost": lost, "step": step}, f)
-            f.flush()
-            os.fsync(f.fileno())
+        # fsync'd BEFORE the rename and the rename before the kill:
+        # the supervisor that inherits this mask must never read a
+        # torn or empty file.
+        atomic_write_json(path, {"lost": lost, "step": step})
         os.kill(os.getpid(), signal.SIGKILL)
 
     # -- serve-phase injection points (step = the engine's decode step;

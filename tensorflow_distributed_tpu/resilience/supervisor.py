@@ -85,6 +85,11 @@ from typing import Dict, List, Optional, Sequence, Tuple
 # contract single-sourced between the drill that writes it and the
 # supervisor that reads it.
 from tensorflow_distributed_tpu.resilience.faults import device_mask_path
+from tensorflow_distributed_tpu.utils.atomicio import durable_append
+# config is pure stdlib (no jax, no backend init): child_flag is the
+# argv contract — every flag the supervisor spells for a child is
+# checked against the namespace config.py actually parses.
+from tensorflow_distributed_tpu.config import child_flag
 
 _MESH_AXES = ("data", "model", "seq", "pipe", "expert")
 
@@ -104,7 +109,7 @@ def parse_mesh_args(args: Sequence[str]) -> Dict[str, int]:
     jax-free, unit-testable."""
     out = {a: (-1 if a == "data" else 1) for a in _MESH_AXES}
     for name in out:
-        v = _child_flag_value(args, f"--mesh.{name}")
+        v = _child_flag_value(args, child_flag(f"mesh.{name}"))
         if v is not None:
             out[name] = int(v)
     return out
@@ -144,7 +149,7 @@ def rewrite_mesh_args(args: Sequence[str], mesh: Dict[str, int]
     child gets the EXPLICIT width the supervisor chose). Pure."""
     out = list(args)
     for name in _MESH_AXES:
-        flag = f"--mesh.{name}"
+        flag = child_flag(f"mesh.{name}")
         sval = str(int(mesh[name]))
         replaced = False
         i = 0
@@ -176,7 +181,7 @@ def plan_elastic(child_args: Sequence[str], total: int, masked: int
     child's real global batch fails its startup validation and turns
     every leg into the crash loop --elastic exists to prevent)."""
     alive = total - masked
-    batch = _child_flag_value(child_args, "--batch-size")
+    batch = _child_flag_value(child_args, child_flag("batch_size"))
     mesh = pick_elastic_mesh(
         parse_mesh_args(child_args), alive,
         int(batch) if batch is not None else _default_batch_size())
@@ -237,11 +242,11 @@ def build_leg_args(child_args: Sequence[str], restarts: int
     journal, which the identical ``--serve.journal`` path makes a
     resume by construction."""
     args = list(child_args)
-    serve = _child_flag_value(args, "--mode") == "serve"
-    ckpt_dir = _child_flag_value(args, "--checkpoint-dir")
+    serve = _child_flag_value(args, child_flag("mode")) == "serve"
+    ckpt_dir = _child_flag_value(args, child_flag("checkpoint_dir"))
     if (restarts > 0 and not serve and ckpt_dir
-            and _child_flag_value(args, "--resume") is None):
-        args += ["--resume", "true"]
+            and _child_flag_value(args, child_flag("resume")) is None):
+        args += [child_flag("resume"), "true"]
     return args
 
 
@@ -263,12 +268,11 @@ def _leg_bundle(flight_dir: Optional[str], since: float
         return None
 
 
-def _append_event(path: Optional[str], record: dict) -> None:
-    if not path:
+def _append_event(jsonl_path: Optional[str], record: dict) -> None:
+    if not jsonl_path:
         return
     try:
-        with open(path, "a") as f:
-            f.write(json.dumps(record) + "\n")
+        durable_append(jsonl_path, record)
     except OSError:
         pass  # the event also went to stdout; never kill the
         #       supervisor over its own bookkeeping
@@ -304,7 +308,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     child_args = argv[split + 1:]
 
     if (opts.elastic
-            and _child_flag_value(child_args, "--plan") == "auto"):
+            and _child_flag_value(child_args, child_flag("plan")) == "auto"):
         # Two mesh owners: --elastic pins --mesh.* to the surviving
         # devices on EVERY leg, which the child's "--plan auto owns
         # the mesh" config guard rejects — the child would die at
@@ -319,11 +323,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
               "--elastic.", file=sys.stderr)
         return 2
 
-    ckpt_dir = _child_flag_value(child_args, "--checkpoint-dir")
-    jsonl = _child_flag_value(child_args, "--observe.metrics-jsonl")
-    flight_dir = _child_flag_value(child_args, "--observe.flightrec")
-    serve = _child_flag_value(child_args, "--mode") == "serve"
-    if serve and not _child_flag_value(child_args, "--serve.journal"):
+    ckpt_dir = _child_flag_value(child_args, child_flag("checkpoint_dir"))
+    jsonl = _child_flag_value(child_args,
+                              child_flag("observe.metrics_jsonl"))
+    flight_dir = _child_flag_value(child_args,
+                                   child_flag("observe.flightrec"))
+    serve = _child_flag_value(child_args, child_flag("mode")) == "serve"
+    if serve and not _child_flag_value(child_args,
+                                       child_flag("serve.journal")):
         print("[supervisor] WARNING: serve child has no "
               "--serve.journal — restarts will re-serve the whole "
               "workload from scratch (in-flight and even finished "
@@ -449,7 +456,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                   "leg": restarts, "rc": rc,
                   "backoff_s": round(delay, 3),
                   "resume": bool(_child_flag_value(
-                      child_args, "--serve.journal")) if serve
+                      child_args, child_flag("serve.journal"))) if serve
                   else bool(ckpt_dir),
                   **bundle_extra}
         print(f"[supervisor] {json.dumps(record)}", flush=True)

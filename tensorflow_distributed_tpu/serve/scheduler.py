@@ -98,6 +98,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from tensorflow_distributed_tpu.utils.atomicio import atomic_write_json
 from tensorflow_distributed_tpu.observe.slo import percentile
 from tensorflow_distributed_tpu.serve.buckets import pick_bucket
 from tensorflow_distributed_tpu.serve.engine import SlotDecodeEngine
@@ -1216,10 +1217,7 @@ class Scheduler:
         snap = self.metrics_snapshot()
         self._emit("metrics_snapshot", **snap)
         if self.export_path:
-            tmp = self.export_path + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(snap, f)
-            os.replace(tmp, self.export_path)
+            atomic_write_json(self.export_path, snap)
 
     def status_line(self) -> str:
         """The periodic one-line live status: occupancy, queue depth,

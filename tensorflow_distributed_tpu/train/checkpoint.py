@@ -48,6 +48,7 @@ from flax import serialization
 
 from tensorflow_distributed_tpu.observe import goodput as _goodput
 from tensorflow_distributed_tpu.observe.registry import emit_event
+from tensorflow_distributed_tpu.utils.atomicio import atomic_write_json
 from tensorflow_distributed_tpu.parallel.mesh import (
     is_chief, mesh_shape_dict)
 
@@ -380,9 +381,8 @@ def orbax_wait() -> None:
             # The mesh manifest lands WITH the commit marker (both
             # chief-written, post-confirmation), so an unmarked crashed
             # save never carries a manifest either.
-            with open(os.path.join(step_path, _MESH_MANIFEST),
-                      "w") as f:
-                json.dump(mesh_manifest, f)
+            atomic_write_json(os.path.join(step_path, _MESH_MANIFEST),
+                              mesh_manifest)
         marker = os.path.join(step_path, _ORBAX_MARKER)
         with open(marker, "w"):
             pass
@@ -480,15 +480,14 @@ def _write(ckpt_dir: str, step: int, host_state: Any, keep: int,
         os.makedirs(tmp)
         with open(os.path.join(tmp, "state.msgpack"), "wb") as f:
             f.write(blob)
-        with open(os.path.join(tmp, "manifest.json"), "w") as f:
-            json.dump(manifest, f)
+        atomic_write_json(os.path.join(tmp, "manifest.json"), manifest)
         if mesh_manifest is not None:
             # Mesh/sharding manifest beside the sha256 manifest: the
             # topology and per-leaf layout the state was WRITTEN with,
             # so restore_resharded (and the operator) can reason about
             # mesh compatibility without decoding the blob.
-            with open(os.path.join(tmp, _MESH_MANIFEST), "w") as f:
-                json.dump(mesh_manifest, f)
+            atomic_write_json(os.path.join(tmp, _MESH_MANIFEST),
+                              mesh_manifest)
         if os.path.exists(final):
             shutil.rmtree(final)
         os.rename(tmp, final)

@@ -1,0 +1,122 @@
+"""Blessed writers for cross-process files — tmp + fsync + rename.
+
+Several processes coordinate through files in this codebase: the
+serve scheduler's `--observe.export-path` snapshot polled by
+`fleet/router.py`, the fleet control-plane feed, replica inboxes and
+request journals, Perfetto trace files, the resilience device-mask,
+checkpoint manifests, calibration profiles, supervisor journals. A
+raw ``open(path, "w")`` on any of those is a torn-read bug waiting
+for a poller (or a post-SIGKILL supervisor) to hit it.
+
+This module is the ONE place the tmp+fsync+rename idiom lives:
+
+* :func:`atomic_write_json` / :func:`atomic_write_jsonl` — replace
+  the whole file atomically. The reader always sees a complete
+  payload, never a torn write; the fsync before the rename means a
+  crash cannot leave an EMPTY renamed file either.
+* :func:`durable_append` — one JSON line, flushed to the OS. Append
+  streams (journals, inboxes, supervisor event logs) get process-kill
+  durability; fsync-per-line is deliberately NOT done — it would only
+  add OS-crash coverage these streams do not promise, at a latency
+  cost on the serving hot path (see serve/journal.py).
+
+``analysis/rules/durability.py`` enforces the split: a direct write
+to a declared path family outside this module is a lint finding
+(`raw-write-to-shared-path`), and an ``os.replace``/``os.rename``
+onto one without an fsync in the same function is
+`missing-fsync-on-durable-path`. Intentionally-raw writes carry a
+``# graftcheck: disable=raw-write-to-shared-path -- <reason>``.
+
+Pure stdlib — the supervisor and the lint tier import this without
+jax.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Iterable, Optional, Tuple
+
+__all__ = ["PATH_FAMILIES", "atomic_write_json", "atomic_write_jsonl",
+           "durable_append"]
+
+#: Declared cross-process path families: (family, file_re, expr_re).
+#: ``file_re`` scopes a family to one module ("" = any); ``expr_re``
+#: matches the path EXPRESSION at the write site (source text, after
+#: resolving one local assignment hop). The durability lint flags raw
+#: writes whose path expression matches a family for its file.
+PATH_FAMILIES: Tuple[Tuple[str, str, str], ...] = (
+    ("export-path", "", r"export_path"),
+    ("fleet-snapshot", "", r"snapshot_path"),
+    ("inbox", "", r"inbox"),
+    ("journal", "", r"journal_path"),
+    ("metrics-jsonl", "", r"metrics_jsonl|jsonl_path"),
+    ("trace-file", r"observe/trace\.py$", r"self\.path"),
+    ("trace-file", r"observe/fleet_trace\.py$", r"out_path"),
+    ("trace-file", "", r"trace_path"),
+    ("device-mask", "", r"device_mask|mask_file|mask_path"),
+    ("ckpt-manifest", "", r"manifest"),
+    ("flight-bundle", "", r"bundle_path"),
+    ("calibration-profile", r"analysis/planner/calibrate\.py$",
+     r"\bpath\b"),
+)
+
+
+def _fsync_dir(path: str) -> None:
+    """Best-effort fsync of ``path``'s directory so the rename itself
+    survives an OS crash (not just the file contents)."""
+    d = os.path.dirname(os.path.abspath(path))
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write_json(path: str, obj: Any, *, indent: Optional[int] = None,
+                      trailing_newline: bool = False,
+                      default: Any = None) -> str:
+    """Atomically replace ``path`` with ``obj`` as JSON.
+
+    tmp file is ``<path>.tmp.<pid>`` (pid-suffixed so two writers
+    racing on the same target never tear each other's staging file);
+    contents are fsync'd before the rename. Returns ``path``.
+    """
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=indent, default=default)
+        if trailing_newline:
+            f.write("\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def atomic_write_jsonl(path: str, records: Iterable[Any], *,
+                       default: Any = None) -> str:
+    """Atomically replace ``path`` with one JSON object per line."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec, default=default) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def durable_append(path: str, record: Any) -> None:
+    """Append one JSON line, flushed to the OS (single writer per
+    file; readers tolerate a torn tail). Process-kill durable; NOT
+    fsync'd — see the module docstring for why."""
+    # The blessed appender IS the allowed raw-write site.
+    # graftcheck: disable=raw-write-to-shared-path -- this helper is the blessed appender
+    with open(path, "a") as f:
+        f.write(json.dumps(record) + "\n")
+        f.flush()

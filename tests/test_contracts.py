@@ -1,0 +1,504 @@
+"""graftcheck v2: the cross-process contract passes.
+
+Three new self-hosting rule families under test, plus the machinery
+they check against:
+
+- **telemetry schema contract** (analysis/rules/telemetry.py +
+  observe/schemas.py): every ``emit``/``emit_event``/record-literal
+  producer writes only declared fields, every cross-process consumer
+  reads only fields some producer declares, and the generated
+  RECORDS.md tracks the registry byte-for-byte.
+- **durability lint** (analysis/rules/durability.py +
+  utils/atomicio.py): raw writes to a declared cross-process path
+  family must go through the blessed atomic/durable helpers.
+- **argv protocol contract** (analysis/rules/argvproto.py +
+  config.known_flags/child_flag): every flag literal the supervisor
+  and fleet controller spell for a child is a flag ``config.py``
+  actually parses.
+
+All rule fixtures are jax-free (the passes are pure stdlib by
+contract — the poisoned-import subprocess test proves it), and the
+SELF-HOSTING pins hold the real tree clean under each pass.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from tensorflow_distributed_tpu.analysis.lint import (
+    PACKAGE_ROOT, lint_paths, lint_source)
+from tensorflow_distributed_tpu.analysis import schema as schema_cli
+from tensorflow_distributed_tpu.observe import schemas
+from tensorflow_distributed_tpu.utils.atomicio import (
+    atomic_write_json, atomic_write_jsonl, durable_append)
+
+
+def findings(src: str, path: str = "mod.py"):
+    return lint_source(textwrap.dedent(src), path)
+
+
+def rules_of(src: str, path: str = "mod.py"):
+    return [f.rule for f in findings(src, path)]
+
+
+# --- telemetry: producer pass ------------------------------------------
+
+def test_undeclared_record_kind():
+    src = """
+    from tensorflow_distributed_tpu.observe.registry import emit_event
+
+    def f():
+        emit_event("totally_unknown_kind", step=1)
+    """
+    assert rules_of(src) == ["undeclared-record-kind"]
+
+
+def test_undeclared_record_field():
+    src = """
+    def f(registry):
+        registry.emit("health", module="lm", step=3, bogus_field=1)
+    """
+    assert rules_of(src) == ["undeclared-record-field"]
+
+
+def test_missing_required_field():
+    # health requires module + step; step alone is a producer bug.
+    src = """
+    def f(registry):
+        registry.emit("health", step=3)
+    """
+    assert rules_of(src) == ["missing-required-field"]
+
+
+def test_splat_disables_required_check():
+    # A ** splat may carry the required fields — only literal kwargs
+    # are checkable, so the required check stands down (undeclared
+    # literal kwargs are still flagged).
+    src = """
+    def f(registry, extra):
+        registry.emit("health", **extra)
+    """
+    assert rules_of(src) == []
+
+
+def test_declared_emit_is_clean():
+    src = """
+    def f(registry):
+        registry.emit("health", module="lm", step=3, grad_norm=0.5)
+    """
+    assert rules_of(src) == []
+
+
+def test_open_schema_allows_extra_fields():
+    # "step" is an open rollup kind: producers may splat beyond the
+    # table (the loop's computed metrics).
+    src = """
+    def f(registry):
+        registry.emit("step", step=1, loss=0.2, my_rollup=3.0)
+    """
+    assert rules_of(src) == []
+
+
+def test_pattern_fields_allowed():
+    src = """
+    def f(registry):
+        registry.emit("eval", step=1, val_loss=0.5, val_accuracy=0.9)
+    """
+    assert rules_of(src) == []
+
+
+def test_record_dict_literal_checked():
+    # The supervisor's journal records are plain dict literals with an
+    # "event" key — same contract, no emit call required.
+    src = """
+    def f():
+        return {"event": "recovery", "kind": "bad_kind_name"}
+    """
+    # An out-of-vocabulary recovery kind is an undeclared KIND — the
+    # recovery sub-vocabulary is part of the kind namespace.
+    assert rules_of(src) == ["undeclared-record-kind"]
+
+
+def test_recovery_kind_vocabulary():
+    good = """
+    def f(registry):
+        registry.emit("recovery", kind="restart", leg=2)
+    """
+    assert rules_of(good) == []
+
+
+def test_suppression_honored():
+    src = """
+    def f(registry):
+        # graftcheck: disable=undeclared-record-kind -- test-only kind
+        registry.emit("totally_unknown_kind", step=1)
+    """
+    assert rules_of(src) == []
+
+
+# --- telemetry: consumer pass ------------------------------------------
+
+def test_consumer_read_checked_in_consumer_modules():
+    src = """
+    def summarize(rec):
+        return rec.get("field_nobody_declares")
+    """
+    assert rules_of(src, "observe/report.py") == [
+        "undeclared-consumer-read"]
+    # Same source outside the consumer set: not a cross-process
+    # reader, not checked.
+    assert rules_of(src, "observe/somewhere_else.py") == []
+
+
+def test_consumer_subscript_read_checked():
+    src = """
+    def summarize(rec):
+        return rec["field_nobody_declares"]
+    """
+    assert rules_of(src, "fleet/router.py") == [
+        "undeclared-consumer-read"]
+
+
+def test_consumer_declared_reads_clean():
+    src = """
+    def summarize(rec):
+        return (rec.get("step"), rec.get("grad_norm"),
+                rec["event"], rec.get("kind"))
+    """
+    assert rules_of(src, "observe/report.py") == []
+
+
+# --- durability lint ---------------------------------------------------
+
+def test_raw_write_to_shared_path():
+    src = """
+    import json
+
+    def export(export_path, snap):
+        with open(export_path, "w") as f:
+            json.dump(snap, f)
+    """
+    assert rules_of(src, "serve/thing.py") == [
+        "raw-write-to-shared-path"]
+
+
+def test_replace_without_fsync():
+    src = """
+    import json, os
+
+    def export(export_path, snap):
+        tmp = export_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(snap, f)
+        os.replace(tmp, export_path)
+    """
+    rules = rules_of(src, "serve/thing.py")
+    assert "missing-fsync-on-durable-path" in rules
+
+
+def test_replace_with_fsync_only_flags_raw_open():
+    src = """
+    import json, os
+
+    def export(export_path, snap):
+        tmp = export_path + ".tmp"
+        f = open(tmp, "w")
+        json.dump(snap, f)
+        f.flush()
+        os.fsync(f.fileno())
+        f.close()
+        os.replace(tmp, export_path)
+    """
+    # The tmp+fsync+rename idiom spelled by hand: no fsync finding,
+    # but the open against a family-matching name is still steered to
+    # the blessed helper.
+    assert rules_of(src, "serve/thing.py") == [
+        "raw-write-to-shared-path"]
+
+
+def test_read_mode_and_unrelated_paths_clean():
+    src = """
+    import json
+
+    def load(export_path, scratch):
+        with open(export_path) as f:
+            data = json.load(f)
+        with open(scratch, "w") as f:
+            json.dump(data, f)
+        return data
+    """
+    assert rules_of(src, "serve/thing.py") == []
+
+
+def test_family_resolved_through_local_assignment():
+    src = """
+    import json
+
+    def export(cfg, snap):
+        path = cfg.export_path
+        with open(path, "w") as f:
+            json.dump(snap, f)
+    """
+    assert rules_of(src, "serve/thing.py") == [
+        "raw-write-to-shared-path"]
+
+
+def test_atomicio_module_exempt():
+    src = """
+    import json, os
+
+    def atomic_write_json(path, obj):
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(obj, f)
+        os.replace(tmp, path)
+    """
+    assert rules_of(src, "utils/atomicio.py") == []
+
+
+# --- argv protocol -----------------------------------------------------
+
+def test_unparsed_child_flag_literal():
+    src = """
+    def build(args):
+        return list(args) + ["--no-such-flag", "1"]
+    """
+    assert rules_of(src, "resilience/supervisor.py") == [
+        "unparsed-child-flag"]
+    # Outside the argv-constructing modules, plain "--" literals are
+    # someone else's CLI — not checked.
+    assert rules_of(src, "serve/run.py") == []
+
+
+def test_known_child_flags_clean():
+    src = """
+    def build(args):
+        return list(args) + ["--checkpoint-dir", "/tmp/ck",
+                             "--observe.metrics-jsonl", "m.jsonl"]
+    """
+    assert rules_of(src, "fleet/controller.py") == []
+
+
+def test_fstring_flag_prefix_checked():
+    good = """
+    def mesh_flags(mesh):
+        return [f"--mesh.{name}" for name in mesh]
+    """
+    assert rules_of(good, "resilience/supervisor.py") == []
+    bad = """
+    def mesh_flags(mesh):
+        return [f"--bogus.{name}" for name in mesh]
+    """
+    assert rules_of(bad, "resilience/supervisor.py") == [
+        "unparsed-child-flag"]
+
+
+def test_child_flag_helper_checked_everywhere():
+    bad = """
+    from tensorflow_distributed_tpu.config import child_flag
+
+    def f():
+        return child_flag("no_such_flag")
+    """
+    assert rules_of(bad, "serve/whatever.py") == ["unparsed-child-flag"]
+    good = bad.replace("no_such_flag", "batch_size")
+    assert rules_of(good, "serve/whatever.py") == []
+
+
+def test_child_flag_runtime_contract():
+    from tensorflow_distributed_tpu.config import child_flag, known_flags
+
+    assert child_flag("observe.metrics_jsonl") == \
+        "--observe.metrics-jsonl"
+    assert child_flag("batch_size") == "--batch-size"
+    assert "--mesh.data" in known_flags()
+    with pytest.raises(KeyError):
+        child_flag("no_such_flag")
+
+
+def test_supervisor_and_controller_share_flag_spelling():
+    """The carried ROADMAP item: both child-argv constructors route
+    through config.child_flag, so every flag they spell parses."""
+    from tensorflow_distributed_tpu.config import known_flags
+    from tensorflow_distributed_tpu.resilience.supervisor import (
+        build_leg_args)
+
+    args = build_leg_args(
+        ["--mode", "train", "--checkpoint-dir", "/tmp/ck"], restarts=1)
+    flags = {a for a in args if a.startswith("--")}
+    assert "--resume" in flags
+    assert flags <= known_flags()
+
+
+# --- utils/atomicio ----------------------------------------------------
+
+def test_atomic_write_json_roundtrip(tmp_path):
+    path = str(tmp_path / "snap.json")
+    obj = {"a": 1, "b": [1, 2, 3]}
+    assert atomic_write_json(path, obj) == path
+    with open(path) as f:
+        assert json.load(f) == obj
+    # No tmp litter: the pid-suffixed staging file was renamed away.
+    assert os.listdir(tmp_path) == ["snap.json"]
+
+
+def test_atomic_write_json_indent_and_newline(tmp_path):
+    path = str(tmp_path / "profile.json")
+    atomic_write_json(path, {"k": 1}, indent=2, trailing_newline=True)
+    text = open(path).read()
+    assert text.endswith("\n") and "\n  " in text
+
+
+def test_atomic_write_jsonl(tmp_path):
+    path = str(tmp_path / "bundle.jsonl")
+    atomic_write_jsonl(path, [{"i": 0}, {"i": 1}])
+    lines = [json.loads(x) for x in open(path).read().splitlines()]
+    assert lines == [{"i": 0}, {"i": 1}]
+
+
+def test_durable_append(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    durable_append(path, {"event": "recovery", "kind": "restart"})
+    durable_append(path, {"event": "recovery", "kind": "rewind"})
+    lines = [json.loads(x) for x in open(path).read().splitlines()]
+    assert [l["kind"] for l in lines] == ["restart", "rewind"]
+
+
+# --- observe/schemas: runtime validation -------------------------------
+
+def test_validate_record_accepts_declared():
+    rec = {"event": "health", "t": 0.1, "process_index": 0,
+           "module": "lm", "step": 3, "grad_norm": 0.5}
+    assert schemas.validate_record("health", rec) == []
+
+
+def test_validate_record_catches_violations():
+    assert schemas.validate_record("no_such_kind", {"event": "x"})
+    assert schemas.validate_record(
+        "health", {"event": "health", "step": 1})      # missing module
+    errs = schemas.validate_record(
+        "health", {"event": "health", "module": "lm", "step": 1,
+                   "bogus": 1})
+    assert any("bogus" in e for e in errs)
+    # Explicit null in a non-nullable field is a producer bug.
+    errs = schemas.validate_record(
+        "health", {"event": "health", "module": None, "step": 1})
+    assert errs
+
+
+def test_validate_record_open_and_patterns():
+    assert schemas.validate_record(
+        "step", {"event": "step", "step": 1, "loss": 0.1,
+                 "anything_extra": 2}) == []
+    assert schemas.validate_record(
+        "eval", {"event": "eval", "step": 1, "val_loss": 0.2}) == []
+
+
+def test_registry_validate_raises_on_bad_emit():
+    from tensorflow_distributed_tpu.observe.registry import (
+        MetricsRegistry)
+
+    reg = MetricsRegistry(validate=True)
+    reg.emit("health", module="lm", step=1)
+    with pytest.raises(ValueError, match="bogus"):
+        reg.emit("health", module="lm", step=1, bogus=1)
+    # Off by default: the same emit is accepted (library inspection
+    # paths and tests construct ad-hoc records freely).
+    MetricsRegistry().emit("health", module="lm", step=1, bogus=1)
+
+
+# --- RECORDS.md generation ---------------------------------------------
+
+def test_records_md_is_generated_and_current():
+    """The drift gate's clean pin: the committed RECORDS.md equals the
+    registry rendering byte-for-byte."""
+    assert not schema_cli.records_md_drift()
+
+
+def test_records_md_update_flow(tmp_path):
+    path = str(tmp_path / "RECORDS.md")
+    assert schema_cli.records_md_drift(path)        # absent = drift
+    schema_cli.update_records_md(path)
+    assert not schema_cli.records_md_drift(path)
+    text = open(path).read()
+    # Every registry-emitted kind is documented.
+    for s in schemas.SCHEMAS:
+        assert f"`{s.kind}`" in text
+
+
+# --- CLI exit codes ----------------------------------------------------
+
+def test_schema_cli_exit_codes(tmp_path):
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text("def f(r):\n"
+                     "    r.emit('no_such_kind', step=1)\n")
+    clean = tmp_path / "clean.py"
+    clean.write_text("def f(r):\n"
+                     "    r.emit('health', module='lm', step=1)\n")
+    assert schema_cli.main([str(dirty)]) == 1
+    assert schema_cli.main([str(clean)]) == 0
+
+
+def test_schema_cli_default_run_is_clean():
+    """SELF-HOSTING + drift gate: the packaged tree and the committed
+    RECORDS.md pass the full schema CLI (what scripts/lint.sh runs)."""
+    assert schema_cli.main([]) == 0
+
+
+# --- jax-free contract -------------------------------------------------
+
+def test_contract_passes_are_jax_free():
+    """Schema registry, atomicio, config flag namespace, and the
+    schema CLI all import and run with jax poisoned away — the
+    supervisor/controller/lint tier must never touch a backend."""
+    code = textwrap.dedent("""
+        import builtins
+        real = builtins.__import__
+        def guard(name, *a, **k):
+            if name == "jax" or name.startswith("jax."):
+                raise ModuleNotFoundError(
+                    f"No module named {name!r}", name="jax")
+            return real(name, *a, **k)
+        builtins.__import__ = guard
+        from tensorflow_distributed_tpu.observe import schemas
+        assert schemas.validate_record(
+            "health", {"event": "health", "module": "m", "step": 1}
+        ) == []
+        from tensorflow_distributed_tpu.utils.atomicio import (
+            atomic_write_json)
+        from tensorflow_distributed_tpu.config import child_flag
+        assert child_flag("batch_size") == "--batch-size"
+        from tensorflow_distributed_tpu.analysis.schema import (
+            schema_findings)
+        from tensorflow_distributed_tpu.analysis.lint import lint_source
+        fs = lint_source(
+            "def f(r):\\n    r.emit('no_such_kind', x=1)\\n", "m.py")
+        assert [f.rule for f in fs] == ["undeclared-record-kind"], fs
+        print("OK")
+    """)
+    out = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "OK" in out.stdout
+
+
+# --- self-hosting pins -------------------------------------------------
+
+@pytest.mark.parametrize("rule_group", [
+    ("undeclared-record-kind", "undeclared-record-field",
+     "missing-required-field", "undeclared-consumer-read"),
+    ("raw-write-to-shared-path", "missing-fsync-on-durable-path"),
+    ("unparsed-child-flag",),
+])
+def test_repo_clean_under_pass(rule_group):
+    """Each contract pass holds the real tree clean (suppressions with
+    reasons excepted) — graftcheck v2 gates the code that ships it."""
+    hits = [f.render() for f in lint_paths([PACKAGE_ROOT])
+            if f.rule in rule_group]
+    assert hits == []
