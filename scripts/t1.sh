@@ -413,6 +413,40 @@ if [ "$fleetobs_rc" -ne 0 ]; then
        "— see $FLEETOBSLOG" >&2
 fi
 
+# Tunebench smoke (autopilot: wrong-knob serve converges back toward
+# the hand-tuned goodput under a shifting trace, a correctly-tuned
+# control run stays at zero knob changes, the speculation loop deepens
+# k on a perfect-accept draft, and token streams stay identical across
+# every live actuation — benchmarks/tunebench.py). Correctness phases
+# only: the CLI subprocess phase and the overhead A/B gate live in the
+# committed TUNEBENCH.json run, not here (subprocess spawn + timing at
+# smoke scale is noise). The convergence bar is loosened to 0.6 for
+# the same reason — the hand-tuned denominator swings ~2x with host
+# timing at this scale, while the wrong-knob run sits at ~0.3-0.5, so
+# 0.6 still separates converged from not; the committed TUNEBENCH.json
+# pins the real >=0.9 gate. Same abort-guard shape as the smokes above.
+TUNELOG="${TUNELOG:-/tmp/_t1_tune.log}"
+run_tunebench() {
+  rm -f "$TUNELOG"
+  timeout -k 10 300 env JAX_PLATFORMS=cpu python -m \
+    tensorflow_distributed_tpu.benchmarks.tunebench \
+    --phases goodput,control,spec --min-goodput-ratio 0.6 \
+    --out "" 2>&1 | tee "$TUNELOG"
+  return "${PIPESTATUS[0]}"
+}
+run_tunebench
+tune_rc=$?
+if ! grep -qa '"metric": "tune_checks"' "$TUNELOG"; then
+  echo "[t1] no tune_checks line in $TUNELOG (known container" \
+       "XLA:CPU abort) — rerunning tunebench once" >&2
+  run_tunebench
+  tune_rc=$?
+fi
+if [ "$tune_rc" -ne 0 ]; then
+  echo "[t1] tunebench smoke FAILED (tune_rc=$tune_rc) — see" \
+       "$TUNELOG" >&2
+fi
+
 # Regress smoke (cross-run regression ledger — observe/regress.py):
 # every committed artifact in the manifest compared against its own
 # HEAD baseline; an untouched tree must pass CLEAN, and any slide in
@@ -474,6 +508,9 @@ if [ "$rc" -eq 0 ] && [ "$fleet_rc" -ne 0 ]; then
 fi
 if [ "$rc" -eq 0 ] && [ "$fleetobs_rc" -ne 0 ]; then
   exit "$fleetobs_rc"
+fi
+if [ "$rc" -eq 0 ] && [ "$tune_rc" -ne 0 ]; then
+  exit "$tune_rc"
 fi
 if [ "$rc" -eq 0 ] && [ "$regress_rc" -ne 0 ]; then
   exit "$regress_rc"

@@ -76,6 +76,22 @@ Examples:
         --observe.slo "high:ttft_p95=100ms,tok_p50=30ms" \
         --observe.export-every 1 --observe.export-path serve.snap.json
 
+    # autopilot (observe/autopilot.py; README "Autopilot"): the online
+    # controller closing the calibrate→plan→act loop on the run's own
+    # telemetry — SLO burn drives admission, page-pool pressure the
+    # live slot cap, the rolling accept rate the speculation depth,
+    # and plan drift a calibration refit; every decision is an
+    # auditable `tune` record, every actuation token-identical (pin
+    # knobs it must not touch with --observe.autopilot-pin)
+    python -m tensorflow_distributed_tpu.cli --mode serve \
+        --model gpt_lm --serve.num-slots 4 --serve.num-requests 64 \
+        --serve.spec-tokens 4 --serve.policy slo \
+        --observe.autopilot true --observe.autopilot-every 25 \
+        --observe.autopilot-pin buckets \
+        --observe.autopilot-calibration serve.calibration.json \
+        --observe.metrics-jsonl serve.jsonl \
+        --observe.slo "ttft_p95=250ms"
+
     # fleet serving (fleet/; README "Fleet serving"): a health-aware
     # router + lifecycle controller over N replica processes — each
     # an ordinary --mode serve command with a per-epoch inbox/journal/

@@ -184,6 +184,32 @@ class ObserveConfig:
     # Snapshot cadence in records (anomaly/recovery records always
     # snapshot immediately).
     flightrec_snapshot_every: int = 50
+    # --- autopilot (observe/autopilot.py; README "Autopilot") -------
+    # The online controller: closes the calibrate→plan→act loop on
+    # the run's own telemetry (SLO burn → admission, page-pool
+    # pressure → slot cap, rolling accept rate → speculation depth,
+    # plan drift → calibration refit). Every decision is an auditable
+    # "tune" record; every actuation rides the scheduler's control-
+    # command path between decode steps (token-identical).
+    autopilot: bool = False
+    # Evaluation cadence in decode steps.
+    autopilot_every: int = 50
+    # Consecutive on-trigger evaluations before a knob moves (the
+    # confirm half of the hysteresis; deadbands are built into each
+    # loop's thresholds).
+    autopilot_confirm: int = 3
+    # Per-knob cooldown in decode steps after an actuation.
+    autopilot_cooldown: int = 200
+    # Relative plan-drift tolerance before a calibration refit
+    # (|drift_ratio - 1| > tol triggers loop 1).
+    autopilot_drift_tol: float = 0.25
+    # Comma-separated knobs the autopilot must NEVER touch:
+    # calibration,slot_cap,spec_k,decode_priority,num_pages,buckets.
+    autopilot_pin: str = ""
+    # Where loop 1 writes the refit calibration profile (atomic JSON,
+    # planner-loadable). "" = refits become advisory tune records
+    # only (applied=false).
+    autopilot_calibration: str = ""
 
     def validate(self) -> None:
         if self.health_every < 0:
@@ -264,6 +290,42 @@ class ObserveConfig:
                 "observe.flightrec_ring/flightrec_snapshot_every have "
                 "no effect without observe.flightrec; set a bundle "
                 "directory (--observe.flightrec DIR)")
+        if self.autopilot_every < 1:
+            raise ValueError(
+                f"observe.autopilot_every must be >= 1, "
+                f"got {self.autopilot_every}")
+        if self.autopilot_confirm < 1:
+            raise ValueError(
+                f"observe.autopilot_confirm must be >= 1, "
+                f"got {self.autopilot_confirm}")
+        if self.autopilot_cooldown < 0:
+            raise ValueError(
+                f"observe.autopilot_cooldown must be >= 0, "
+                f"got {self.autopilot_cooldown}")
+        if self.autopilot_drift_tol <= 0:
+            raise ValueError(
+                f"observe.autopilot_drift_tol must be > 0, "
+                f"got {self.autopilot_drift_tol}")
+        if self.autopilot_pin:
+            from tensorflow_distributed_tpu.observe.autopilot import (
+                KNOBS)
+            bad = sorted(
+                {p.strip() for p in self.autopilot_pin.split(",")
+                 if p.strip()} - set(KNOBS))
+            if bad:
+                raise ValueError(
+                    f"observe.autopilot_pin: unknown knob(s) "
+                    f"{', '.join(bad)} (valid: {', '.join(KNOBS)})")
+        if not self.autopilot and (
+                self.autopilot_every != 50
+                or self.autopilot_confirm != 3
+                or self.autopilot_cooldown != 200
+                or self.autopilot_drift_tol != 0.25
+                or self.autopilot_pin
+                or self.autopilot_calibration):
+            raise ValueError(
+                "observe.autopilot_* knobs have no effect without "
+                "observe.autopilot; add --observe.autopilot true")
 
 
 @dataclasses.dataclass

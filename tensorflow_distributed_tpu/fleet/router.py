@@ -814,6 +814,12 @@ class Router:
                 "per_device_cache_bytes": snap.get(
                     "per_device_cache_bytes"),
             }
+            if "tune_actions" in snap:
+                # Autopilot-armed replica: how many knobs its
+                # controller has moved — a replica self-tuning hard is
+                # a replica whose workload shifted (observe/
+                # autopilot.py; surfaces in fleetview).
+                per_rep[name]["tune_actions"] = snap["tune_actions"]
         done = [t for t in self.tracks.values() if t.state == "done"]
         by_cls: Dict[str, List[float]] = {}
         for t in done:

@@ -108,6 +108,8 @@ def _snapshot_section(snap: Optional[Dict[str, Any]],
             f"e{r.get('epoch', 0)} load={r.get('load', 0)} "
             f"inflight={r.get('inflight', 0)} done={r.get('done', 0)}"
             + (f" stale={_fmt(stale)}s" if stale is not None else "")
+            + (f" tunes={r['tune_actions']}"
+               if "tune_actions" in r else "")
             + (f" [{r['reason']}]" if r.get("reason") else ""))
 
 

@@ -192,6 +192,27 @@ class ServeObservatory:
             self.status_every = ocfg.slo_status_every
         self.export_every = ocfg.export_every
         self.export_path = ocfg.export_path
+        # The online controller (observe/autopilot.py): tune records
+        # flow to the same sinks; actuation happens scheduler-side
+        # through the control-command path. The metrics JSONL this
+        # bundle itself writes is the stream loop 1 tails for the
+        # compile × device_time join.
+        self.autopilot = None
+        if getattr(ocfg, "autopilot", False):
+            from tensorflow_distributed_tpu.observe.autopilot import (
+                Autopilot)
+            pins = tuple(
+                p.strip() for p in ocfg.autopilot_pin.split(",")
+                if p.strip())
+            self.autopilot = Autopilot(
+                emit=self.registry.emit,
+                every=ocfg.autopilot_every,
+                confirm=ocfg.autopilot_confirm,
+                cooldown=ocfg.autopilot_cooldown,
+                drift_tol=ocfg.autopilot_drift_tol,
+                pins=pins,
+                metrics_path=ocfg.metrics_jsonl,
+                calibration_path=ocfg.autopilot_calibration)
         # Library-level events (engine program registrations,
         # generate's compile-cache misses) land in this run's JSONL;
         # the program registry arms under the same sink-configured
@@ -208,6 +229,7 @@ class ServeObservatory:
             "registry": self.registry, "tracer": self.tracer,
             "slo_monitor": self.slo_monitor,
             "anomaly_hub": self.anomalies,
+            "autopilot": self.autopilot,
             "export_every": self.export_every,
             "export_path": self.export_path,
             "status_every": self.status_every,

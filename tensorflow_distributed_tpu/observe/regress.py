@@ -112,6 +112,18 @@ MANIFEST: Dict[str, Tuple[str, List[Check]]] = {
         ("slo_checks.recovery_instants_ok", "truthy"),
         ("slo_checks.trace_spans_restart", "truthy"),
     )),
+    "TUNEBENCH.json": ("jsonl", _jsonl_checks(
+        ("tune_goodput.ratio", "higher", 0.1),
+        ("tune_control.tune_actions", "lower", 0.0, 0.0),
+        ("tune_autopilot_tokens_per_sec.ratio", "higher", 0.0, 0.1),
+        ("tune_checks.converged", "truthy"),
+        ("tune_checks.identity", "truthy"),
+        ("tune_checks.quiet_control", "truthy"),
+        ("tune_checks.spec_retuned", "truthy"),
+        ("tune_checks.cli_wired", "truthy"),
+        ("tune_checks.overhead_ok", "truthy"),
+        ("tune_checks.evidence_ok", "truthy"),
+    )),
     "FIREBENCH.json": ("jsonl", _jsonl_checks(
         ("fire_goodput.value", "higher", 0.15),
         ("fire_tokens_per_sec.value", "higher", 0.5),

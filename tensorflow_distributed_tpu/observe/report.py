@@ -118,7 +118,7 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                     "total_new_tokens", "prefill_compiles", "retries",
                     "swaps", "swap_seconds", "seed", "trace",
                     "policy", "preemptions", "spec_tokens",
-                    "verify_steps", "accept_rate",
+                    "verify_steps", "accept_rate", "tune_actions",
                     "spec_fallback_slots", "slo_alerts",
                     "slo_budget_remaining_min", "slo_targets",
                     # Paged KV + prefix reuse (serve/paging): pool
@@ -163,13 +163,36 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         last = snapshots[-1]
         keep = ("t_s", "decode_steps", "requests_done", "queue_depth",
                 "slot_occupancy", "tokens_per_sec",
-                "tokens_per_sec_window", "accept_rate", "retries",
-                "preemptions", "swaps")
+                "tokens_per_sec_window", "accept_rate",
+                "accept_rate_window", "spec_tokens", "tune_actions",
+                "retries", "preemptions", "swaps")
         entry = {k: last[k] for k in keep if k in last}
         for k in sorted(last):
             if k.startswith("ttft_ms_p"):
                 entry[k] = last[k]
         out["snapshot_last"] = entry
+    # Autopilot decision ledger (observe/autopilot.py): the run-end
+    # tune_summary rollup plus the decision records folded per loop —
+    # a quiet well-tuned run shows actions=0 here.
+    tunes = [r for r in records if r.get("event") == "tune"]
+    tune_sums = [r for r in records
+                 if r.get("event") == "tune_summary"]
+    if tunes or tune_sums:
+        tentry: Dict[str, Any] = {}
+        if tune_sums:
+            tfin = tune_sums[-1]
+            for k in ("evals", "actions", "advisories", "suppressed",
+                      "by_knob", "quiet"):
+                if k in tfin:
+                    tentry[k] = tfin[k]
+        by_loop: Dict[str, int] = {}
+        for r in tunes:
+            lp = str(r.get("loop", "?"))
+            by_loop[lp] = by_loop.get(lp, 0) + 1
+        if by_loop:
+            tentry["decisions_by_loop"] = dict(sorted(
+                by_loop.items()))
+        out["tune"] = tentry
     # SLO preempt-and-requeue events (policy, not failure — reported
     # apart from the Recovery section).
     preempts = [r for r in records if r.get("event") == "preempt"]
@@ -567,7 +590,8 @@ def render(summary: Dict[str, Any]) -> str:
              "serve_swap_seconds", "serve_policy", "serve_preemptions",
              "serve_preempt_events", "serve_spec_tokens",
              "serve_verify_steps", "serve_accept_rate",
-             "serve_spec_fallback_slots", "serve_slo_alerts",
+             "serve_spec_fallback_slots", "serve_tune_actions",
+             "serve_slo_alerts",
              "serve_slo_budget_remaining_min", "serve_slo_targets",
              "serve_seed", "serve_trace", "snapshots")
     # plan/programs/health/recovery/slo render as their own sections
@@ -576,7 +600,7 @@ def render(summary: Dict[str, Any]) -> str:
                 "recovery_counts", "swap_seconds_total",
                 "mesh_changes", "mesh_change_path",
                 "reshard_seconds_total", "slo", "snapshot_last",
-                "fleet", "anomalies", "postmortem_bundles",
+                "tune", "fleet", "anomalies", "postmortem_bundles",
                 "device_time", "device_time_null_records", "hosts",
                 # rendered inside the Device time section, not the
                 # generic stats list (one print per number).
@@ -779,6 +803,11 @@ def render(summary: Dict[str, Any]) -> str:
     if "snapshot_last" in summary:
         lines.append("Snapshot (final)")
         entry = summary["snapshot_last"]
+        for key in sorted(entry):
+            lines.append(f"  {key:<28} {entry[key]}")
+    if "tune" in summary:
+        lines.append("Autopilot")
+        entry = summary["tune"]
         for key in sorted(entry):
             lines.append(f"  {key:<28} {entry[key]}")
     if "anomalies" in summary:

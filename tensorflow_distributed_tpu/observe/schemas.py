@@ -112,6 +112,13 @@ _SECTIONS: Tuple[Tuple[str, str], ...] = (
     ("Resilience", ""),
     ("Incident observatory (observe/anomaly.py, observe/flightrec.py)", ""),
     ("Serving", ""),
+    ("Autopilot (observe/autopilot.py)",
+     "The online controller's decision ledger (`--observe.autopilot`): "
+     "every knob move (and every advisory it could not apply live) is "
+     "one auditable `tune` record carrying the triggering signal, the "
+     "observed value, and the threshold it crossed; one `tune_summary` "
+     "rolls up the run (`quiet=true` is the well-tuned-run contract "
+     "TUNEBENCH gates)."),
     ("Fleet serving (fleet/router.py, fleet/controller.py)",
      "Emitted by the FRONT-END process (fleet/run.py's registry), not "
      "the replicas; `observe.report` folds them into the Fleet section."),
@@ -540,6 +547,10 @@ SCHEMAS: Tuple[Schema, ...] = (
             F("anomalies", "int",
               doc="total anomaly-record count (when `--observe.anomaly` "
                   "is armed)"),
+            F("tune_actions", "int",
+              doc="applied autopilot knob changes this run (when "
+                  "`--observe.autopilot` is armed; 0 on a well-tuned "
+                  "run — the quiet-control contract)"),
             F("tp_width", "int",
               doc="tensor-parallel width (`--serve.mesh-model`, 1 when "
                   "unsharded)"),
@@ -612,9 +623,22 @@ SCHEMAS: Tuple[Schema, ...] = (
             F("queue_depth", "int", doc="queued requests"),
             F("slot_occupancy", "num", doc="live-slot fraction"),
             F("tokens_per_sec", "num", doc="cumulative throughput"),
-            F("tokens_per_sec_window", "num", doc="windowed throughput"),
+            F("tokens_per_sec_window", "num",
+              doc="throughput over the rolling window — beside the "
+                  "cumulative rate, so a regime shift is visible to a "
+                  "controller (the autopilot reads this one)"),
             F("accept_rate", "num", nullable=True,
-              doc="speculation accept rate"),
+              doc="speculation accept rate, lifetime-cumulative"),
+            F("accept_rate_window", "num",
+              doc="accept rate over the rolling window "
+                  "(accepted/proposed deltas between the window "
+                  "endpoints) — the autopilot's loop-3 signal"),
+            F("spec_tokens", "int",
+              doc="CURRENT speculation depth k — moves live under "
+                  "autopilot loop 3"),
+            F("tune_actions", "int",
+              doc="applied autopilot knob changes so far "
+                  "(`--observe.autopilot`)"),
             F("retries", "int", doc="intake retries"),
             F("preemptions", "int", doc="scheduler preemptions"),
             F("swaps", "int", doc="weight swaps absorbed"),
@@ -668,6 +692,70 @@ SCHEMAS: Tuple[Schema, ...] = (
             F("tenant", "str", nullable=True, doc="victim's tenant"),
             F("served", "int", doc="tokens served before preemption"),
             F("t_s", "num", doc="serve clock seconds"),
+        )),
+    # ---------------------------------------------------------- Autopilot
+    Schema(
+        "tune", section="Autopilot (observe/autopilot.py)",
+        doc="One autopilot decision: a live knob actuation "
+            "(`applied=true` — routed through the scheduler's "
+            "control-command path between decode steps, so the token "
+            "streams are identical by construction) or an advisory "
+            "recommendation for a boot-time knob it cannot change live "
+            "(`applied=false`: `num_pages`, `buckets`, or a calibration "
+            "refit with no `--observe.autopilot-calibration` path). The "
+            "`signal`/`observed`/`threshold` triple plus `evidence` is "
+            "the machine-readable audit trail TUNEBENCH gates.",
+        fields=(
+            F("step", "int", required=True,
+              doc="decode-step clock at the decision"),
+            F("loop", "str", required=True,
+              doc="`admission` | `capacity` | `speculation` | "
+                  "`calibration`"),
+            F("knob", "str", required=True,
+              doc="`decode_priority` | `slot_cap` | `spec_k` | "
+                  "`calibration` | `num_pages` | `buckets`"),
+            F("action", "str", required=True,
+              doc="what moved: `tighten`/`relax` (admission), "
+                  "`shrink`/`grow` (slot cap), `deepen`/`shallow` "
+                  "(spec k), `refit` (calibration), `recommend` "
+                  "(advisories)"),
+            F("value", "any", required=True,
+              doc="the new knob value (calibration: the refit "
+                  "profile's `calibration_id`)"),
+            F("prev", "any", nullable=True, doc="the value it replaced"),
+            F("signal", "str", required=True,
+              doc="the telemetry stream that triggered: "
+                  "`slo_burn_fast` | `pool_occupancy` | "
+                  "`accept_rate_window` | `drift_ratio` | "
+                  "`slot_pages_peak` | `prompt_len_p99`"),
+            F("observed", "num", doc="the signal's observed value"),
+            F("threshold", "num", doc="the threshold it crossed"),
+            F("applied", "bool", required=True,
+              doc="true = actuated live through the control-command "
+                  "path; false = advisory only"),
+            F("evidence", "dict",
+              doc="the triggering context (e.g. the `plan_drift` "
+                  "record, burn rates per target, the sizer's "
+                  "rationale lines)"),
+        )),
+    Schema(
+        "tune_summary", section="Autopilot (observe/autopilot.py)",
+        doc="One per autopilot-armed run: the decision-ledger rollup. "
+            "`quiet=true` (zero applied actions) is the well-tuned-run "
+            "contract; `suppressed` counts triggers absorbed by per-knob "
+            "cooldowns (the rate limiter working, not a bug).",
+        fields=(
+            F("step", "int", required=True,
+              doc="decode-step clock at run end"),
+            F("evals", "int", doc="evaluation ticks"),
+            F("actions", "int", doc="applied knob changes"),
+            F("advisories", "int",
+              doc="applied=false recommendations emitted"),
+            F("suppressed", "int",
+              doc="triggers absorbed by a cooling-down knob"),
+            F("by_knob", "dict", doc="applied changes per knob"),
+            F("quiet", "bool", required=True,
+              doc="zero applied actions (the control-run gate)"),
         )),
     # ------------------------------------------------------ Fleet serving
     Schema(
@@ -944,6 +1032,9 @@ NESTED: Dict[str, Tuple[Field, ...]] = {
         F("ckpt_step", "int", nullable=True, doc="model staleness feed"),
         F("tp_width", "int", doc="tensor-parallel width"),
         F("per_device_cache_bytes", "int", doc="per-device cache bytes"),
+        F("tune_actions", "int",
+          doc="autopilot knob changes on this replica — a replica "
+              "self-tuning hard is one whose workload shifted"),
     ),
     # The serve journal's line records (serve/journal.py) — the
     # replay/crash-recovery contract the fleet router also tails.
@@ -1040,6 +1131,9 @@ NESTED: Dict[str, Tuple[Field, ...]] = {
           doc="min SLO budget remaining"),
         F("worst_burn_fast", "num", doc="worst fast-window burn"),
         F("snapshot_last", "dict", doc="last metrics_snapshot folded"),
+        F("tune", "dict",
+          doc="Autopilot section: the run's `tune_summary` rollup plus "
+              "the decision records folded per loop"),
         F("by_detector", "dict", doc="anomaly counts per detector"),
         F("postmortem_bundles", "list", doc="bundle paths seen"),
         F("worst_update_ratio", "num", doc="health: worst update ratio"),

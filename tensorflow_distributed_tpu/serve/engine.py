@@ -298,6 +298,29 @@ class SlotDecodeEngine:
                                           self.spec_tokens)
                            if self.spec_tokens else None)
 
+    def set_spec_k(self, k: int) -> None:
+        """Live speculation-depth change between decode steps — the
+        autopilot's loop-3 actuator. Rebinds the verify executable at
+        the new k through the same ``lookup_program`` cache the ctor
+        used: a k this engine has already run is a dict hit; a new k
+        pays its compile once, on the next verify dispatch. Safe with
+        slots live — ``can_verify``/``verify_fallback_slots`` read
+        ``spec_tokens`` per call for the headroom guard, and greedy
+        verify is token-identical at any k by construction. Only an
+        engine BUILT speculative can retune: k=0 engines compiled no
+        verify program and the scheduler wires no speculator."""
+        k = int(k)
+        if k < 1:
+            raise ValueError(f"spec k must be >= 1, got {k}")
+        if not self.spec_tokens:
+            raise ValueError(
+                "set_spec_k needs an engine built with spec_tokens "
+                "> 0 (a k=0 engine has no verify program to retune)")
+        if k == self.spec_tokens:
+            return
+        self.spec_tokens = k
+        self._build_programs()
+
     def _dispatch_step(self, tok, pos):
         """One decode-program dispatch (the paged subclass appends the
         page tables); returns (cache, next tokens, per-slot ok)."""

@@ -463,6 +463,28 @@ def serve_run(cfg: TrainConfig) -> Dict:
                                  watchdog=watchdog,
                                  spec_tokens=cfg.serve.spec_tokens,
                                  tracer=obs.tracer)
+        if obs.autopilot is not None:
+            # Loop 2's advisory half: the autopilot re-runs the SAME
+            # one-shot sizer against the peak it OBSERVED, via this
+            # closure — the controller itself stays jax-free and
+            # never re-derives page-bytes arithmetic.
+            def _recommend_pages(observed_peak: int,
+                                 _ps=cfg.serve.page_size):
+                import jax
+                reserved = sum(
+                    int(np.prod(x.shape)) * x.dtype.itemsize
+                    for x in jax.tree_util.tree_leaves(params))
+                return auto_num_pages(
+                    num_slots=cfg.serve.num_slots,
+                    need_pages=-(-need // _ps),
+                    page_bytes=page_bytes_estimate(model.cfg, _ps,
+                                                   tp=tp),
+                    budget_bytes=int(
+                        cfg.serve.hbm_budget_gb * 2 ** 30),
+                    reserved_bytes=reserved,
+                    observed_peak=int(observed_peak))
+            obs.autopilot.bind_paging(num_pages=num_pages,
+                                      recommend=_recommend_pages)
     else:
         engine = SlotDecodeEngine(model, params, cfg.serve.num_slots,
                                   buckets=buckets, check=cfg.check,
@@ -482,6 +504,10 @@ def serve_run(cfg: TrainConfig) -> Dict:
     # window) pays compute, not compile/cache-load, and the measured
     # serving wall (tokens/s) starts clean after warmup.
     engine.warmup(speculator)
+    if obs.autopilot is not None:
+        # The bucket ladder the run booted with — the baseline the
+        # prompt-distribution advisory compares against.
+        obs.autopilot.bind_buckets(buckets)
     reload_fn = None
     if cfg.checkpoint_dir:
         def reload_fn():

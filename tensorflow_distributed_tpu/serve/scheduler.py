@@ -231,7 +231,7 @@ class Scheduler:
                  summary_extra=None, policy: str = "fifo",
                  tenant_quota: int = 0, preempt: bool = True,
                  speculator=None, tracer=None, slo_monitor=None,
-                 anomaly_hub=None,
+                 anomaly_hub=None, autopilot=None,
                  export_every: float = 0.0, export_path: str = "",
                  status_fn=None, status_every: int = 0,
                  feed=None, served_ckpt_step=None):
@@ -269,6 +269,23 @@ class Scheduler:
         # decode-dispatch-wall / queue-depth values this loop already
         # holds on host, on the deterministic decode-step clock.
         self.anomaly_hub = anomaly_hub
+        # The online controller (observe/autopilot.py): consulted on
+        # the decode-step clock; its decisions come back as "tune"
+        # commands through the SAME control path fleet drain/swap/
+        # cancel commands take, so every actuation lands between
+        # decode steps and token identity holds by construction.
+        self.autopilot = autopilot
+        # Effective live-slot cap, tunable below the engine's
+        # allocated num_slots (loop 2: fewer live slots pin fewer
+        # pages). 0 = uncapped.
+        self._slot_cap = 0
+        self._tunes = 0
+        if autopilot is not None:
+            autopilot.bind_scheduler(
+                num_slots=int(getattr(engine, "num_slots", 0) or 0),
+                spec_k=int(getattr(engine, "spec_tokens", 0) or 0),
+                decode_priority=decode_priority,
+                has_spec=speculator is not None)
         if export_every < 0:
             raise ValueError(
                 f"export_every must be >= 0, got {export_every}")
@@ -441,11 +458,17 @@ class Scheduler:
         # Rolling (t, decoded) samples for the tokens/s counter track
         # and the snapshot's windowed rate.
         rate_win: collections.deque = collections.deque(maxlen=64)
+        # Rolling (t, accepted_cum, proposed_cum) samples: the
+        # windowed accept rate beside the cumulative one — a regime
+        # shift in acceptance is invisible to any controller reading
+        # only the lifetime ratio.
+        spec_win: collections.deque = collections.deque(maxlen=64)
         self._snap_state = {
             "t0": t0, "tally": tally, "rate_win": rate_win,
             "queue": queue, "live": live, "done": done,
             "pending": pending, "retries_map": retries,
             "preempts_map": preempts, "spec_stats": spec_stats,
+            "spec_win": spec_win,
         }
         self._last_export = t0
 
@@ -537,6 +560,11 @@ class Scheduler:
 
         def admit(pick: int) -> None:
             req = queue.pop(pick)
+            if self.autopilot is not None:
+                # One host int per admission: the prompt-length
+                # distribution the bucket/num-pages advisories size
+                # from.
+                self.autopilot.observe_prompt(len(req.prompt))
             slot = eng.free_slots()[0]
             ctx = (tracer.prefill(req.rid,
                                   pick_bucket(len(req.prompt),
@@ -728,6 +756,8 @@ class Scheduler:
             elif kind == "hold_export":
                 self._export_hold_until = (
                     self.clock() + float(cmd.get("secs", 0.0)))
+            elif kind == "tune":
+                self._apply_tune(cmd)
 
         def feed_request(r) -> None:
             nonlocal has_sessions
@@ -794,6 +824,8 @@ class Scheduler:
                                           prompt_len=len(req.prompt),
                                           tenant=req.tenant)
             if queue and eng.free_slots() and (
+                    not self._slot_cap
+                    or len(live) < self._slot_cap) and (
                     not live or steps_since_admit
                     >= self.decode_priority):
                 # Page-pool pressure (paged engine only): the pick's
@@ -991,6 +1023,9 @@ class Scheduler:
                 self.journal.flush()
             # --- live observability, on the decode-step clock -------
             rate_win.append((now(), tally["decoded"]))
+            if spec is not None:
+                spec_win.append((now(), spec_stats["accepted"],
+                                 spec_stats["proposed"]))
             if tracer is not None:
                 counters = {"slots": eng.occupancy(),
                             "queue": float(len(queue))}
@@ -1007,6 +1042,17 @@ class Scheduler:
             if (self.status_fn is not None and self.status_every > 0
                     and tally["steps"] % self.status_every == 0):
                 self.status_fn(self.status_line())
+            if self.autopilot is not None:
+                # The controller evaluates on its own cadence (the
+                # off-cadence cost is one modulo — the snapshot is
+                # only built on eval ticks) and its decisions route
+                # through feed_cmd like any fleet command: applied
+                # HERE, between decode steps, where continuation
+                # semantics + greedy determinism keep every live
+                # stream token-identical.
+                for tc in self.autopilot.maybe_step(
+                        tally["steps"], self.metrics_snapshot):
+                    feed_cmd(tc)
             self._maybe_export()
 
         wall = now()
@@ -1059,8 +1105,16 @@ class Scheduler:
             # capacity feed the item-1 router / item-5 Fleetbench
             # poll, and PAGEBENCH's FLOPs-saved arithmetic.
             summary.update(pstats())
+        if self.autopilot is not None:
+            summary["tune_actions"] = self._tunes
         self._emit("serve_summary", **summary)
         self.summary = summary
+        if self.autopilot is not None:
+            # Run-end rollup: the decision ledger plus the advisory
+            # recommendations for the boot-time knobs (num_pages,
+            # bucket ladder) sized from THIS run's observed peaks.
+            self.autopilot.emit_summary(tally["steps"],
+                                        self.metrics_snapshot())
         # One FINAL snapshot covering every completion, so the export
         # artifact's last point agrees exactly with the post-run
         # report's per-class percentiles (slobench gates this).
@@ -1082,6 +1136,50 @@ class Scheduler:
         if tb <= ta:
             return None
         return (db - da) / (tb - ta)
+
+    def _window_accept(self) -> Optional[float]:
+        """Accept rate over the rolling window — accepted/proposed
+        deltas between the window's endpoints (None until speculation
+        has proposed inside the window). The cumulative
+        ``accept_rate`` stays beside it: a regime shift moves the
+        window long before it moves the lifetime ratio."""
+        st = self._snap_state
+        if st is None or len(st.get("spec_win", ())) < 2:
+            return None
+        a, b = st["spec_win"][0], st["spec_win"][-1]
+        dp = b[2] - a[2]
+        if dp <= 0:
+            return None
+        return (b[1] - a[1]) / dp
+
+    def _apply_tune(self, cmd: Dict[str, Any]) -> None:
+        """One live knob change, between decode steps (the autopilot's
+        actuation path — also reachable from a fleet inbox ``tune``
+        command). Values are clamped, unknown knobs are ignored (a
+        replica never crashes on a bad dispatch), and every applied
+        change counts into ``tune_actions``."""
+        knob = cmd.get("knob")
+        value = cmd.get("value")
+        if knob == "decode_priority":
+            self.decode_priority = max(1, int(value))
+        elif knob == "slot_cap":
+            ns = int(getattr(self.engine, "num_slots", 0) or 0)
+            cap = max(1, int(value))
+            self._slot_cap = min(cap, ns) if ns else cap
+        elif knob == "preempt":
+            self.preempt = bool(value)
+        elif knob == "spec_k":
+            k = max(1, int(value))
+            set_k = getattr(self.engine, "set_spec_k", None)
+            if set_k is None:
+                return
+            set_k(k)
+            sp_set = getattr(self.speculator, "set_k", None)
+            if sp_set is not None:
+                sp_set(k)
+        else:
+            return
+        self._tunes += 1
 
     def _capacity_fields(self) -> Dict[str, Any]:
         """HBM-capacity facts for the fleet side, PER-DEVICE honest:
@@ -1169,6 +1267,13 @@ class Scheduler:
         if self.speculator is not None and spec_stats["proposed"]:
             snap["accept_rate"] = round(
                 spec_stats["accepted"] / spec_stats["proposed"], 4)
+            snap["spec_tokens"] = int(
+                getattr(self.engine, "spec_tokens", 0) or 0)
+        aw = self._window_accept()
+        if aw is not None:
+            snap["accept_rate_window"] = round(aw, 4)
+        if self.autopilot is not None:
+            snap["tune_actions"] = self._tunes
         by_cls: Dict[str, List[float]] = {}
         for c in st["done"]:
             by_cls.setdefault(c.slo, []).append(1e3 * c.ttft_s)

@@ -120,6 +120,13 @@ class SelfDraft:
         self.k = k
         self.g = g
 
+    def set_k(self, k: int) -> None:
+        """Live depth change (autopilot loop 3): the k-gram proposer
+        is host-only, so a new k is just a wider/narrower lookup."""
+        if k < 1:
+            raise ValueError(f"spec_tokens must be >= 1, got {k}")
+        self.k = int(k)
+
     def propose(self, histories: Dict[int, Sequence[int]]
                 ) -> np.ndarray:
         """[num_slots, k] int32 proposals; rows without a history
@@ -229,6 +236,20 @@ class DraftSpeculator:
         self.tok = np.zeros((num_slots,), np.int32)
         self.pos = np.zeros((num_slots,), np.int32)
         self._propose_fn = lookup_program(_compiled_draft, model, k)
+
+    def set_k(self, k: int) -> None:
+        """Live depth change (autopilot loop 3): rebind the proposal
+        scan at the new k through the same ``lookup_program`` cache
+        the ctor used — a revisited k is a dict hit, a new one
+        compiles on the next propose. The draft cache/positions are
+        untouched: the scan length is the only thing k shapes."""
+        if k < 1:
+            raise ValueError(f"spec_tokens must be >= 1, got {k}")
+        if int(k) == self.k:
+            return
+        self.k = int(k)
+        self._propose_fn = lookup_program(_compiled_draft, self.model,
+                                          self.k)
 
     def observe_admit(self, slot: int, prompt, first_tok: int) -> None:
         """Mirror an engine admission: prefill the draft cache row for
